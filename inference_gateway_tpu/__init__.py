@@ -1,0 +1,25 @@
+"""inference_gateway_tpu — a TPU-native inference gateway framework.
+
+A ground-up rebuild of the capability surface of
+inference-gateway/inference-gateway (a Go OpenAI-compatible LLM gateway,
+see /root/reference) re-designed TPU-first:
+
+- ``gateway`` layers (``api/``, ``providers/``, ``mcp/``, ``otel/``,
+  ``config``, ``logger``): an asyncio, stdlib-only HTTP gateway exposing a
+  unified OpenAI-compatible API over 15 upstream providers plus a
+  first-class ``tpu`` provider.
+- ``serving``: the TPU serving engine — continuous batching, paged KV
+  cache, OpenAI-compatible SSE server — whose compute path is JAX/XLA with
+  Pallas kernels for the hot ops.
+- ``models`` / ``ops`` / ``parallel``: pure-JAX model definitions
+  (Llama-family, Mixtral MoE, vision), TPU kernels, and ``jax.sharding``
+  mesh utilities (dp/tp/sp/ep) for single-host and multi-host pods.
+
+Reference parity map (file:line citations to /root/reference throughout):
+see SURVEY.md at the repo root.
+"""
+
+from inference_gateway_tpu.version import APPLICATION_NAME, VERSION
+
+__all__ = ["APPLICATION_NAME", "VERSION"]
+__version__ = VERSION

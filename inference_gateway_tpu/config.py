@@ -1,0 +1,254 @@
+"""Environment-variable configuration.
+
+Capability parity with reference config/config.go:20-139: the same env-var
+surface (ENVIRONMENT, ALLOWED_MODELS/DISALLOWED_MODELS, ENABLE_VISION,
+TELEMETRY_*, MCP_*, AUTH_*, SERVER_*, CLIENT_*, ROUTING_*, plus per-provider
+``<ID>_API_URL`` / ``<ID>_API_KEY``), the same defaults, and the same
+"provider is not configured" notice for providers missing a token.
+
+Like the reference's ``envconfig.Lookuper``, ``Config.load`` takes any
+mapping (default ``os.environ``) so tests can inject environments without
+touching the process env.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from inference_gateway_tpu.providers import constants
+from inference_gateway_tpu.providers.registry import REGISTRY, ProviderConfig
+from inference_gateway_tpu.utils.durations import parse_duration
+
+
+def _get_bool(env: Mapping[str, str], key: str, default: bool) -> bool:
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() in ("1", "t", "true", "yes", "on")
+
+
+def _get_str(env: Mapping[str, str], key: str, default: str = "") -> str:
+    val = env.get(key)
+    return default if val is None else val
+
+
+def _get_int(env: Mapping[str, str], key: str, default: int) -> int:
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    return int(raw)
+
+
+def _get_duration(env: Mapping[str, str], key: str, default: str) -> float:
+    return parse_duration(env.get(key) or default)
+
+
+@dataclass
+class TelemetryConfig:
+    """TELEMETRY_* (config.go:46-52)."""
+
+    enable: bool = False
+    metrics_push_enable: bool = False
+    metrics_port: str = "9464"
+    tracing_enable: bool = False
+    tracing_otlp_endpoint: str = "http://localhost:4318"
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "TELEMETRY_") -> "TelemetryConfig":
+        return cls(
+            enable=_get_bool(env, prefix + "ENABLE", False),
+            metrics_push_enable=_get_bool(env, prefix + "METRICS_PUSH_ENABLE", False),
+            metrics_port=_get_str(env, prefix + "METRICS_PORT", "9464"),
+            tracing_enable=_get_bool(env, prefix + "TRACING_ENABLE", False),
+            tracing_otlp_endpoint=_get_str(env, prefix + "TRACING_OTLP_ENDPOINT", "http://localhost:4318"),
+        )
+
+
+@dataclass
+class MCPConfig:
+    """MCP_* (config.go:55-76). Durations are float seconds."""
+
+    enable: bool = False
+    expose: bool = False
+    servers: str = ""
+    include_tools: str = ""
+    exclude_tools: str = ""
+    client_timeout: float = 5.0
+    dial_timeout: float = 3.0
+    tls_handshake_timeout: float = 3.0
+    response_header_timeout: float = 3.0
+    expect_continue_timeout: float = 1.0
+    request_timeout: float = 5.0
+    max_retries: int = 3
+    retry_interval: float = 5.0
+    initial_backoff: float = 1.0
+    enable_reconnect: bool = True
+    reconnect_interval: float = 30.0
+    polling_enable: bool = True
+    polling_interval: float = 30.0
+    polling_timeout: float = 5.0
+    disable_healthcheck_logs: bool = True
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "MCP_") -> "MCPConfig":
+        return cls(
+            enable=_get_bool(env, prefix + "ENABLE", False),
+            expose=_get_bool(env, prefix + "EXPOSE", False),
+            servers=_get_str(env, prefix + "SERVERS"),
+            include_tools=_get_str(env, prefix + "INCLUDE_TOOLS"),
+            exclude_tools=_get_str(env, prefix + "EXCLUDE_TOOLS"),
+            client_timeout=_get_duration(env, prefix + "CLIENT_TIMEOUT", "5s"),
+            dial_timeout=_get_duration(env, prefix + "DIAL_TIMEOUT", "3s"),
+            tls_handshake_timeout=_get_duration(env, prefix + "TLS_HANDSHAKE_TIMEOUT", "3s"),
+            response_header_timeout=_get_duration(env, prefix + "RESPONSE_HEADER_TIMEOUT", "3s"),
+            expect_continue_timeout=_get_duration(env, prefix + "EXPECT_CONTINUE_TIMEOUT", "1s"),
+            request_timeout=_get_duration(env, prefix + "REQUEST_TIMEOUT", "5s"),
+            max_retries=_get_int(env, prefix + "MAX_RETRIES", 3),
+            retry_interval=_get_duration(env, prefix + "RETRY_INTERVAL", "5s"),
+            initial_backoff=_get_duration(env, prefix + "INITIAL_BACKOFF", "1s"),
+            enable_reconnect=_get_bool(env, prefix + "ENABLE_RECONNECT", True),
+            reconnect_interval=_get_duration(env, prefix + "RECONNECT_INTERVAL", "30s"),
+            polling_enable=_get_bool(env, prefix + "POLLING_ENABLE", True),
+            polling_interval=_get_duration(env, prefix + "POLLING_INTERVAL", "30s"),
+            polling_timeout=_get_duration(env, prefix + "POLLING_TIMEOUT", "5s"),
+            disable_healthcheck_logs=_get_bool(env, prefix + "DISABLE_HEALTHCHECK_LOGS", True),
+        )
+
+
+@dataclass
+class AuthConfig:
+    """AUTH_* (config.go:79-84)."""
+
+    enable: bool = False
+    oidc_issuer: str = "http://keycloak:8080/realms/inference-gateway-realm"
+    oidc_client_id: str = "inference-gateway-client"
+    oidc_client_secret: str = ""
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "AUTH_") -> "AuthConfig":
+        return cls(
+            enable=_get_bool(env, prefix + "ENABLE", False),
+            oidc_issuer=_get_str(env, prefix + "OIDC_ISSUER", cls.oidc_issuer),
+            oidc_client_id=_get_str(env, prefix + "OIDC_CLIENT_ID", cls.oidc_client_id),
+            oidc_client_secret=_get_str(env, prefix + "OIDC_CLIENT_SECRET"),
+        )
+
+
+@dataclass
+class ServerConfig:
+    """SERVER_* (config.go:87-95)."""
+
+    host: str = "0.0.0.0"
+    port: str = "8080"
+    read_timeout: float = 30.0
+    write_timeout: float = 30.0
+    idle_timeout: float = 120.0
+    tls_cert_path: str = ""
+    tls_key_path: str = ""
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "SERVER_") -> "ServerConfig":
+        return cls(
+            host=_get_str(env, prefix + "HOST", "0.0.0.0"),
+            port=_get_str(env, prefix + "PORT", "8080"),
+            read_timeout=_get_duration(env, prefix + "READ_TIMEOUT", "30s"),
+            write_timeout=_get_duration(env, prefix + "WRITE_TIMEOUT", "30s"),
+            idle_timeout=_get_duration(env, prefix + "IDLE_TIMEOUT", "120s"),
+            tls_cert_path=_get_str(env, prefix + "TLS_CERT_PATH"),
+            tls_key_path=_get_str(env, prefix + "TLS_KEY_PATH"),
+        )
+
+
+@dataclass
+class ClientConfig:
+    """CLIENT_* (reference providers/client/client.go:26-35)."""
+
+    timeout: float = 30.0
+    max_idle_conns: int = 20
+    max_idle_conns_per_host: int = 20
+    idle_conn_timeout: float = 30.0
+    tls_min_version: str = "TLS12"
+    disable_compression: bool = True
+    response_header_timeout: float = 10.0
+    expect_continue_timeout: float = 1.0
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "CLIENT_") -> "ClientConfig":
+        return cls(
+            timeout=_get_duration(env, prefix + "TIMEOUT", "30s"),
+            max_idle_conns=_get_int(env, prefix + "MAX_IDLE_CONNS", 20),
+            max_idle_conns_per_host=_get_int(env, prefix + "MAX_IDLE_CONNS_PER_HOST", 20),
+            idle_conn_timeout=_get_duration(env, prefix + "IDLE_CONN_TIMEOUT", "30s"),
+            tls_min_version=_get_str(env, prefix + "TLS_MIN_VERSION", "TLS12"),
+            disable_compression=_get_bool(env, prefix + "DISABLE_COMPRESSION", True),
+            response_header_timeout=_get_duration(env, prefix + "RESPONSE_HEADER_TIMEOUT", "10s"),
+            expect_continue_timeout=_get_duration(env, prefix + "EXPECT_CONTINUE_TIMEOUT", "1s"),
+        )
+
+
+@dataclass
+class RoutingConfig:
+    """ROUTING_* (config.go:98-101)."""
+
+    enabled: bool = False
+    config_path: str = ""
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "ROUTING_") -> "RoutingConfig":
+        return cls(
+            enabled=_get_bool(env, prefix + "ENABLED", False),
+            config_path=_get_str(env, prefix + "CONFIG_PATH"),
+        )
+
+
+@dataclass
+class Config:
+    """Top-level gateway configuration (config.go:20-43)."""
+
+    environment: str = "production"
+    allowed_models: str = ""
+    disallowed_models: str = ""
+    enable_vision: bool = False
+    debug_content_truncate_words: int = 10
+    debug_max_messages: int = 100
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    mcp: MCPConfig = field(default_factory=MCPConfig)
+    auth: AuthConfig = field(default_factory=AuthConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+    client: ClientConfig = field(default_factory=ClientConfig)
+    routing: RoutingConfig = field(default_factory=RoutingConfig)
+    providers: dict[str, ProviderConfig] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, env: Mapping[str, str] | None = None, logger=None) -> "Config":
+        """Resolve config from an environment mapping
+        (config.go:104-139)."""
+        if env is None:
+            env = os.environ
+        cfg = cls(
+            environment=_get_str(env, "ENVIRONMENT", "production"),
+            allowed_models=_get_str(env, "ALLOWED_MODELS"),
+            disallowed_models=_get_str(env, "DISALLOWED_MODELS"),
+            enable_vision=_get_bool(env, "ENABLE_VISION", False),
+            debug_content_truncate_words=_get_int(env, "DEBUG_CONTENT_TRUNCATE_WORDS", 10),
+            debug_max_messages=_get_int(env, "DEBUG_MAX_MESSAGES", 100),
+            telemetry=TelemetryConfig.load(env),
+            mcp=MCPConfig.load(env),
+            auth=AuthConfig.load(env),
+            server=ServerConfig.load(env),
+            client=ClientConfig.load(env),
+            routing=RoutingConfig.load(env),
+        )
+        for pid, defaults in REGISTRY.items():
+            pc = defaults.copy()
+            url = env.get(pid.upper() + "_API_URL")
+            if url:
+                pc.url = url
+            token = env.get(pid.upper() + "_API_KEY", "")
+            if not token and pc.auth_type != constants.AUTH_TYPE_NONE and logger is not None:
+                logger.info("provider is not configured", "provider", pid)
+            pc.token = token
+            cfg.providers[pid] = pc
+        return cfg

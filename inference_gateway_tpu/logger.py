@@ -1,0 +1,84 @@
+"""Structured key-value logger.
+
+Capability parity with the reference's zap wrapper (logger/logger.go:12-109):
+Info/Debug/Warn/Error with variadic key-value fields, JSON lines in
+production, human-readable lines in development, debug suppressed outside
+development, and automatic noop under the test runner
+(logger.go:39-47 ``isTestMode``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, TextIO
+
+
+def _is_test_mode() -> bool:
+    argv0 = sys.argv[0] if sys.argv else ""
+    return "pytest" in argv0 or "py.test" in argv0 or "pytest" in sys.modules
+
+
+class Logger:
+    """Structured logger; JSON encoder in production, console in dev."""
+
+    def __init__(self, environment: str = "production", stream: TextIO | None = None) -> None:
+        self.environment = environment
+        self._stream = stream or sys.stderr
+        self._lock = threading.Lock()
+
+    # -- core ------------------------------------------------------------
+    def _kv(self, args: tuple[Any, ...]) -> dict[str, Any]:
+        fields: dict[str, Any] = {}
+        it = iter(args)
+        for key in it:
+            fields[str(key)] = next(it, None)
+        return fields
+
+    def _emit(self, level: str, msg: str, args: tuple[Any, ...]) -> None:
+        fields = self._kv(args)
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + "Z"
+        with self._lock:
+            if self.environment == "development":
+                kv = " ".join(f"{k}={v!r}" for k, v in fields.items())
+                self._stream.write(f"{ts} {level.upper()} {msg} {kv}\n".rstrip() + "\n")
+            else:
+                record = {"level": level, "timestamp": ts, "msg": msg, **fields}
+                self._stream.write(json.dumps(record, default=str) + "\n")
+            self._stream.flush()
+
+    # -- public API (logger.go:12-17) ------------------------------------
+    def info(self, msg: str, *args: Any) -> None:
+        self._emit("info", msg, args)
+
+    def debug(self, msg: str, *args: Any) -> None:
+        if self.environment == "development":
+            self._emit("debug", msg, args)
+
+    def warn(self, msg: str, *args: Any) -> None:
+        self._emit("warn", msg, args)
+
+    def error(self, msg: str, err: Any = None, *args: Any) -> None:
+        if err is not None:
+            args = ("error", str(err)) + args
+        self._emit("error", msg, args)
+
+
+class NoopLogger(Logger):
+    """Discards everything (logger.go:26-37)."""
+
+    def __init__(self) -> None:
+        super().__init__("production", stream=None)  # type: ignore[arg-type]
+
+    def _emit(self, level: str, msg: str, args: tuple[Any, ...]) -> None:
+        pass
+
+
+def new_logger(environment: str = "production", stream: TextIO | None = None) -> Logger:
+    """Build a logger; auto-noop under pytest unless a stream is forced
+    (logger.go:49-57)."""
+    if stream is None and _is_test_mode():
+        return NoopLogger()
+    return Logger(environment, stream)

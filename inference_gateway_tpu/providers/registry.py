@@ -1,0 +1,119 @@
+"""Provider registry.
+
+Capability parity with reference providers/registry/registry.go:14-242: a
+static table of provider configurations (ID, display name, base URL, auth
+type, vision flag, extra headers, endpoints) plus ``BuildProvider`` which
+validates token presence before constructing a provider instance.
+
+The new ``tpu`` entry is a first-class local-runtime provider (auth
+``none``, like ollama/llamacpp in registry.go:143-208) whose upstream is
+the in-repo JAX serving sidecar.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from inference_gateway_tpu.providers import constants
+
+
+@dataclass
+class Endpoints:
+    models: str
+    chat: str
+
+
+@dataclass
+class ProviderConfig:
+    """One provider's static + env-resolved configuration
+    (reference registry.go:15-24)."""
+
+    id: str
+    name: str
+    url: str
+    token: str = ""
+    auth_type: str = constants.AUTH_TYPE_BEARER
+    supports_vision: bool = False
+    extra_headers: dict[str, list[str]] = field(default_factory=dict)
+    endpoints: Endpoints = field(default_factory=lambda: Endpoints("/models", "/chat/completions"))
+
+    def copy(self) -> "ProviderConfig":
+        return replace(
+            self,
+            extra_headers={k: list(v) for k, v in self.extra_headers.items()},
+            endpoints=Endpoints(self.endpoints.models, self.endpoints.chat),
+        )
+
+
+def _cfg(pid: str, auth_type: str, vision: bool, extra: dict[str, list[str]] | None = None) -> ProviderConfig:
+    models, chat = constants.ENDPOINTS[pid]
+    return ProviderConfig(
+        id=pid,
+        name=constants.DISPLAY_NAMES[pid],
+        url=constants.DEFAULT_BASE_URLS[pid],
+        auth_type=auth_type,
+        supports_vision=vision,
+        extra_headers=extra or {},
+        endpoints=Endpoints(models, chat),
+    )
+
+
+# Static registry (reference registry.go:73-242). Auth types and vision
+# flags match the reference table; `tpu` is new.
+REGISTRY: dict[str, ProviderConfig] = {
+    constants.ANTHROPIC_ID: _cfg(
+        constants.ANTHROPIC_ID,
+        constants.AUTH_TYPE_XHEADER,
+        True,
+        {"anthropic-version": ["2023-06-01"]},
+    ),
+    constants.CLOUDFLARE_ID: _cfg(constants.CLOUDFLARE_ID, constants.AUTH_TYPE_BEARER, False),
+    constants.COHERE_ID: _cfg(constants.COHERE_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.DEEPSEEK_ID: _cfg(constants.DEEPSEEK_ID, constants.AUTH_TYPE_BEARER, False),
+    constants.GOOGLE_ID: _cfg(constants.GOOGLE_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.GROQ_ID: _cfg(constants.GROQ_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.LLAMACPP_ID: _cfg(constants.LLAMACPP_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.MINIMAX_ID: _cfg(constants.MINIMAX_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.MISTRAL_ID: _cfg(constants.MISTRAL_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.MOONSHOT_ID: _cfg(constants.MOONSHOT_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.NVIDIA_ID: _cfg(constants.NVIDIA_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.OLLAMA_ID: _cfg(constants.OLLAMA_ID, constants.AUTH_TYPE_NONE, True),
+    constants.OLLAMA_CLOUD_ID: _cfg(constants.OLLAMA_CLOUD_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.OPENAI_ID: _cfg(constants.OPENAI_ID, constants.AUTH_TYPE_BEARER, True),
+    constants.ZAI_ID: _cfg(constants.ZAI_ID, constants.AUTH_TYPE_BEARER, True),
+    # New: the TPU serving sidecar. Local runtime, no auth, vision-capable
+    # (the sidecar gates per-model), runtime metadata endpoint like
+    # llama.cpp's /props (SURVEY.md §7).
+    constants.TPU_ID: _cfg(constants.TPU_ID, constants.AUTH_TYPE_NONE, True),
+}
+
+
+class ProviderNotFoundError(KeyError):
+    pass
+
+
+class ProviderNotConfiguredError(ValueError):
+    pass
+
+
+class ProviderRegistry:
+    """Runtime registry bound to resolved config
+    (reference registry.go:32-70)."""
+
+    def __init__(self, cfg: dict[str, ProviderConfig], logger=None) -> None:
+        self._cfg = cfg
+        self._logger = logger
+
+    def get_providers(self) -> dict[str, ProviderConfig]:
+        return self._cfg
+
+    def build_provider(self, provider_id: str, client):
+        # Import here to avoid a cycle: core imports registry types.
+        from inference_gateway_tpu.providers.core import Provider
+
+        cfg = self._cfg.get(provider_id)
+        if cfg is None:
+            raise ProviderNotFoundError(f"provider {provider_id} not found")
+        if cfg.auth_type != constants.AUTH_TYPE_NONE and not cfg.token:
+            raise ProviderNotConfiguredError(f"provider {provider_id} token not configured")
+        return Provider(cfg, client, logger=self._logger)

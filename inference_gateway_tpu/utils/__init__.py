@@ -1,0 +1,3 @@
+from inference_gateway_tpu.utils.durations import format_duration, parse_duration
+
+__all__ = ["parse_duration", "format_duration"]

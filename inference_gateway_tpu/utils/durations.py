@@ -1,0 +1,73 @@
+"""Go-style duration strings.
+
+The reference configures every timeout as a Go ``time.Duration`` env value
+("5s", "30s", "120s", "1m30s"; reference: config/config.go:61-75, 90-92).
+We keep the same wire format so every documented env var keeps working,
+parsed into float seconds.
+"""
+
+from __future__ import annotations
+
+import re
+
+_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+_PART = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+
+
+def parse_duration(value: str | float | int) -> float:
+    """Parse a Go duration string (e.g. "1m30s") into seconds."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = value.strip()
+    if not s:
+        raise ValueError("empty duration")
+    if s in ("0", "+0", "-0"):
+        return 0.0
+    neg = s.startswith("-")
+    if s[0] in "+-":
+        s = s[1:]
+    # Bare numbers are treated as seconds (lenient extension for operators).
+    if re.fullmatch(r"\d+(\.\d+)?", s):
+        total = float(s)
+    else:
+        total = 0.0
+        pos = 0
+        for m in _PART.finditer(s):
+            if m.start() != pos:
+                raise ValueError(f"invalid duration {value!r}")
+            total += float(m.group(1)) * _UNITS[m.group(2)]
+            pos = m.end()
+        if pos != len(s):
+            raise ValueError(f"invalid duration {value!r}")
+    return -total if neg else total
+
+
+def format_duration(seconds: float) -> str:
+    """Format seconds into a compact Go-style duration string."""
+    if seconds == 0:
+        return "0s"
+    neg = seconds < 0
+    s = abs(seconds)
+    parts = []
+    for unit, size in (("h", 3600.0), ("m", 60.0)):
+        if s >= size:
+            n = int(s // size)
+            parts.append(f"{n}{unit}")
+            s -= n * size
+    if s:
+        if s >= 1:
+            text = f"{s:.9f}".rstrip("0").rstrip(".")
+            parts.append(f"{text}s")
+        else:
+            parts.append(f"{s * 1000:g}ms")
+    out = "".join(parts)
+    return f"-{out}" if neg else out

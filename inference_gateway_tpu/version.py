@@ -1,0 +1,4 @@
+"""Application identity (reference: config/meta.go)."""
+
+APPLICATION_NAME = "inference-gateway-tpu"
+VERSION = "0.1.0"

@@ -1,0 +1,17 @@
+"""Test bootstrap.
+
+Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported so
+multi-chip sharding paths (dp/tp/sp/ep meshes) compile and execute in CI
+without TPU hardware (SURVEY.md §7: test sharding on a virtual 8-device
+CPU mesh).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,95 @@
+"""Config surface tests (reference: config/config_test.go)."""
+
+from inference_gateway_tpu.config import Config
+from inference_gateway_tpu.providers import constants
+from inference_gateway_tpu.utils.durations import format_duration, parse_duration
+
+
+def test_defaults():
+    cfg = Config.load({})
+    assert cfg.environment == "production"
+    assert cfg.server.port == "8080"
+    assert cfg.server.read_timeout == 30.0
+    assert cfg.server.idle_timeout == 120.0
+    assert cfg.telemetry.enable is False
+    assert cfg.telemetry.metrics_port == "9464"
+    assert cfg.mcp.enable is False
+    assert cfg.mcp.request_timeout == 5.0
+    assert cfg.mcp.polling_interval == 30.0
+    assert cfg.auth.enable is False
+    assert cfg.routing.enabled is False
+    assert cfg.client.timeout == 30.0
+    assert not cfg.enable_vision
+
+
+def test_all_providers_present_with_defaults():
+    cfg = Config.load({})
+    assert set(cfg.providers) == set(constants.ALL_PROVIDER_IDS)
+    assert len(cfg.providers) == 16  # 15 reference providers + tpu
+    assert cfg.providers["ollama"].auth_type == "none"
+    assert cfg.providers["tpu"].auth_type == "none"
+    assert cfg.providers["anthropic"].auth_type == "xheader"
+    assert cfg.providers["anthropic"].extra_headers["anthropic-version"] == ["2023-06-01"]
+
+
+def test_provider_env_overrides():
+    cfg = Config.load(
+        {
+            "OPENAI_API_KEY": "sk-test",
+            "OPENAI_API_URL": "http://fake:1234/v1",
+            "TPU_API_URL": "http://sidecar:8000/v1",
+        }
+    )
+    assert cfg.providers["openai"].token == "sk-test"
+    assert cfg.providers["openai"].url == "http://fake:1234/v1"
+    assert cfg.providers["tpu"].url == "http://sidecar:8000/v1"
+    # Defaults untouched for others.
+    assert cfg.providers["groq"].url == constants.DEFAULT_BASE_URLS["groq"]
+
+
+def test_env_var_surface():
+    cfg = Config.load(
+        {
+            "ENVIRONMENT": "development",
+            "ALLOWED_MODELS": "a,b",
+            "ENABLE_VISION": "true",
+            "TELEMETRY_ENABLE": "true",
+            "TELEMETRY_METRICS_PORT": "9999",
+            "MCP_ENABLE": "true",
+            "MCP_SERVERS": "http://mcp1:3000/mcp,http://mcp2:3000/mcp",
+            "MCP_CLIENT_TIMEOUT": "10s",
+            "AUTH_ENABLE": "true",
+            "SERVER_WRITE_TIMEOUT": "1m30s",
+            "ROUTING_ENABLED": "true",
+            "ROUTING_CONFIG_PATH": "/etc/pools.yaml",
+        }
+    )
+    assert cfg.environment == "development"
+    assert cfg.allowed_models == "a,b"
+    assert cfg.enable_vision
+    assert cfg.telemetry.enable
+    assert cfg.telemetry.metrics_port == "9999"
+    assert cfg.mcp.enable
+    assert cfg.mcp.servers.count(",") == 1
+    assert cfg.mcp.client_timeout == 10.0
+    assert cfg.auth.enable
+    assert cfg.server.write_timeout == 90.0
+    assert cfg.routing.enabled
+    assert cfg.routing.config_path == "/etc/pools.yaml"
+
+
+def test_duration_parsing():
+    assert parse_duration("5s") == 5.0
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration("100ms") == 0.1
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("1.5s") == 1.5
+    assert format_duration(90) == "1m30s"
+    assert format_duration(0.1) == "100ms"
+    assert format_duration(0) == "0s"
+
+
+def test_logger_noop_under_pytest():
+    from inference_gateway_tpu.logger import NoopLogger, new_logger
+
+    assert isinstance(new_logger("production"), NoopLogger)
