@@ -1,0 +1,78 @@
+"""HuggingFace checkpoint conversion.
+
+Converts a transformers Llama/Mixtral state dict (torch CPU tensors or
+numpy arrays) into this framework's stacked-layer JAX pytrees, and derives
+our config from an HF config object. Used both for loading real
+checkpoints into the serving engine and for numerics parity tests against
+the reference implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from inference_gateway_tpu.models.llama import LlamaConfig
+
+
+def _to_np(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def llama_config_from_hf(hf_cfg: Any) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
+        intermediate_size=hf_cfg.intermediate_size,
+        head_dim=getattr(hf_cfg, "head_dim", None),
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        rms_norm_eps=hf_cfg.rms_norm_eps,
+        max_position_embeddings=hf_cfg.max_position_embeddings,
+        tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        rope_scaling=getattr(hf_cfg, "rope_scaling", None),
+    )
+
+
+def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig, dtype=jnp.bfloat16):
+    """Map HF `model.*` tensors into our stacked pytree.
+
+    HF Linear weights are (out, in); ours are (in, out) so activations
+    right-multiply. Head-major reshapes line up because HF projects heads
+    contiguously on the out axis.
+    """
+    L = cfg.num_layers
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def get(name: str) -> np.ndarray:
+        return _to_np(sd[name])
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        mats = [get(fmt.format(i)) for i in range(L)]
+        arr = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(arr, dtype)
+
+    params = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "layers": {
+            "attn_norm": stack("layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stack("layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("layers.{}.post_attention_layernorm.weight", transpose=False),
+            "wg": stack("layers.{}.mlp.gate_proj.weight"),
+            "wu": stack("layers.{}.mlp.up_proj.weight"),
+            "wd": stack("layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(get("norm.weight"), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_to_np(sd["lm_head.weight"]).T, dtype)
+    return params

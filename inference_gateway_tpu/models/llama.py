@@ -1,0 +1,247 @@
+"""Llama-family decoder (TinyLlama, Llama-2, Llama-3/3.1) in pure JAX.
+
+TPU-first design decisions:
+- Parameters are a flat pytree of arrays with layers **stacked** on a
+  leading axis, walked with ``lax.scan`` — one trace regardless of depth,
+  fast compiles, and sharding annotations apply uniformly to every layer.
+- One jitted ``forward`` serves prefill (T = padded prompt bucket) and
+  decode (T = 1) against a contiguous KV cache with static shapes; ragged
+  batches are handled by masks, never by dynamic shapes.
+- bf16 weights/activations, fp32 softmax/norm statistics, fp32 matmul
+  accumulation (``preferred_element_type``) — the MXU recipe.
+
+This is the serving model behind the ``tpu`` provider (the capability the
+reference delegates to Ollama/llama.cpp upstreams,
+reference providers/registry/registry.go:143-208).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from inference_gateway_tpu.ops.attention import causal_prefill_mask, decode_mask, gqa_attend
+from inference_gateway_tpu.ops.norms import rms_norm
+from inference_gateway_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    intermediate_size: int = 5632
+    head_dim: int | None = None
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    # Stored as a hashable tuple of (key, value) pairs so the config can be
+    # a jit static argument; accepts a dict at construction.
+    rope_scaling: Any = None
+
+    def __post_init__(self):
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(self, "rope_scaling", tuple(sorted(self.rope_scaling.items())))
+
+    @property
+    def rope_scaling_dict(self) -> dict | None:
+        return dict(self.rope_scaling) if self.rope_scaling else None
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+
+Params = dict[str, Any]
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
+    """Random init (normal 0.02). Layers stacked on axis 0."""
+    L, H, I, V = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    keys = jax.random.split(rng, 8)
+
+    def norm(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+
+    params: Params = {
+        "embed": norm(keys[0], (V, H)),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": norm(keys[1], (L, H, Hq * D)),
+            "wk": norm(keys[2], (L, H, Hkv * D)),
+            "wv": norm(keys[3], (L, H, Hkv * D)),
+            "wo": norm(keys[4], (L, Hq * D, H)),
+            "mlp_norm": jnp.ones((L, H), dtype),
+            "wg": norm(keys[5], (L, H, I)),
+            "wu": norm(keys[6], (L, H, I)),
+            "wd": norm(keys[7], (L, I, H)),
+        },
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(jax.random.fold_in(rng, 99), (H, V))
+    return params
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> Params:
+    """Contiguous KV cache: k/v of shape (L, B, S, Hkv, D)."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _layer(
+    x: jnp.ndarray,  # (B, T, H)
+    lp: Params,  # this layer's params, leading L axis removed
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    k_cache: jnp.ndarray | None,  # (B, S, Hkv, D)
+    v_cache: jnp.ndarray | None,
+    scatter_pos: jnp.ndarray | None,  # (B, T) int32 write indices (S = drop)
+    mask: jnp.ndarray,  # prefill: (B,T,T); decode: (B,T,S)
+    cfg: LlamaConfig,
+    decode: bool,
+):
+    B, T, H = x.shape
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, Hq, D)
+    k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
+    v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_k_cache = new_v_cache = None
+    if k_cache is not None:
+        b_idx = jnp.arange(B)[:, None]
+        new_k_cache = k_cache.at[b_idx, scatter_pos].set(k.astype(k_cache.dtype), mode="drop")
+        new_v_cache = v_cache.at[b_idx, scatter_pos].set(v.astype(v_cache.dtype), mode="drop")
+
+    if decode:
+        attn = gqa_attend(q, new_k_cache.astype(q.dtype), new_v_cache.astype(q.dtype), mask)
+    else:
+        attn = gqa_attend(q, k, v, mask)
+    x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+    return x, new_k_cache, new_v_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only"))
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # (B, T) int32
+    positions: jnp.ndarray,  # (B, T) int32 absolute positions
+    lengths: jnp.ndarray,  # (B,) valid length: prefill = prompt len; decode = cache len incl. this token
+    cache: Params | None = None,
+    mode: str = "prefill",  # "prefill" | "decode"
+    last_only: bool = False,
+) -> tuple[jnp.ndarray, Params | None]:
+    """Run the decoder. Returns (logits, updated_cache).
+
+    prefill: queries attend to this call's keys only (fresh requests);
+             cache (if given) is written at ``positions``.
+    decode:  T must be 1; attends to the whole cache masked to ``lengths``.
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens]  # (B, T, H)
+    inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    if mode == "decode":
+        assert cache is not None
+        S = cache["k"].shape[2]
+        mask = decode_mask(S, lengths)
+        scatter_pos = positions
+    else:
+        valid = jnp.arange(T)[None, :] < lengths[:, None]
+        mask = causal_prefill_mask(positions, lengths)
+        if cache is not None:
+            S = cache["k"].shape[2]
+            scatter_pos = jnp.where(valid, positions, S)  # S = out of bounds -> drop
+        else:
+            scatter_pos = None
+
+    decode = mode == "decode"
+
+    if cache is not None:
+        def body(x, per_layer):
+            lp, kc, vc = per_layer
+            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, scatter_pos, mask, cfg, decode)
+            return x, (nk, nv)
+
+        x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": new_k, "v": new_v}
+    else:
+        def body(x, lp):
+            x, _, _ = _layer(x, lp, cos, sin, None, None, None, mask, cfg, decode)
+            return x, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if last_only:
+        idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
+        x = x[jnp.arange(B), idx]  # (B, H)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits, new_cache
+
+
+def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray, targets: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross-entropy over valid positions (training path used by
+    the multi-chip dry run)."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    logits, _ = forward(params, cfg, tokens, positions, lengths, mode="prefill")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    valid = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Presets
+# ---------------------------------------------------------------------------
+
+PRESETS: dict[str, LlamaConfig] = {
+    "test-tiny": LlamaConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        intermediate_size=128, max_position_embeddings=512,
+    ),
+    "tinyllama-1.1b": LlamaConfig(
+        vocab_size=32000, hidden_size=2048, num_layers=22, num_heads=32, num_kv_heads=4,
+        intermediate_size=5632, max_position_embeddings=2048,
+    ),
+    "llama-2-7b": LlamaConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=32,
+        intermediate_size=11008, max_position_embeddings=4096,
+    ),
+    "llama-3-8b": LlamaConfig(
+        vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,
+        intermediate_size=14336, rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+    "llama-3.1-8b": LlamaConfig(
+        vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,
+        intermediate_size=14336, rope_theta=500000.0, max_position_embeddings=131072,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+        },
+    ),
+    "llama-3-70b": LlamaConfig(
+        vocab_size=128256, hidden_size=8192, num_layers=80, num_heads=64, num_kv_heads=8,
+        intermediate_size=28672, rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+}

@@ -1,0 +1,66 @@
+"""Attention ops: batched GQA attention over a contiguous KV cache.
+
+The baseline (XLA-fused einsum) attention path. It is written so the same
+jitted function serves both phases of serving:
+
+- prefill: T = prompt length (padded to a bucket), cache written at
+  positions [0, T)
+- decode: T = 1, cache appended at position ``lengths``
+
+Softmax statistics in fp32, matmuls in the input dtype (bf16 on TPU) with
+fp32 accumulation via ``preferred_element_type`` — this keeps the MXU fed.
+A Pallas ragged paged-attention kernel (ops/paged_attention.py) replaces
+the decode path on TPU for paged caches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gqa_attend(
+    q: jnp.ndarray,  # (B, T, Hq, D)
+    k: jnp.ndarray,  # (B, S, Hkv, D)
+    v: jnp.ndarray,  # (B, S, Hkv, D)
+    mask: jnp.ndarray,  # (B, T, S) bool — True = attend
+) -> jnp.ndarray:
+    """Grouped-query attention. Returns (B, T, Hq, D)."""
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+    scale = D ** -0.5
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return out.reshape(B, T, Hq, D).astype(q.dtype)
+
+
+def causal_prefill_mask(positions: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Causal mask for prefill on padded batches.
+
+    positions: (B, T) absolute positions of the query tokens.
+    lengths:   (B,) valid prompt length per row.
+    Returns (B, T, T) bool where key j is visible to query i iff
+    j_pos <= i_pos and j_pos < length.
+    """
+    key_pos = positions  # keys share positions with queries during prefill
+    causal = key_pos[:, None, :] <= positions[:, :, None]
+    valid = key_pos[:, None, :] < lengths[:, None, None]
+    return causal & valid
+
+
+def decode_mask(cache_len: int, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Mask for single-token decode against a cache of static size S.
+
+    lengths: (B,) number of valid entries in the cache *including* the
+    token being decoded (i.e. attend to [0, lengths)).
+    Returns (B, 1, S) bool.
+    """
+    span = jnp.arange(cache_len)
+    return (span[None, None, :] < lengths[:, None, None])

@@ -1,0 +1,18 @@
+"""Normalization ops.
+
+RMSNorm in fp32 math with cast back to the input dtype — the standard
+TPU-safe recipe (bf16 activations, fp32 statistics). XLA fuses this into
+neighbouring ops; no kernel needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,55 @@
+"""Rotary position embeddings (RoPE), Llama conventions.
+
+Half-split rotate convention (matches HF Llama numerics), with optional
+Llama-3.1 frequency scaling. Computed on the fly from positions so decode
+steps and ragged prefill share one code path; everything is jit-traceable
+with static shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_inv_freq(head_dim: int, theta: float, scaling: dict | None = None) -> jnp.ndarray:
+    """Inverse frequencies (head_dim//2,), optionally Llama-3.1-scaled.
+
+    ``scaling`` mirrors HF's ``rope_scaling`` dict for rope_type="llama3":
+    factor, low_freq_factor, high_freq_factor, original_max_position_embeddings.
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = scaling["factor"]
+        low = scaling["low_freq_factor"]
+        high = scaling["high_freq_factor"]
+        old_len = scaling["original_max_position_embeddings"]
+        wavelen = 2 * jnp.pi / inv_freq
+        # Three bands: keep high-freq, scale low-freq by 1/factor, smooth in between.
+        smooth = (old_len / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        inv_freq = (1 - smooth) * scaled + smooth * inv_freq
+    return inv_freq
+
+
+def rope_cos_sin(positions: jnp.ndarray, inv_freq: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for integer positions.
+
+    positions: (..., T) int32 -> cos, sin of shape (..., T, head_dim).
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., T, D/2)
+    angles = jnp.concatenate([angles, angles], axis=-1)  # (..., T, D)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply RoPE to (..., T, H, D) given cos/sin of shape (..., T, D)."""
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    out = x.astype(jnp.float32) * cos + _rotate_half(x.astype(jnp.float32)) * sin
+    return out.astype(x.dtype)

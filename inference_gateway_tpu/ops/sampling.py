@@ -1,0 +1,56 @@
+"""On-device token sampling.
+
+Vectorized over the batch with *per-row* temperature and top-p so a
+continuous batch can mix greedy and sampled requests in one jitted decode
+step (no per-request recompiles). top_k is a static cap applied before
+top-p to bound the sort cost on the vocab axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+GREEDY_EPS = 1e-4
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # (B, V) fp32
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # (B,)
+    top_p: jnp.ndarray,  # (B,)
+    top_k: int = 0,  # static; 0 = disabled
+) -> jnp.ndarray:
+    """Sample one token per row; temperature <= GREEDY_EPS means argmax."""
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, GREEDY_EPS)[:, None]
+    scaled = logits / temp
+
+    if top_k and top_k < V:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted probs whose
+    # cumulative mass reaches top_p; always keep the argmax.
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep_sorted = cum - sorted_probs < top_p[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(B)[:, None], sort_idx
+    ].set(keep_sorted)
+    filtered = jnp.where(keep, scaled, -jnp.inf)
+
+    sampled_tok = jax.random.categorical(rng, filtered, axis=-1)
+    return jnp.where(temperature <= GREEDY_EPS, greedy_tok, sampled_tok)
+
+
+def compute_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Log-probability of chosen tokens: logits (B, V), tokens (B,)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]

@@ -9,9 +9,17 @@ CPU mesh).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The container's sitecustomize imports jax at interpreter startup (before
+# this conftest), so the env vars above are too late for jax.config — force
+# the platform through the config API instead.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
