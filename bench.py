@@ -1,0 +1,91 @@
+"""Benchmark: batched decode throughput of the TPU serving engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: decode tokens/sec/chip on TinyLlama-1.1B shapes (bf16) with a
+continuously-batched decode step. "vs_baseline" is the speedup over
+single-stream decode (batch=1) — the serving model of the reference
+gateway's naive upstream (one request at a time through the proxy); our
+continuous-batching engine must win by saturating the MXU with batched
+GEMMs. (Reference publishes no absolute perf numbers — BASELINE.md.)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from inference_gateway_tpu.models import llama
+
+
+def _decode_tps(cfg, params, batch: int, cache_len: int, steps: int) -> float:
+    cache = llama.init_cache(cfg, batch, cache_len, dtype=jnp.bfloat16)
+    B = batch
+    rng = np.random.default_rng(0)
+    prompt_len = 64
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt_len)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (B, prompt_len))
+    logits, cache = llama.forward(
+        params, cfg, tokens, positions, jnp.full((B,), prompt_len, jnp.int32), cache,
+        mode="prefill", last_only=True,
+    )
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+
+    def step(tok, cache, pos):
+        step_logits, cache = llama.forward(
+            params, cfg, tok, pos, pos[:, 0] + 1, cache, mode="decode",
+        )
+        nxt = jnp.argmax(step_logits[:, 0], axis=-1).astype(jnp.int32)[:, None]
+        return nxt, cache
+
+    # Warmup (compile).
+    pos = jnp.full((B, 1), prompt_len, jnp.int32)
+    t, c = step(tok, cache, pos)
+    jax.block_until_ready(t)
+
+    start = time.perf_counter()
+    tok_i, cache_i = tok, cache
+    for i in range(steps):
+        pos = jnp.full((B, 1), prompt_len + i, jnp.int32)
+        tok_i, cache_i = step(tok_i, cache_i, pos)
+    jax.block_until_ready(tok_i)
+    elapsed = time.perf_counter() - start
+    return (steps * B) / elapsed
+
+
+def main() -> None:
+    cfg = llama.PRESETS["tinyllama-1.1b"]
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    jax.block_until_ready(params)
+
+    batched = _decode_tps(cfg, params, batch=64, cache_len=512, steps=64)
+    single = _decode_tps(cfg, params, batch=1, cache_len=512, steps=64)
+
+    n_chips = max(len(jax.devices()), 1)
+    value = batched / n_chips
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(batched / max(single, 1e-9), 2),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(json.dumps({
+            "metric": "decode_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)

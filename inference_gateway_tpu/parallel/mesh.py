@@ -1,0 +1,61 @@
+"""Device mesh construction.
+
+The framework's parallelism axes (SURVEY.md §2.4, TPU-rebuild column):
+
+- ``dp``: data parallel — replicate weights, shard the batch.
+- ``sp``: sequence/context parallel — shard long sequences (ring
+  attention rides ICI neighbours on this axis).
+- ``tp``: tensor parallel — shard attention heads / MLP hidden.
+- ``ep``: expert parallel — shard MoE experts (Mixtral); laid out on the
+  same physical axis as ``tp`` unless a dedicated axis is requested.
+
+Meshes are plain ``jax.sharding.Mesh`` objects over ``mesh_utils``-ordered
+devices so ICI-neighbour axes get ICI bandwidth; multi-host pods extend
+the same mesh over DCN via ``jax.distributed`` with no code change.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+def create_mesh(
+    dp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices=None,
+    axis_names: tuple[str, ...] = AXES,
+) -> Mesh:
+    """Build a (dp, sp, tp) mesh over the given (or all) devices."""
+    shape = (dp, sp, tp)
+    n = math.prod(shape)
+    if devices is None:
+        devices = jax.devices()[:n]
+    if len(devices) != n:
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    dev_array = mesh_utils.create_device_mesh(shape, devices=devices, allow_split_physical_axes=True)
+    return Mesh(dev_array, axis_names)
+
+
+def default_mesh_shape(n_devices: int, max_tp: int = 8) -> tuple[int, int, int]:
+    """Factor a device count into (dp, sp, tp).
+
+    Prefers tensor parallelism on the innermost (ICI-fastest) axis, then a
+    2-way sequence-parallel axis when it divides out, data parallel with
+    the rest — a sane default for dense decoder serving.
+    """
+    tp = 1
+    for cand in (max_tp, 4, 2):
+        if cand <= n_devices and n_devices % cand == 0:
+            tp = cand
+            break
+    rem = n_devices // tp
+    sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    return dp, sp, tp

@@ -1,0 +1,78 @@
+"""Sharding rules: map model pytrees onto the mesh.
+
+Megatron-style tensor parallel layout for the Llama pytree
+(models/llama.py): QKV and gate/up projections are column-sharded on
+``tp``; the output and down projections are row-sharded on the
+contraction axis so XLA inserts a single ``psum`` (reduce-scatter when
+profitable) per block. Embedding/LM head are vocab-sharded. KV caches
+shard heads on ``tp`` and batch on ``dp``. XLA's SPMD partitioner derives
+every collective from these annotations — nothing is hand-scheduled.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from inference_gateway_tpu.models.llama import LlamaConfig
+
+
+def llama_param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpec pytree matching init_params' structure."""
+    specs = {
+        "embed": P("tp", None),  # vocab-sharded
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "wg": P(None, None, "tp"),
+            "wu": P(None, None, "tp"),
+            "wd": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def llama_cache_specs() -> dict:
+    """KV cache (L, B, S, Hkv, D): batch on dp, kv heads on tp."""
+    return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}
+
+
+def batch_spec() -> P:
+    """Activations/token batches: (B, T, ...) → B on dp, T on sp."""
+    return P("dp", "sp")
+
+
+def named(mesh: Mesh, spec_tree):
+    """PartitionSpec pytree → NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params, mesh: Mesh, specs) -> dict:
+    """Device-put an existing pytree onto the mesh per the spec tree."""
+    shardings = named(mesh, specs)
+    return jax.device_put(params, shardings)
+
+
+def check_divisibility(cfg: LlamaConfig, mesh: Mesh) -> None:
+    """Fail fast when the model doesn't tile onto the mesh."""
+    tp = mesh.shape.get("tp", 1)
+    for name, dim in (
+        ("num_heads", cfg.num_heads),
+        ("num_kv_heads", cfg.num_kv_heads),
+        ("intermediate_size", cfg.intermediate_size),
+        ("vocab_size", cfg.vocab_size),
+    ):
+        if dim % tp != 0:
+            raise ValueError(f"{name}={dim} not divisible by tp={tp}")

@@ -1,0 +1,54 @@
+"""Sharded training step.
+
+The serving framework's models are trainable (fine-tuning path) — this
+module provides a pjit-style train step over a (dp, sp, tp) mesh: data
+parallel on the batch, sequence parallel on tokens, tensor parallel on the
+weights. XLA derives the gradient psums/reduce-scatters from the same
+NamedShardings used for inference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from inference_gateway_tpu.models import llama
+from inference_gateway_tpu.parallel.sharding import llama_param_specs, named
+
+
+def make_train_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh, learning_rate: float = 1e-3, dtype=jnp.float32):
+    """Sharded params + AdamW optimizer state on the mesh."""
+    specs = llama_param_specs(cfg)
+    shardings = named(mesh, specs)
+    params = jax.jit(
+        lambda k: llama.init_params(k, cfg, dtype=dtype), out_shardings=shardings
+    )(rng)
+    tx = optax.adamw(learning_rate)
+    opt_state = jax.jit(tx.init)(params)
+    return params, tx, opt_state
+
+
+def make_train_step(cfg: llama.LlamaConfig, tx: optax.GradientTransformation, mesh: Mesh):
+    """One jitted SPMD training step: loss, grads, AdamW update."""
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+    len_sharding = NamedSharding(mesh, P("dp"))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens, targets, lengths):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(params, cfg, tokens, targets, lengths)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def step(params, opt_state, tokens, targets, lengths):
+        tokens = jax.device_put(tokens, batch_sharding)
+        targets = jax.device_put(targets, batch_sharding)
+        lengths = jax.device_put(lengths, len_sharding)
+        return train_step(params, opt_state, tokens, targets, lengths)
+
+    return step

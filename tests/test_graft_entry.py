@@ -1,0 +1,18 @@
+"""Driver-contract tests: dryrun_multichip on the virtual CPU mesh."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(2)

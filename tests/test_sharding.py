@@ -1,0 +1,77 @@
+"""Multi-device sharding tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from inference_gateway_tpu.models.llama import LlamaConfig, forward, init_cache, init_params
+from inference_gateway_tpu.parallel.mesh import create_mesh, default_mesh_shape
+from inference_gateway_tpu.parallel.sharding import (
+    check_divisibility,
+    llama_cache_specs,
+    llama_param_specs,
+    named,
+    shard_params,
+)
+
+CFG = LlamaConfig(
+    vocab_size=256, hidden_size=64, num_layers=2, num_heads=8, num_kv_heads=4,
+    intermediate_size=128, max_position_embeddings=256,
+)
+
+
+def test_eight_cpu_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_default_mesh_shape():
+    assert default_mesh_shape(8) == (1, 1, 8)
+    assert default_mesh_shape(16, max_tp=8) == (1, 2, 8)
+    assert default_mesh_shape(1) == (1, 1, 1)
+    assert default_mesh_shape(2) == (1, 1, 2)
+
+
+def test_tp_sharded_forward_matches_single_device():
+    mesh = create_mesh(dp=2, sp=1, tp=4)
+    check_divisibility(CFG, mesh)
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+
+    B, T = 4, 8
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 256, (B, T)))
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    lengths = jnp.full((B,), T)
+
+    ref, _ = forward(params, CFG, tokens, positions, lengths, mode="prefill")
+
+    sharded = shard_params(params, mesh, llama_param_specs(CFG))
+    with jax.sharding.set_mesh(mesh):
+        out, _ = forward(sharded, CFG, tokens, positions, lengths, mode="prefill")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_decode_with_cache():
+    mesh = create_mesh(dp=2, sp=1, tp=4)
+    params = shard_params(
+        init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32), mesh, llama_param_specs(CFG)
+    )
+    B, S = 4, 32
+    cache = jax.device_put(init_cache(CFG, B, S, dtype=jnp.float32), named(mesh, llama_cache_specs()))
+
+    tokens = jnp.asarray(np.random.default_rng(1).integers(0, 256, (B, 6)))
+    positions = jnp.broadcast_to(jnp.arange(6), (B, 6))
+    with jax.sharding.set_mesh(mesh):
+        _, cache = forward(params, CFG, tokens, positions, jnp.full((B,), 6), cache, mode="prefill")
+        step_logits, cache = forward(
+            params, CFG, tokens[:, :1], jnp.full((B, 1), 6), jnp.full((B,), 7), cache, mode="decode"
+        )
+    assert step_logits.shape == (B, 1, 256)
+    assert not np.any(np.isnan(np.asarray(step_logits)))
+
+
+def test_divisibility_guard():
+    import pytest
+
+    mesh = create_mesh(dp=1, sp=1, tp=8)
+    bad = LlamaConfig(num_heads=4, num_kv_heads=2, hidden_size=64, intermediate_size=128, vocab_size=256, num_layers=1)
+    with pytest.raises(ValueError):
+        check_divisibility(bad, mesh)
