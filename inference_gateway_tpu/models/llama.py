@@ -102,8 +102,9 @@ def _layer(
     lp: Params,  # this layer's params, leading L axis removed
     cos: jnp.ndarray,
     sin: jnp.ndarray,
-    k_cache: jnp.ndarray | None,  # (B, S, Hkv, D)
+    k_cache: jnp.ndarray | None,  # (Slots, S, Hkv, D)
     v_cache: jnp.ndarray | None,
+    slot_ids: jnp.ndarray | None,  # (B,) cache rows written by this batch
     scatter_pos: jnp.ndarray | None,  # (B, T) int32 write indices (S = drop)
     mask: jnp.ndarray,  # prefill: (B,T,T); decode: (B,T,S)
     cfg: LlamaConfig,
@@ -121,9 +122,9 @@ def _layer(
 
     new_k_cache = new_v_cache = None
     if k_cache is not None:
-        b_idx = jnp.arange(B)[:, None]
-        new_k_cache = k_cache.at[b_idx, scatter_pos].set(k.astype(k_cache.dtype), mode="drop")
-        new_v_cache = v_cache.at[b_idx, scatter_pos].set(v.astype(v_cache.dtype), mode="drop")
+        rows = (jnp.arange(B) if slot_ids is None else slot_ids)[:, None]
+        new_k_cache = k_cache.at[rows, scatter_pos].set(k.astype(k_cache.dtype), mode="drop")
+        new_v_cache = v_cache.at[rows, scatter_pos].set(v.astype(v_cache.dtype), mode="drop")
 
     if decode:
         attn = gqa_attend(q, new_k_cache.astype(q.dtype), new_v_cache.astype(q.dtype), mask)
@@ -146,12 +147,16 @@ def forward(
     cache: Params | None = None,
     mode: str = "prefill",  # "prefill" | "decode"
     last_only: bool = False,
+    slot_ids: jnp.ndarray | None = None,  # (B,) cache rows for this batch
 ) -> tuple[jnp.ndarray, Params | None]:
     """Run the decoder. Returns (logits, updated_cache).
 
     prefill: queries attend to this call's keys only (fresh requests);
-             cache (if given) is written at ``positions``.
-    decode:  T must be 1; attends to the whole cache masked to ``lengths``.
+             cache (if given) is written at ``positions``. ``slot_ids``
+             maps batch rows onto cache rows so a small prefill batch can
+             write into a large slot cache (continuous batching).
+    decode:  T must be 1 and the batch must cover every cache row;
+             attends to the whole cache masked to ``lengths``.
     """
     B, T = tokens.shape
     x = params["embed"][tokens]  # (B, T, H)
@@ -177,14 +182,14 @@ def forward(
     if cache is not None:
         def body(x, per_layer):
             lp, kc, vc = per_layer
-            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, scatter_pos, mask, cfg, decode)
+            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, slot_ids, scatter_pos, mask, cfg, decode)
             return x, (nk, nv)
 
         x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
         new_cache = {"k": new_k, "v": new_v}
     else:
         def body(x, lp):
-            x, _, _ = _layer(x, lp, cos, sin, None, None, None, mask, cfg, decode)
+            x, _, _ = _layer(x, lp, cos, sin, None, None, None, None, mask, cfg, decode)
             return x, None
 
         x, _ = jax.lax.scan(body, x, params["layers"])

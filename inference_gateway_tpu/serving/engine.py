@@ -1,0 +1,232 @@
+"""The TPU model engine: jitted prefill/decode steps over a slot cache.
+
+Continuous-batching substrate (SURVEY.md §7 stage 5):
+
+- A fixed pool of ``max_slots`` cache rows (static shapes — XLA compiles
+  exactly one decode program and one prefill program per prompt bucket).
+- Prefill writes a small padded batch of fresh prompts into their slot
+  rows (``slot_ids`` scatter) and samples each prompt's first token.
+- Decode advances *all* slots every step (inactive rows are masked) and
+  samples with per-slot temperature/top-p, so heterogeneous requests
+  share one MXU-saturating batch.
+
+Weights/caches are bf16 by default, sharded over a (dp, sp, tp) mesh when
+more than one device is visible.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from inference_gateway_tpu.models import llama
+from inference_gateway_tpu.ops.sampling import compute_logprobs, sample_tokens
+from inference_gateway_tpu.parallel.mesh import create_mesh, default_mesh_shape
+from inference_gateway_tpu.parallel.sharding import (
+    check_divisibility,
+    llama_cache_specs,
+    llama_param_specs,
+    named,
+    shard_params,
+)
+from inference_gateway_tpu.serving.tokenizer import load_tokenizer
+
+
+@dataclass
+class EngineConfig:
+    model: str = "test-tiny"  # preset name (models/llama.py PRESETS) or HF path
+    tokenizer: str | None = None
+    max_slots: int = 8
+    max_seq_len: int = 512
+    prefill_buckets: tuple[int, ...] = (16, 32, 64, 128, 256, 512)
+    max_prefill_batch: int = 4
+    dtype: str = "bfloat16"
+    top_k: int = 64
+    seed: int = 0
+    use_mesh: bool = True  # shard over all visible devices when >1
+
+
+@dataclass
+class PrefillResult:
+    slot: int
+    first_token: int
+    logprob: float
+
+
+class Engine:
+    """Owns params, cache, and the two jitted step functions."""
+
+    def __init__(self, config: EngineConfig, params=None, model_cfg: llama.LlamaConfig | None = None):
+        self.config = config
+        self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+        if model_cfg is not None:
+            self.model_cfg = model_cfg
+        elif config.model in llama.PRESETS:
+            self.model_cfg = llama.PRESETS[config.model]
+        else:
+            self.model_cfg, params = self._load_hf(config.model)
+        self.tokenizer = load_tokenizer(config.tokenizer or (None if config.model in llama.PRESETS else config.model))
+
+        self.mesh = None
+        n_dev = len(jax.devices())
+        if config.use_mesh and n_dev > 1:
+            dp, sp, tp = default_mesh_shape(n_dev)
+            # tp must tile the model; degrade toward dp otherwise.
+            while tp > 1 and (self.model_cfg.num_kv_heads % tp or self.model_cfg.intermediate_size % tp):
+                tp //= 2
+            dp = n_dev // (sp * tp)
+            self.mesh = create_mesh(dp=dp, sp=sp, tp=tp)
+            check_divisibility(self.model_cfg, self.mesh)
+
+        if params is None:
+            params = llama.init_params(jax.random.PRNGKey(config.seed), self.model_cfg, dtype=self.dtype)
+        if self.mesh is not None:
+            params = shard_params(params, self.mesh, llama_param_specs(self.model_cfg))
+        self.params = params
+
+        cache = llama.init_cache(self.model_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
+        if self.mesh is not None:
+            # Slot axis stays replicated (slots are scheduled host-side);
+            # kv-heads shard on tp.
+            from jax.sharding import PartitionSpec as P
+
+            cache_specs = {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}
+            cache = jax.device_put(cache, named(self.mesh, cache_specs))
+        self.cache = cache
+
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        self._step_counter = 0
+        self._lock = threading.Lock()
+        # Serving metrics surfaced via the sidecar's /metrics endpoint.
+        self.metrics = {
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "decode_steps": 0,
+            "prefill_batches": 0,
+        }
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _load_hf(path: str):
+        """Load a local HF Llama checkpoint (no network)."""
+        import torch  # CPU-only wheel is in the image
+        from transformers import AutoConfig, AutoModelForCausalLM
+
+        from inference_gateway_tpu.models.hf_loader import llama_config_from_hf, llama_params_from_hf
+
+        hf_cfg = AutoConfig.from_pretrained(path)
+        cfg = llama_config_from_hf(hf_cfg)
+        with torch.no_grad():
+            model = AutoModelForCausalLM.from_pretrained(path, torch_dtype=torch.float32)
+        params = llama_params_from_hf(model.state_dict(), cfg, dtype=jnp.bfloat16)
+        del model
+        return cfg, params
+
+    # ------------------------------------------------------------------
+    def bucket_for(self, length: int) -> int:
+        for b in self.config.prefill_buckets:
+            if length <= b and b <= self.config.max_seq_len:
+                return b
+        raise ValueError(f"prompt of {length} tokens exceeds largest bucket")
+
+    def _next_rng(self) -> jax.Array:
+        self._step_counter += 1
+        return jax.random.fold_in(self._rng, self._step_counter)
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnames=("self",))
+    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
+        logits, cache = llama.forward(
+            params, self.model_cfg, tokens, positions, lengths, cache,
+            mode="prefill", last_only=True, slot_ids=slot_ids,
+        )
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _decode_fn(self, params, cache, tokens, positions, lengths, temps, top_ps, rng):
+        logits, cache = llama.forward(
+            params, self.model_cfg, tokens, positions, lengths, cache, mode="decode",
+        )
+        logits = logits[:, 0]
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    # ------------------------------------------------------------------
+    def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float], top_ps: list[float]) -> list[PrefillResult]:
+        """Prefill a batch of prompts into their slots; returns each
+        prompt's sampled first token. Pads to (max_prefill_batch, bucket)."""
+        assert prompts and len(prompts) == len(slots)
+        Bp = self.config.max_prefill_batch
+        assert len(prompts) <= Bp
+        bucket = self.bucket_for(max(len(p) for p in prompts))
+
+        tokens = np.zeros((Bp, bucket), np.int32)
+        lengths = np.zeros((Bp,), np.int32)
+        slot_arr = np.full((Bp,), self.config.max_slots, np.int32)  # OOB rows drop
+        t_arr = np.zeros((Bp,), np.float32)
+        p_arr = np.ones((Bp,), np.float32)
+        for i, (prompt, slot) in enumerate(zip(prompts, slots)):
+            tokens[i, : len(prompt)] = prompt
+            lengths[i] = len(prompt)
+            slot_arr[i] = slot
+            t_arr[i] = temps[i]
+            p_arr[i] = top_ps[i]
+        positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (Bp, bucket))
+
+        with self._lock:
+            toks, logprobs, self.cache = self._prefill_fn(
+                self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
+                jnp.asarray(p_arr), self._next_rng(),
+            )
+            self.metrics["prefill_tokens"] += int(lengths.sum())
+            self.metrics["prefill_batches"] += 1
+        toks = np.asarray(toks)
+        logprobs = np.asarray(logprobs)
+        return [PrefillResult(slot, int(toks[i]), float(logprobs[i])) for i, slot in enumerate(slots)]
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray, lengths: np.ndarray, temps: np.ndarray, top_ps: np.ndarray):
+        """One decode step for ALL slots.
+
+        tokens: (S,) pending token per slot; positions: (S,) write index;
+        lengths: (S,) attended span (0 = inactive). Returns (tokens,
+        logprobs) as numpy (S,).
+        """
+        S = self.config.max_slots
+        assert tokens.shape == (S,)
+        with self._lock:
+            toks, logprobs, self.cache = self._decode_fn(
+                self.params, self.cache,
+                jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+                jnp.asarray(lengths), jnp.asarray(temps), jnp.asarray(top_ps),
+                self._next_rng(),
+            )
+            active = int((lengths > 0).sum())
+            self.metrics["decode_tokens"] += active
+            self.metrics["decode_steps"] += 1
+        return np.asarray(toks), np.asarray(logprobs)
+
+    # ------------------------------------------------------------------
+    def context_window(self) -> int:
+        return min(self.config.max_seq_len, self.model_cfg.max_position_embeddings)
+
+    def warmup(self) -> float:
+        """Compile the decode program and the smallest prefill bucket."""
+        t0 = time.perf_counter()
+        S = self.config.max_slots
+        self.decode(
+            np.zeros((S,), np.int32), np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+            np.zeros((S,), np.float32), np.ones((S,), np.float32),
+        )
+        self.prefill([[1, 2, 3]], [0], [0.0], [1.0])
+        return time.perf_counter() - t0

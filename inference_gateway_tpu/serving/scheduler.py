@@ -1,0 +1,205 @@
+"""Continuous-batching scheduler.
+
+The host-side serving loop (SURVEY.md §7 stage 5): admits waiting
+requests into free cache slots (batched, bucket-padded prefill), then
+advances every active slot one token per engine step, streaming tokens to
+per-request callbacks as they are sampled. Runs on its own thread; the
+asyncio server hands results back to clients via thread-safe queues.
+
+Finish conditions: eos/stop tokens, per-request max_tokens, or cache-row
+exhaustion (finish_reason "length").
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from inference_gateway_tpu.serving.engine import Engine
+
+# Callback payload: (token_id, logprob, finished, finish_reason)
+TokenCallback = Callable[[int, float, bool, str | None], None]
+
+
+@dataclass
+class GenRequest:
+    prompt_ids: list[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    stop_token_ids: frozenset[int] = frozenset()
+    callback: TokenCallback = lambda *a: None
+    request_id: str = ""
+
+
+@dataclass
+class _SlotState:
+    req: GenRequest
+    pos: int  # tokens currently written to the cache row
+    pending_token: int  # sampled but not yet written
+    pending_logprob: float
+    generated: int = 1  # pending token counts as generated
+
+
+class Scheduler:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self._waiting: deque[GenRequest] = deque()
+        self._slots: dict[int, _SlotState] = {}
+        self._free = list(range(engine.config.max_slots))
+        self._wake = threading.Condition()
+        self._stop = False
+        self._ids = itertools.count()
+        self._thread: threading.Thread | None = None
+        self.queue_depth = 0  # exported metric
+
+    # -- public API ----------------------------------------------------
+    def submit(self, req: GenRequest) -> str:
+        if not req.request_id:
+            req.request_id = f"req-{next(self._ids)}"
+        limit = self.engine.context_window() - 1
+        if len(req.prompt_ids) > limit:
+            req.prompt_ids = req.prompt_ids[-limit:]
+        with self._wake:
+            self._waiting.append(req)
+            self.queue_depth = len(self._waiting)
+            self._wake.notify()
+        return req.request_id
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, name="scheduler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # -- core loop -----------------------------------------------------
+    def run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._stop and not self._waiting and not self._slots:
+                    self._wake.wait(timeout=0.2)
+                if self._stop:
+                    break
+            self._admit()
+            if self._slots:
+                self._decode_step()
+
+    def _admit(self) -> None:
+        """Move waiting requests into free slots and prefill them."""
+        batch: list[GenRequest] = []
+        slots: list[int] = []
+        with self._wake:
+            while self._waiting and self._free and len(batch) < self.engine.config.max_prefill_batch:
+                req = self._waiting.popleft()
+                batch.append(req)
+                slots.append(self._free.pop())
+            self.queue_depth = len(self._waiting)
+        if not batch:
+            return
+        results = self.engine.prefill(
+            [r.prompt_ids for r in batch], slots,
+            [r.temperature for r in batch], [r.top_p for r in batch],
+        )
+        for req, res in zip(batch, results):
+            state = _SlotState(req, pos=len(req.prompt_ids), pending_token=res.first_token,
+                               pending_logprob=res.logprob)
+            finished, reason = self._emit(state, res.first_token, res.logprob)
+            if finished:
+                self._release(res.slot, reason)
+                continue
+            self._slots[res.slot] = state
+
+    def _decode_step(self) -> None:
+        S = self.engine.config.max_slots
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        lengths = np.zeros((S,), np.int32)
+        temps = np.zeros((S,), np.float32)
+        top_ps = np.ones((S,), np.float32)
+        for slot, st in self._slots.items():
+            tokens[slot] = st.pending_token
+            positions[slot] = st.pos
+            lengths[slot] = st.pos + 1
+            temps[slot] = st.req.temperature
+            top_ps[slot] = st.req.top_p
+
+        toks, logprobs = self.engine.decode(tokens, positions, lengths, temps, top_ps)
+
+        for slot in list(self._slots):
+            st = self._slots[slot]
+            st.pos += 1
+            st.pending_token = int(toks[slot])
+            st.pending_logprob = float(logprobs[slot])
+            st.generated += 1
+            finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
+            if finished:
+                del self._slots[slot]
+                self._release(slot, reason)
+
+    def _emit(self, st: _SlotState, token: int, logprob: float) -> tuple[bool, str | None]:
+        """Send one token to the request's callback; decide termination."""
+        req = st.req
+        eos = self.engine.tokenizer.eos_token_id
+        is_stop = token == eos or token in req.stop_token_ids
+        hit_max = st.generated >= req.max_tokens
+        out_of_room = st.pos + 1 >= self.engine.config.max_seq_len
+        finished = is_stop or hit_max or out_of_room
+        reason = None
+        if finished:
+            reason = "stop" if is_stop else "length"
+        try:
+            req.callback(token, logprob, finished, reason)
+        except Exception:
+            pass  # a dead client must not kill the batch
+        return finished, reason
+
+    def _release(self, slot: int, reason: str | None) -> None:
+        with self._wake:
+            self._free.append(slot)
+            self._wake.notify()
+
+
+# ----------------------------------------------------------------------
+def generate_sync(
+    scheduler: Scheduler,
+    prompt_ids: list[int],
+    max_tokens: int = 64,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    stop_token_ids: frozenset[int] = frozenset(),
+    timeout: float = 120.0,
+) -> tuple[list[int], str | None]:
+    """Blocking helper used by tests and the non-streaming path."""
+    q: queue.Queue = queue.Queue()
+
+    def cb(token, logprob, finished, reason):
+        q.put((token, finished, reason))
+
+    scheduler.submit(GenRequest(
+        prompt_ids=prompt_ids, max_tokens=max_tokens, temperature=temperature,
+        top_p=top_p, stop_token_ids=stop_token_ids, callback=cb,
+    ))
+    out: list[int] = []
+    deadline = time.monotonic() + timeout
+    while True:
+        token, finished, reason = q.get(timeout=max(deadline - time.monotonic(), 0.1))
+        is_stop_tok = reason == "stop"
+        if not (finished and is_stop_tok):
+            out.append(token)
+        else:
+            # stop tokens are not part of the visible completion
+            pass
+        if finished:
+            return out, reason
