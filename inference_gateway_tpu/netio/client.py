@@ -1,0 +1,230 @@
+"""Asyncio HTTP/1.1 client with streaming (SSE) responses.
+
+The stand-in for the reference's pooled net/http client
+(providers/client/client.go:37-64): keep-alive connection pooling per
+(scheme, host, port), TLS 1.2+ minimum, compression off by default (SSE
+passthrough must not be buffered/deflated), and a self-addressing hook —
+requests whose URL has no host are sent to the gateway's own address
+(client.go:66-75), which is what routes provider traffic back through
+``/proxy/:provider`` (SURVEY.md §3.2, the double-hop architecture).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+from urllib.parse import urlsplit
+
+from inference_gateway_tpu.netio.server import Headers
+
+DEFAULT_TIMEOUT = 30.0
+
+
+class HTTPClientError(Exception):
+    pass
+
+
+@dataclass
+class ClientResponse:
+    status: int
+    headers: Headers
+    body: bytes = b""
+    _reader: asyncio.StreamReader | None = None
+    _release=None
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status < 300
+
+    def json(self):
+        import json
+
+        return json.loads(self.body.decode("utf-8"))
+
+    async def iter_lines(self) -> AsyncIterator[bytes]:
+        """Stream body lines (newline-delimited; SSE). Chunked-decoded."""
+        assert self._reader is not None, "not a streaming response"
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        buffer = b""
+        try:
+            if "chunked" in te:
+                while True:
+                    size_line = await self._reader.readline()
+                    if not size_line:
+                        break
+                    size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                    if size == 0:
+                        await self._reader.readline()
+                        break
+                    data = await self._reader.readexactly(size + 2)
+                    buffer += data[:-2]
+                    while b"\n" in buffer:
+                        line, buffer = buffer.split(b"\n", 1)
+                        yield line + b"\n"
+            else:
+                length = self.headers.get("Content-Length")
+                remaining = int(length) if length else None
+                while remaining is None or remaining > 0:
+                    chunk = await self._reader.read(min(65536, remaining or 65536))
+                    if not chunk:
+                        break
+                    if remaining is not None:
+                        remaining -= len(chunk)
+                    buffer += chunk
+                    while b"\n" in buffer:
+                        line, buffer = buffer.split(b"\n", 1)
+                        yield line + b"\n"
+            if buffer:
+                yield buffer
+        finally:
+            if self._release:
+                await self._release()
+
+
+@dataclass
+class ClientConfig:
+    """Mirrors reference providers/client/client.go:26-35."""
+
+    timeout: float = DEFAULT_TIMEOUT
+    max_idle_conns_per_host: int = 20
+    idle_conn_timeout: float = 30.0
+    disable_compression: bool = True
+    tls_min_version: str = "TLS12"
+
+
+class HTTPClient:
+    """Pooled async HTTP client with gateway self-addressing."""
+
+    def __init__(self, config: ClientConfig | None = None, self_scheme: str = "http",
+                 self_host: str = "localhost", self_port: int = 8080) -> None:
+        self.config = config or ClientConfig()
+        self.self_scheme = self_scheme
+        self.self_host = self_host
+        self.self_port = self_port
+        self._pool: dict[tuple[str, str, int], list[tuple[asyncio.StreamReader, asyncio.StreamWriter]]] = {}
+        self._pool_lock = asyncio.Lock()
+
+    # -- pool ----------------------------------------------------------
+    async def _connect(self, scheme: str, host: str, port: int):
+        async with self._pool_lock:
+            conns = self._pool.get((scheme, host, port))
+            while conns:
+                reader, writer = conns.pop()
+                if not writer.is_closing():
+                    return reader, writer
+        ssl_ctx = None
+        if scheme == "https":
+            ssl_ctx = ssl.create_default_context()
+            ssl_ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        return await asyncio.open_connection(host, port, ssl=ssl_ctx)
+
+    async def _release(self, scheme: str, host: str, port: int, reader, writer, reusable: bool):
+        if not reusable or writer.is_closing():
+            writer.close()
+            return
+        async with self._pool_lock:
+            conns = self._pool.setdefault((scheme, host, port), [])
+            if len(conns) < self.config.max_idle_conns_per_host:
+                conns.append((reader, writer))
+            else:
+                writer.close()
+
+    # -- request -------------------------------------------------------
+    async def request(
+        self,
+        method: str,
+        url: str,
+        headers: Headers | dict | None = None,
+        body: bytes = b"",
+        timeout: float | None = None,
+        stream: bool = False,
+    ) -> ClientResponse:
+        split = urlsplit(url)
+        scheme = split.scheme or self.self_scheme
+        host = split.hostname or self.self_host
+        port = split.port or (self.self_port if not split.hostname else (443 if scheme == "https" else 80))
+        path = split.path or "/"
+        if split.query:
+            path += "?" + split.query
+        timeout = timeout if timeout is not None else self.config.timeout
+
+        hdrs = Headers()
+        if isinstance(headers, Headers):
+            hdrs = Headers(headers.items())
+        elif headers:
+            for k, v in headers.items():
+                hdrs.add(k, v)
+        hdrs.set("Host", f"{host}:{port}")
+        hdrs.set("Content-Length", str(len(body)))
+        if self.config.disable_compression:
+            hdrs.set("Accept-Encoding", "identity")
+        if "Connection" not in hdrs:
+            hdrs.set("Connection", "keep-alive")
+
+        reader, writer = await self._connect(scheme, host, port)
+        try:
+            head = f"{method.upper()} {path} HTTP/1.1\r\n" + "".join(
+                f"{k}: {v}\r\n" for k, v in hdrs.items()
+            ) + "\r\n"
+            writer.write(head.encode("latin-1") + body)
+            await asyncio.wait_for(writer.drain(), timeout=timeout)
+
+            status_blob = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=timeout)
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+            writer.close()
+            raise HTTPClientError(f"{type(e).__name__} talking to {host}:{port}") from e
+
+        lines = status_blob.decode("latin-1").split("\r\n")
+        try:
+            status = int(lines[0].split(" ", 2)[1])
+        except (IndexError, ValueError) as e:
+            writer.close()
+            raise HTTPClientError(f"malformed status line from {host}:{port}") from e
+        resp_headers = Headers()
+        for line in lines[1:]:
+            if line:
+                k, _, v = line.partition(":")
+                resp_headers.add(k.strip(), v.strip())
+
+        resp = ClientResponse(status=status, headers=resp_headers)
+        keep = (resp_headers.get("Connection", "keep-alive") or "").lower() != "close"
+
+        if stream:
+            resp._reader = reader
+
+            async def release():
+                await self._release(scheme, host, port, reader, writer, reusable=False)
+
+            resp._release = release
+            return resp
+
+        te = (resp_headers.get("Transfer-Encoding") or "").lower()
+        try:
+            if "chunked" in te:
+                parts = []
+                while True:
+                    size_line = await asyncio.wait_for(reader.readline(), timeout=timeout)
+                    size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                    if size == 0:
+                        await asyncio.wait_for(reader.readline(), timeout=timeout)
+                        break
+                    data = await asyncio.wait_for(reader.readexactly(size + 2), timeout=timeout)
+                    parts.append(data[:-2])
+                resp.body = b"".join(parts)
+            else:
+                length = int(resp_headers.get("Content-Length") or 0)
+                resp.body = await asyncio.wait_for(reader.readexactly(length), timeout=timeout) if length else b""
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+            writer.close()
+            raise HTTPClientError(f"{type(e).__name__} reading from {host}:{port}") from e
+
+        await self._release(scheme, host, port, reader, writer, reusable=keep)
+        return resp
+
+    async def get(self, url: str, headers=None, timeout: float | None = None) -> ClientResponse:
+        return await self.request("GET", url, headers=headers, timeout=timeout)
+
+    async def post(self, url: str, body: bytes, headers=None, timeout: float | None = None, stream: bool = False) -> ClientResponse:
+        return await self.request("POST", url, headers=headers, body=body, timeout=timeout, stream=stream)

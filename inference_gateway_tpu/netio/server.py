@@ -1,0 +1,368 @@
+"""Asyncio HTTP/1.1 server with SSE streaming and a middleware chain.
+
+The TPU-native stand-in for the reference's gin engine + http.Server
+(cmd/gateway/main.go:237-292): a stdlib-only server with
+
+- a tiny router with ``:param`` and ``*path`` segments,
+- gin-style middlewares ``async def mw(req, next) -> Response``,
+- buffered JSON responses and chunk-flushed streaming responses,
+- per-write deadline reset for streams so long generations survive the
+  server write timeout (reference api/middlewares/shared.go:27-56),
+- optional TLS and graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import ssl
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Awaitable, Callable
+from urllib.parse import parse_qs, unquote, urlsplit
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class Headers:
+    """Case-insensitive multimap."""
+
+    def __init__(self, items: list[tuple[str, str]] | None = None) -> None:
+        self._items: list[tuple[str, str]] = list(items or [])
+
+    def get(self, key: str, default: str | None = None) -> str | None:
+        lk = key.lower()
+        for k, v in self._items:
+            if k.lower() == lk:
+                return v
+        return default
+
+    def get_all(self, key: str) -> list[str]:
+        lk = key.lower()
+        return [v for k, v in self._items if k.lower() == lk]
+
+    def set(self, key: str, value: str) -> None:
+        self.remove(key)
+        self._items.append((key, value))
+
+    def add(self, key: str, value: str) -> None:
+        self._items.append((key, value))
+
+    def remove(self, key: str) -> None:
+        lk = key.lower()
+        self._items = [(k, v) for k, v in self._items if k.lower() != lk]
+
+    def items(self) -> list[tuple[str, str]]:
+        return list(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return self.get(key) is not None
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, list[str]]
+    headers: Headers
+    body: bytes
+    params: dict[str, str] = field(default_factory=dict)
+    ctx: dict[str, Any] = field(default_factory=dict)
+    client: tuple[str, int] | None = None
+
+    def json(self) -> Any:
+        return json.loads(self.body.decode("utf-8"))
+
+    def query_get(self, key: str, default: str = "") -> str:
+        vals = self.query.get(key)
+        return vals[0] if vals else default
+
+
+@dataclass
+class Response:
+    status: int = 200
+    headers: Headers = field(default_factory=Headers)
+    body: bytes = b""
+
+    @classmethod
+    def json(cls, obj: Any, status: int = 200) -> "Response":
+        r = cls(status=status, body=json.dumps(obj).encode())
+        r.headers.set("Content-Type", "application/json")
+        return r
+
+    @classmethod
+    def text(cls, text: str, status: int = 200, content_type: str = "text/plain") -> "Response":
+        r = cls(status=status, body=text.encode())
+        r.headers.set("Content-Type", content_type)
+        return r
+
+
+@dataclass
+class StreamingResponse(Response):
+    """Body produced by an async iterator; each chunk is flushed
+    immediately (SSE)."""
+
+    chunks: AsyncIterator[bytes] | None = None
+
+    @classmethod
+    def sse(cls, chunks: AsyncIterator[bytes]) -> "StreamingResponse":
+        r = cls(status=200, chunks=chunks)
+        # SSE headers (reference api/middlewares/shared.go:17-25).
+        r.headers.set("Content-Type", "text/event-stream")
+        r.headers.set("Cache-Control", "no-cache")
+        r.headers.set("Connection", "keep-alive")
+        r.headers.set("X-Accel-Buffering", "no")
+        return r
+
+
+Handler = Callable[[Request], Awaitable[Response]]
+Middleware = Callable[[Request, Handler], Awaitable[Response]]
+
+_STATUS_TEXT = {
+    200: "OK", 201: "Created", 204: "No Content", 206: "Partial Content",
+    301: "Moved Permanently", 302: "Found", 304: "Not Modified",
+    400: "Bad Request", 401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout", 413: "Payload Too Large",
+    415: "Unsupported Media Type", 422: "Unprocessable Entity", 429: "Too Many Requests",
+    500: "Internal Server Error", 501: "Not Implemented", 502: "Bad Gateway",
+    503: "Service Unavailable", 504: "Gateway Timeout",
+}
+
+
+class Router:
+    """Method+path routing with ``:param`` and trailing ``*param``."""
+
+    def __init__(self) -> None:
+        self._routes: list[tuple[str, list[str], Handler]] = []
+        self.not_found: Handler = self._default_not_found
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        segs = [s for s in pattern.split("/") if s != ""]
+        self._routes.append((method.upper(), segs, handler))
+
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.add("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.add("POST", pattern, handler)
+
+    def resolve(self, method: str, path: str) -> tuple[Handler, dict[str, str]]:
+        parts = [s for s in path.split("/") if s != ""]
+        allowed_other_method = False
+        for m, segs, handler in self._routes:
+            params = self._match(segs, parts)
+            if params is None:
+                continue
+            if m != method.upper():
+                allowed_other_method = True
+                continue
+            return handler, params
+        if allowed_other_method:
+            async def method_not_allowed(req: Request) -> Response:
+                return Response.json({"error": "method not allowed"}, status=405)
+
+            return method_not_allowed, {}
+        return self.not_found, {}
+
+    @staticmethod
+    def _match(segs: list[str], parts: list[str]) -> dict[str, str] | None:
+        params: dict[str, str] = {}
+        i = 0
+        for i, seg in enumerate(segs):
+            if seg.startswith("*"):
+                params[seg[1:]] = "/" + "/".join(parts[i:])
+                return params
+            if i >= len(parts):
+                return None
+            if seg.startswith(":"):
+                params[seg[1:]] = unquote(parts[i])
+            elif seg != parts[i]:
+                return None
+        if len(parts) != len(segs):
+            return None
+        return params
+
+    @staticmethod
+    async def _default_not_found(req: Request) -> Response:
+        return Response.json({"error": "not found"}, status=404)
+
+
+class HTTPServer:
+    def __init__(
+        self,
+        router: Router,
+        middlewares: list[Middleware] | None = None,
+        read_timeout: float = 30.0,
+        write_timeout: float = 30.0,
+        idle_timeout: float = 120.0,
+        logger=None,
+    ) -> None:
+        self.router = router
+        self.middlewares = middlewares or []
+        self.read_timeout = read_timeout
+        self.write_timeout = write_timeout
+        self.idle_timeout = idle_timeout
+        self.logger = logger
+        self._server: asyncio.Server | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    # -- lifecycle -----------------------------------------------------
+    async def start(self, host: str, port: int, tls_cert: str = "", tls_key: str = "") -> int:
+        ssl_ctx = None
+        if tls_cert and tls_key:
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(tls_cert, tls_key)
+        self._server = await asyncio.start_server(self._handle_conn, host, port, ssl=ssl_ctx)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def shutdown(self) -> None:
+        if self._server:
+            self._server.close()
+            for writer in list(self._conns):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+
+    # -- connection handling -------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        self._conns.add(writer)
+        try:
+            keep_alive = True
+            first = True
+            while keep_alive:
+                timeout = self.read_timeout if first else self.idle_timeout
+                req = await self._read_request(reader, timeout, peer)
+                if req is None:
+                    break
+                first = False
+                keep_alive = (req.headers.get("Connection", "keep-alive") or "").lower() != "close"
+                resp = await self._dispatch(req)
+                await self._write_response(writer, resp, keep_alive)
+                if isinstance(resp, StreamingResponse):
+                    keep_alive = False  # streams own the connection
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError):
+            pass
+        except Exception as e:  # pragma: no cover - defensive
+            if self.logger:
+                self.logger.error("connection handler error", e)
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader, timeout: float, peer) -> Request | None:
+        try:
+            header_blob = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=timeout)
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError, ConnectionError):
+            return None
+        if len(header_blob) > MAX_HEADER_BYTES:
+            return None
+        lines = header_blob.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            return None
+        headers = Headers()
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers.add(k.strip(), v.strip())
+
+        body = b""
+        te = (headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            parts = []
+            total = 0
+            while True:
+                size_line = await asyncio.wait_for(reader.readline(), timeout=timeout)
+                size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    await asyncio.wait_for(reader.readline(), timeout=timeout)
+                    break
+                chunk = await asyncio.wait_for(reader.readexactly(size + 2), timeout=timeout)
+                parts.append(chunk[:-2])
+                total += size
+                if total > MAX_BODY_BYTES:
+                    return None
+            body = b"".join(parts)
+        else:
+            length = int(headers.get("Content-Length") or 0)
+            if length > MAX_BODY_BYTES:
+                return None
+            if length:
+                body = await asyncio.wait_for(reader.readexactly(length), timeout=timeout)
+
+        split = urlsplit(target)
+        return Request(
+            method=method.upper(),
+            path=unquote(split.path),
+            query=parse_qs(split.query),
+            headers=headers,
+            body=body,
+            client=peer,
+        )
+
+    async def _dispatch(self, req: Request) -> Response:
+        handler, params = self.router.resolve(req.method, req.path)
+        req.params = params
+
+        call = handler
+        for mw in reversed(self.middlewares):
+            call = self._wrap(mw, call)
+        try:
+            return await call(req)
+        except Exception as e:
+            if self.logger:
+                self.logger.error("handler error", e, "path", req.path)
+            return Response.json({"error": "internal server error"}, status=500)
+
+    @staticmethod
+    def _wrap(mw: Middleware, nxt: Handler) -> Handler:
+        async def wrapped(req: Request) -> Response:
+            return await mw(req, nxt)
+
+        return wrapped
+
+    async def _write_response(self, writer: asyncio.StreamWriter, resp: Response, keep_alive: bool) -> None:
+        status_line = f"HTTP/1.1 {resp.status} {_STATUS_TEXT.get(resp.status, 'Unknown')}\r\n"
+        headers = resp.headers
+        is_stream = isinstance(resp, StreamingResponse) and resp.chunks is not None
+        if is_stream:
+            headers.set("Transfer-Encoding", "chunked")
+            headers.remove("Content-Length")
+        else:
+            headers.set("Content-Length", str(len(resp.body)))
+        if not keep_alive and not is_stream:
+            headers.set("Connection", "close")
+        head = status_line + "".join(f"{k}: {v}\r\n" for k, v in headers.items()) + "\r\n"
+        writer.write(head.encode("latin-1"))
+
+        if is_stream:
+            try:
+                async for chunk in resp.chunks:  # type: ignore[union-attr]
+                    if not chunk:
+                        continue
+                    writer.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                    # Per-write deadline reset: each chunk gets a fresh
+                    # write_timeout window instead of one deadline for the
+                    # whole response (shared.go:27-56).
+                    await asyncio.wait_for(writer.drain(), timeout=self.write_timeout)
+            finally:
+                try:
+                    writer.write(b"0\r\n\r\n")
+                    await asyncio.wait_for(writer.drain(), timeout=self.write_timeout)
+                except Exception:
+                    pass
+        else:
+            writer.write(resp.body)
+            await asyncio.wait_for(writer.drain(), timeout=self.write_timeout)

@@ -1,0 +1,52 @@
+"""Server-sent-events framing helpers.
+
+The gateway and sidecar speak OpenAI-style SSE: ``data: <json>\n\n``
+frames terminated by ``data: [DONE]``. The reference's middlewares parse
+this wire format directly (telemetry scans the last chunks for usage,
+the MCP agent accumulates tool-call deltas), so framing must be exact
+(reference api/middlewares/shared.go:17-25, telemetry.go:195-231).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator, Iterator
+
+DONE_FRAME = b"data: [DONE]\n\n"
+
+
+def format_event(data: Any) -> bytes:
+    """One SSE frame. ``data`` may be a dict (JSON-encoded) or raw str."""
+    if not isinstance(data, (str, bytes)):
+        data = json.dumps(data, separators=(",", ":"))
+    if isinstance(data, str):
+        data = data.encode()
+    return b"data: " + data + b"\n\n"
+
+
+def parse_data_line(line: bytes) -> bytes | None:
+    """Extract the payload of a ``data:`` line; None for other lines."""
+    line = line.strip()
+    if line.startswith(b"data:"):
+        return line[5:].strip()
+    return None
+
+
+async def iter_sse_payloads(lines: AsyncIterator[bytes]) -> AsyncIterator[bytes]:
+    """Yield data payloads (without framing) from an SSE byte-line stream;
+    stops after [DONE]."""
+    async for line in lines:
+        payload = parse_data_line(line)
+        if payload is None:
+            continue
+        if payload == b"[DONE]":
+            return
+        yield payload
+
+
+def split_sse_payloads(body: bytes) -> Iterator[bytes]:
+    """Data payloads from a fully-buffered SSE body."""
+    for line in body.split(b"\n"):
+        payload = parse_data_line(line)
+        if payload is not None and payload != b"[DONE]":
+            yield payload

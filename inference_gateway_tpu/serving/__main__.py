@@ -1,0 +1,37 @@
+"""CLI: ``python -m inference_gateway_tpu.serving`` — run the TPU sidecar."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from inference_gateway_tpu.serving.engine import EngineConfig
+from inference_gateway_tpu.serving.server import serve
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="TPU serving sidecar (OpenAI-compatible)")
+    p.add_argument("--model", default="tinyllama-1.1b", help="preset name or local HF checkpoint path")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-slots", type=int, default=64)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--max-prefill-batch", type=int, default=8)
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
+    args = p.parse_args()
+
+    cfg = EngineConfig(
+        model=args.model,
+        max_slots=args.max_slots,
+        max_seq_len=args.max_seq_len,
+        max_prefill_batch=args.max_prefill_batch,
+        dtype=args.dtype,
+        use_mesh=not args.no_mesh,
+    )
+    asyncio.run(serve(cfg, host=args.host, port=args.port, served_model_name=args.served_model_name))
+
+
+if __name__ == "__main__":
+    main()

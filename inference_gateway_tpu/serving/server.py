@@ -1,0 +1,263 @@
+"""The TPU serving sidecar: an OpenAI-compatible HTTP server over the
+continuous-batching engine.
+
+This is the upstream behind the gateway's first-class ``tpu`` provider —
+the same contract llama.cpp/Ollama fulfil for the reference
+(providers/registry/registry.go:143-208):
+
+- ``GET  /v1/models``            — OpenAI list-models shape
+- ``POST /v1/chat/completions``  — non-streaming + SSE streaming with
+  OpenAI-chunk-exact framing (usage in the trailing chunks, then
+  ``data: [DONE]``) so the gateway's telemetry middleware and MCP agent
+  parse it unchanged (SURVEY.md §7 "streaming fidelity").
+- ``GET  /props``                — llama.cpp-compatible runtime metadata
+  (default_generation_settings.n_ctx) feeding the gateway's runtime
+  context-window tier (reference api/context_window.go:86-100).
+- ``GET  /health``, ``GET /metrics`` — liveness + engine counters
+  (tokens/sec, queue depth, TTFT) for observability.
+
+Tokens stream straight off the decode loop: the scheduler thread pushes
+sampled tokens into an asyncio queue consumed by the SSE writer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any
+
+from inference_gateway_tpu.logger import Logger, new_logger
+from inference_gateway_tpu.netio import sse
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
+from inference_gateway_tpu.serving.tokenizer import DetokenizeState
+
+
+class SidecarServer:
+    def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
+                 served_model_name: str | None = None, logger: Logger | None = None):
+        self.engine = engine
+        self.scheduler = scheduler or Scheduler(engine)
+        self._own_scheduler = scheduler is None
+        self.model_name = served_model_name or engine.config.model
+        self.logger = logger or new_logger()
+        self.created = int(time.time())
+        self._started = time.monotonic()
+        self.router = self._build_router()
+        self.http = HTTPServer(self.router, logger=self.logger)
+
+    # ------------------------------------------------------------------
+    def _build_router(self) -> Router:
+        r = Router()
+        r.get("/health", self.health)
+        r.get("/v1/models", self.list_models)
+        r.post("/v1/chat/completions", self.chat_completions)
+        r.get("/props", self.props)
+        r.get("/metrics", self.metrics)
+        return r
+
+    async def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:
+        if self._own_scheduler:
+            self.scheduler.start()
+        return await self.http.start(host, port)
+
+    async def shutdown(self) -> None:
+        await self.http.shutdown()
+        if self._own_scheduler:
+            self.scheduler.stop()
+
+    # -- handlers ------------------------------------------------------
+    async def health(self, req: Request) -> Response:
+        return Response.json({"status": "ok"})
+
+    async def list_models(self, req: Request) -> Response:
+        return Response.json({
+            "object": "list",
+            "data": [{
+                "id": self.model_name,
+                "object": "model",
+                "created": self.created,
+                "owned_by": "tpu",
+                "served_by": "tpu",
+                "context_window": self.engine.context_window(),
+            }],
+        })
+
+    async def props(self, req: Request) -> Response:
+        """llama.cpp-compatible /props (context_window.go:86-100)."""
+        return Response.json({
+            "default_generation_settings": {"n_ctx": self.engine.context_window()},
+            "model": self.model_name,
+            "total_slots": self.engine.config.max_slots,
+        })
+
+    async def metrics(self, req: Request) -> Response:
+        m = dict(self.engine.metrics)
+        m["queue_depth"] = self.scheduler.queue_depth
+        m["uptime_seconds"] = round(time.monotonic() - self._started, 3)
+        return Response.json(m)
+
+    # ------------------------------------------------------------------
+    def _prepare(self, body: dict[str, Any]) -> tuple[GenRequest, dict[str, Any]]:
+        messages = body.get("messages") or []
+        prompt_ids = self.engine.tokenizer.apply_chat_template(messages)
+        max_tokens = body.get("max_completion_tokens") or body.get("max_tokens") or 256
+        stop = body.get("stop")
+        stop_strings: list[str] = [stop] if isinstance(stop, str) else list(stop or [])
+        req = GenRequest(
+            prompt_ids=prompt_ids,
+            max_tokens=int(max_tokens),
+            temperature=float(body.get("temperature") or 0.0),
+            top_p=float(body.get("top_p") or 1.0),
+        )
+        meta = {
+            "id": "chatcmpl-" + uuid.uuid4().hex[:24],
+            "created": int(time.time()),
+            "model": body.get("model") or self.model_name,
+            "prompt_tokens": len(prompt_ids),
+            "stop_strings": stop_strings,
+        }
+        return req, meta
+
+    async def chat_completions(self, req: Request) -> Response:
+        try:
+            body = req.json()
+        except (ValueError, UnicodeDecodeError):
+            return Response.json({"error": "invalid JSON body"}, status=400)
+        if not body.get("messages"):
+            return Response.json({"error": "messages is required"}, status=400)
+
+        gen, meta = self._prepare(body)
+        if len(gen.prompt_ids) >= self.engine.context_window():
+            return Response.json({"error": "prompt exceeds context window"}, status=400)
+        stream = bool(body.get("stream"))
+        include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def cb(token: int, logprob: float, finished: bool, reason: str | None) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, (token, finished, reason))
+
+        gen.callback = cb
+
+        if stream:
+            return StreamingResponse.sse(self._stream_chunks(gen, meta, q, include_usage))
+
+        # Non-streaming: drain the queue to completion.
+        self.scheduler.submit(gen)
+        detok = DetokenizeState()
+        completion_tokens = 0
+        reason = "stop"
+        while True:
+            token, finished, fin_reason = await q.get()
+            if not (finished and fin_reason == "stop"):
+                detok.push(self.engine.tokenizer, token)
+            completion_tokens += 1
+            if finished:
+                reason = fin_reason or "stop"
+                break
+        text, reason = self._apply_stop_strings(detok.emitted, meta["stop_strings"], reason)
+        return Response.json({
+            "id": meta["id"],
+            "object": "chat.completion",
+            "created": meta["created"],
+            "model": meta["model"],
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": reason,
+            }],
+            "usage": {
+                "prompt_tokens": meta["prompt_tokens"],
+                "completion_tokens": completion_tokens,
+                "total_tokens": meta["prompt_tokens"] + completion_tokens,
+            },
+        })
+
+    @staticmethod
+    def _apply_stop_strings(text: str, stop_strings: list[str], reason: str) -> tuple[str, str]:
+        for s in stop_strings:
+            if s and s in text:
+                return text[: text.index(s)], "stop"
+        return text, reason
+
+    async def _stream_chunks(self, gen: GenRequest, meta: dict[str, Any], q: asyncio.Queue, include_usage: bool):
+        """OpenAI chat.completion.chunk SSE frames off the decode loop."""
+        self.scheduler.submit(gen)
+
+        def chunk(delta: dict[str, Any], finish: str | None) -> bytes:
+            return sse.format_event({
+                "id": meta["id"],
+                "object": "chat.completion.chunk",
+                "created": meta["created"],
+                "model": meta["model"],
+                "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+            })
+
+        yield chunk({"role": "assistant", "content": ""}, None)
+
+        detok = DetokenizeState()
+        completion_tokens = 0
+        reason = "stop"
+        stop_strings = meta["stop_strings"]
+        emitted_len = 0
+        stopped_early = False
+        while True:
+            token, finished, fin_reason = await q.get()
+            completion_tokens += 1
+            if not (finished and fin_reason == "stop"):
+                delta = detok.push(self.engine.tokenizer, token)
+            else:
+                delta = ""
+            if stop_strings and not stopped_early:
+                cut, new_reason = self._apply_stop_strings(detok.emitted, stop_strings, "")
+                if new_reason == "stop":
+                    delta = cut[emitted_len:]
+                    stopped_early = True
+                    reason = "stop"
+                    if delta:
+                        emitted_len += len(delta)
+                        yield chunk({"content": delta}, None)
+                    break
+            if delta and not stopped_early:
+                emitted_len += len(delta)
+                yield chunk({"content": delta}, None)
+            if finished:
+                reason = fin_reason or "stop"
+                break
+
+        yield chunk({}, reason)
+        if include_usage:
+            yield sse.format_event({
+                "id": meta["id"],
+                "object": "chat.completion.chunk",
+                "created": meta["created"],
+                "model": meta["model"],
+                "choices": [],
+                "usage": {
+                    "prompt_tokens": meta["prompt_tokens"],
+                    "completion_tokens": completion_tokens,
+                    "total_tokens": meta["prompt_tokens"] + completion_tokens,
+                },
+            })
+        yield sse.DONE_FRAME
+
+
+async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
+                served_model_name: str | None = None) -> None:
+    """Run the sidecar until cancelled (entry point for __main__)."""
+    logger = new_logger()
+    engine = Engine(config)
+    warm = engine.warmup()
+    logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)
+    server = SidecarServer(engine, served_model_name=served_model_name, logger=logger)
+    bound = await server.start(host, port)
+    logger.info("tpu sidecar listening", "host", host, "port", bound)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.shutdown()

@@ -23,3 +23,63 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# ---------------------------------------------------------------------------
+# Minimal async test support (pytest-asyncio is not in the image): async test
+# functions run on one shared background event loop, so module-scoped server
+# fixtures can live on the same loop via the ``aloop`` fixture.
+# ---------------------------------------------------------------------------
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+class AsyncLoopRunner:
+    def __init__(self) -> None:
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name="test-aloop", daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float = 120.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+_RUNNER: AsyncLoopRunner | None = None
+
+
+def _get_runner() -> AsyncLoopRunner:
+    global _RUNNER
+    if _RUNNER is None:
+        _RUNNER = AsyncLoopRunner()
+    return _RUNNER
+
+
+@pytest.fixture(scope="session")
+def aloop() -> AsyncLoopRunner:
+    return _get_runner()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+        _get_runner().run(fn(**kwargs), timeout=180.0)
+        return True
+    return None
+
+
+def pytest_sessionfinish(session, exitstatus):
+    global _RUNNER
+    if _RUNNER is not None:
+        _RUNNER.stop()
+        _RUNNER = None
+

@@ -1,0 +1,157 @@
+"""End-to-end sidecar tests: OpenAI-compatible HTTP over the tiny engine.
+
+Real sockets (ephemeral port), real scheduler thread, real SSE framing —
+the netio client consumes what the netio server emits.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.sse import iter_sse_payloads
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.server import SidecarServer
+
+
+@pytest.fixture(scope="module")
+def sidecar(aloop):
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=128,
+                                 dtype="float32", max_prefill_batch=2, use_mesh=False))
+    server = SidecarServer(engine, served_model_name="tpu-test-tiny")
+    port = aloop.run(server.start("127.0.0.1", 0))
+    yield server, port
+    aloop.run(server.shutdown())
+
+
+@pytest.fixture
+def client():
+    return HTTPClient()
+
+
+async def test_health(sidecar, client):
+    _, port = sidecar
+    resp = await client.get(f"http://127.0.0.1:{port}/health")
+    assert resp.status == 200
+
+
+async def test_list_models(sidecar, client):
+    _, port = sidecar
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models")
+    data = resp.json()
+    assert data["object"] == "list"
+    assert data["data"][0]["id"] == "tpu-test-tiny"
+    assert data["data"][0]["served_by"] == "tpu"
+
+
+async def test_props_runtime_metadata(sidecar, client):
+    _, port = sidecar
+    resp = await client.get(f"http://127.0.0.1:{port}/props")
+    props = resp.json()
+    assert props["default_generation_settings"]["n_ctx"] == 128
+
+
+async def test_chat_completion_non_streaming(sidecar, client):
+    _, port = sidecar
+    body = {
+        "model": "tpu-test-tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+    }
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    assert resp.status == 200
+    data = resp.json()
+    assert data["object"] == "chat.completion"
+    assert data["choices"][0]["message"]["role"] == "assistant"
+    assert data["choices"][0]["finish_reason"] in ("stop", "length")
+    assert data["usage"]["prompt_tokens"] > 0
+    assert data["usage"]["completion_tokens"] > 0
+    assert data["usage"]["total_tokens"] == data["usage"]["prompt_tokens"] + data["usage"]["completion_tokens"]
+
+
+async def test_chat_completion_streaming(sidecar, client):
+    _, port = sidecar
+    body = {
+        "model": "tpu-test-tiny",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode(), stream=True
+    )
+    assert resp.status == 200
+    assert "text/event-stream" in (resp.headers.get("Content-Type") or "")
+
+    chunks = []
+    async for payload in iter_sse_payloads(resp.iter_lines()):
+        chunks.append(json.loads(payload))
+
+    assert chunks[0]["object"] == "chat.completion.chunk"
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    finishes = [c["choices"][0]["finish_reason"] for c in chunks if c.get("choices")]
+    assert finishes[-1] in ("stop", "length")
+    # usage rides in the trailing chunk (reference telemetry scans last 4).
+    assert "usage" in chunks[-1]
+    assert chunks[-1]["usage"]["completion_tokens"] > 0
+
+
+async def test_streaming_matches_non_streaming(sidecar, client):
+    _, port = sidecar
+    body = {
+        "model": "tpu-test-tiny",
+        "messages": [{"role": "user", "content": "determinism"}],
+        "max_tokens": 8,
+        "temperature": 0,
+    }
+    non = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    text_non = non.json()["choices"][0]["message"]["content"]
+
+    body["stream"] = True
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode(), stream=True)
+    text_stream = ""
+    async for payload in iter_sse_payloads(resp.iter_lines()):
+        c = json.loads(payload)
+        for choice in c.get("choices", []):
+            text_stream += choice.get("delta", {}).get("content") or ""
+    assert text_stream == text_non
+
+
+async def test_bad_request(sidecar, client):
+    _, port = sidecar
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", b"not json")
+    assert resp.status == 400
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", b"{}")
+    assert resp.status == 400
+
+
+async def test_concurrent_streams(sidecar, client):
+    _, port = sidecar
+
+    async def one(i: int) -> str:
+        body = {
+            "messages": [{"role": "user", "content": f"request {i}"}],
+            "max_tokens": 5,
+            "stream": True,
+        }
+        c = HTTPClient()
+        resp = await c.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode(), stream=True)
+        text = ""
+        async for payload in iter_sse_payloads(resp.iter_lines()):
+            data = json.loads(payload)
+            for choice in data.get("choices", []):
+                text += choice.get("delta", {}).get("content") or ""
+        return text
+
+    results = await asyncio.gather(*[one(i) for i in range(8)])
+    assert len(results) == 8
+
+
+async def test_metrics_endpoint(sidecar, client):
+    _, port = sidecar
+    resp = await client.get(f"http://127.0.0.1:{port}/metrics")
+    m = resp.json()
+    assert m["decode_tokens"] > 0
+    assert "queue_depth" in m
