@@ -1,0 +1,115 @@
+"""Context-window metadata enrichment.
+
+Capability parity with reference providers/core/context_window.go and
+community_context_window.go — the 3-tier precedence documented there:
+
+  runtime (llama.cpp /props, Ollama /api/show, tpu /props — resolved in
+  api/context_window.py) > provider-published > community table
+
+Provider-published detection scans the provider's raw list-models body
+for any of the published size keys (context_window.go:13).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# Keys providers publish model context sizes under (context_window.go:13).
+PROVIDER_KEYS = ("context_window", "context_length", "max_context_length", "max_model_len")
+
+# Community tier: curated from public model documentation (stand-in for
+# the reference's models.dev-generated table, community_context_windows.json).
+COMMUNITY_CONTEXT_WINDOWS: dict[str, int] = {
+    "gpt-4o": 128000,
+    "gpt-4o-mini": 128000,
+    "gpt-4-turbo": 128000,
+    "gpt-4": 8192,
+    "gpt-3.5-turbo": 16385,
+    "o1": 200000,
+    "o3-mini": 200000,
+    "claude-3-opus-20240229": 200000,
+    "claude-3-5-sonnet-20241022": 200000,
+    "claude-3-5-haiku-20241022": 200000,
+    "claude-3-haiku-20240307": 200000,
+    "gemini-1.5-pro": 2097152,
+    "gemini-1.5-flash": 1048576,
+    "gemini-2.0-flash": 1048576,
+    "llama-3.3-70b-versatile": 131072,
+    "llama-3.1-8b-instant": 131072,
+    "llama3-8b-8192": 8192,
+    "llama3-70b-8192": 8192,
+    "mixtral-8x7b-32768": 32768,
+    "mistral-large-latest": 131072,
+    "mistral-small-latest": 32768,
+    "open-mistral-7b": 32768,
+    "open-mixtral-8x7b": 32768,
+    "command-r": 128000,
+    "command-r-plus": 128000,
+    "deepseek-chat": 65536,
+    "deepseek-reasoner": 65536,
+    "moonshot-v1-8k": 8192,
+    "moonshot-v1-32k": 32768,
+    "moonshot-v1-128k": 131072,
+    "glm-4-plus": 128000,
+    "glm-4-flash": 128000,
+    "tinyllama": 2048,
+    "llama3": 8192,
+    "llama3.1": 131072,
+    "llama-3-8b": 8192,
+    "llama-3-8b-instruct": 8192,
+    "llama-3.1-8b": 131072,
+    "tinyllama-1.1b": 2048,
+    "mixtral-8x7b": 32768,
+    "mixtral-8x7b-instruct": 32768,
+}
+
+
+def _strip_provider(model_id: str) -> str:
+    _, sep, rest = model_id.partition("/")
+    return rest if sep else model_id
+
+
+def apply_provider_context_windows(raw: dict[str, Any] | None, models: list[dict[str, Any]]) -> None:
+    """Copy provider-published sizes from the raw body onto transformed
+    models (context_window.go:40-55). Mutates in place."""
+    if not raw:
+        return
+    raw_models = None
+    for key in ("data", "models", "result"):
+        if isinstance(raw.get(key), list):
+            raw_models = raw[key]
+            break
+    if not raw_models:
+        return
+
+    by_name: dict[str, int] = {}
+    for rm in raw_models:
+        if not isinstance(rm, dict):
+            continue
+        name = rm.get("id") or rm.get("name") or rm.get("model") or ""
+        if not isinstance(name, str):
+            continue
+        for k in PROVIDER_KEYS:
+            v = rm.get(k)
+            if isinstance(v, (int, float)) and v > 0:
+                by_name[name.removeprefix("models/")] = int(v)
+                break
+
+    for m in models:
+        if m.get("context_window"):
+            continue
+        name = _strip_provider(m.get("id", ""))
+        if name in by_name:
+            m["context_window"] = by_name[name]
+
+
+def apply_community_context_windows(models: list[dict[str, Any]]) -> None:
+    """Community fallback tier (community_context_window.go:41). Mutates
+    in place; never overrides an already-present value."""
+    for m in models:
+        if m.get("context_window"):
+            continue
+        name = _strip_provider(m.get("id", "")).lower()
+        size = COMMUNITY_CONTEXT_WINDOWS.get(name)
+        if size:
+            m["context_window"] = size

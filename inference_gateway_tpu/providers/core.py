@@ -1,0 +1,170 @@
+"""The concrete provider implementation.
+
+Capability parity with reference providers/core/provider.go:35-330:
+every provider request targets ``/proxy/<id><endpoint>`` with no host —
+the netio client's self-addressing sends it back through the gateway's
+own ProxyHandler, where provider auth is attached (the double-hop
+architecture, SURVEY.md §3.2). Streaming enforces
+``stream_options.include_usage`` except for Cohere/Mistral
+(provider.go:85-96) and relays SSE lines through a bounded queue
+(provider.go:259-293).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator
+
+from inference_gateway_tpu.logger import Logger, NoopLogger
+from inference_gateway_tpu.netio.client import HTTPClient, HTTPClientError
+from inference_gateway_tpu.netio.server import Headers
+from inference_gateway_tpu.providers import constants
+from inference_gateway_tpu.providers.context_window import (
+    apply_community_context_windows,
+    apply_provider_context_windows,
+)
+from inference_gateway_tpu.providers.pricing import apply_community_pricing, apply_provider_pricing
+from inference_gateway_tpu.providers.registry import ProviderConfig
+from inference_gateway_tpu.providers.transformers import transform_list_models
+
+STREAM_QUEUE_CAP = 100  # provider.go:259 channel cap
+
+
+class HTTPError(Exception):
+    """Upstream non-200 (provider.go:26-33)."""
+
+    def __init__(self, status_code: int, message: str):
+        super().__init__(message)
+        self.status_code = status_code
+        self.message = message
+
+
+class Provider:
+    def __init__(self, cfg: ProviderConfig, client: HTTPClient, logger: Logger | None = None):
+        self.cfg = cfg
+        self.client = client
+        self.logger = logger or NoopLogger()
+
+    # -- identity ------------------------------------------------------
+    @property
+    def id(self) -> str:
+        return self.cfg.id
+
+    @property
+    def name(self) -> str:
+        return self.cfg.name
+
+    def supports_vision(self, model: str) -> bool:
+        """Vision capability heuristics (provider.go:299-330)."""
+        if not self.cfg.supports_vision:
+            return False
+        m = model.lower()
+        pid = self.cfg.id
+        if pid == constants.OPENAI_ID:
+            if "gpt-5" in m or "gpt-4.1" in m:
+                return True
+            return "gpt-4" in m and ("vision" in m or "turbo" in m or "gpt-4o" in m)
+        if pid == constants.ANTHROPIC_ID:
+            return any(s in m for s in ("claude-3", "opus-4", "sonnet-4", "haiku-4"))
+        if pid == constants.ZAI_ID:
+            return True
+        if pid == constants.TPU_ID:
+            # The sidecar reports per-model modality in /v1/models; default
+            # to name heuristics like other local runtimes.
+            return "vision" in m or "vl" in m or "llava" in m or "gemma-3" in m
+        return "vision" in m or "multimodal" in m or "-vl" in m or ("qwen" in m and "vl" in m)
+
+    # -- helpers -------------------------------------------------------
+    def _headers(self, ctx: dict[str, Any] | None) -> Headers:
+        h = Headers()
+        h.set("Content-Type", "application/json")
+        h.set("Accept", "text/event-stream, application/json")
+        h.set("Cache-Control", "no-cache")
+        # Forward the client's bearer for OIDC-protected gateways
+        # (provider.go:110-112).
+        token = (ctx or {}).get("auth_token")
+        if token:
+            h.set("Authorization", f"Bearer {token}")
+        # Self-calls must skip MCP re-interception (mcp.go:25).
+        h.set("X-MCP-Bypass", "true")
+        return h
+
+    def _prepare_streaming_request(self, req: dict[str, Any]) -> dict[str, Any]:
+        out = dict(req)
+        out["stream_options"] = {"include_usage": True}
+        if self.cfg.id in (constants.COHERE_ID, constants.MISTRAL_ID):
+            out.pop("stream_options", None)
+        return out
+
+    # -- API (interfaces.go:10-24) --------------------------------------
+    async def list_models(self, ctx: dict[str, Any] | None = None) -> dict[str, Any]:
+        url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.models}"
+        try:
+            resp = await self.client.get(url, headers=self._headers(ctx))
+        except HTTPClientError as e:
+            self.logger.error("failed to list models", e, "provider", self.name)
+            raise
+        if resp.status != 200:
+            raise HTTPError(resp.status, resp.body.decode("utf-8", errors="replace"))
+        try:
+            raw = resp.json()
+        except ValueError:
+            raw = {}
+        out = transform_list_models(self.cfg.id, raw)
+        apply_provider_context_windows(raw, out["data"])
+        apply_community_context_windows(out["data"])
+        apply_provider_pricing(raw, out["data"])
+        apply_community_pricing(out["data"])
+        return out
+
+    async def chat_completions(self, req: dict[str, Any], ctx: dict[str, Any] | None = None) -> dict[str, Any]:
+        url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.chat}"
+        body = json.dumps(req).encode()
+        try:
+            resp = await self.client.post(url, body, headers=self._headers(ctx))
+        except HTTPClientError as e:
+            self.logger.error("failed to send request", e, "provider", self.name)
+            raise
+        if resp.status != 200:
+            raise HTTPError(resp.status, resp.body.decode("utf-8", errors="replace"))
+        return resp.json()
+
+    async def stream_chat_completions(
+        self, req: dict[str, Any], ctx: dict[str, Any] | None = None
+    ) -> AsyncIterator[bytes]:
+        """SSE line stream from the upstream, via a bounded relay queue."""
+        url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.chat}"
+        stream_req = self._prepare_streaming_request(req)
+        body = json.dumps(stream_req).encode()
+        resp = await self.client.post(url, body, headers=self._headers(ctx), stream=True)
+        if resp.status != 200:
+            err_body = b""
+            async for line in resp.iter_lines():
+                err_body += line
+            raise HTTPError(resp.status, err_body.decode("utf-8", errors="replace"))
+
+        queue: asyncio.Queue[bytes | None] = asyncio.Queue(maxsize=STREAM_QUEUE_CAP)
+
+        async def reader():
+            try:
+                async for line in resp.iter_lines():
+                    await queue.put(line)
+            except Exception as e:
+                self.logger.error("error reading stream", e, "provider", self.name)
+            finally:
+                await queue.put(None)
+
+        task = asyncio.create_task(reader())
+
+        async def gen() -> AsyncIterator[bytes]:
+            try:
+                while True:
+                    line = await queue.get()
+                    if line is None:
+                        break
+                    yield line
+            finally:
+                task.cancel()
+
+        return gen()

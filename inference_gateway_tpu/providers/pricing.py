@@ -1,0 +1,99 @@
+"""Pricing metadata enrichment.
+
+Capability parity with reference providers/core/pricing.go and
+community_pricing.go: OpenRouter-style provider-published per-token
+decimal-string rates, with a curated community fallback. Rates are
+dollars per token, serialized as decimal strings to avoid float drift
+(pricing.go:51).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# Community tier (USD per token, decimal strings), curated from public
+# price sheets — stand-in for the reference's models.dev-generated table.
+COMMUNITY_PRICING: dict[str, dict[str, str]] = {
+    "gpt-4o": {"prompt": "0.0000025", "completion": "0.00001"},
+    "gpt-4o-mini": {"prompt": "0.00000015", "completion": "0.0000006"},
+    "gpt-4-turbo": {"prompt": "0.00001", "completion": "0.00003"},
+    "gpt-3.5-turbo": {"prompt": "0.0000005", "completion": "0.0000015"},
+    "o1": {"prompt": "0.000015", "completion": "0.00006"},
+    "claude-3-opus-20240229": {"prompt": "0.000015", "completion": "0.000075"},
+    "claude-3-5-sonnet-20241022": {"prompt": "0.000003", "completion": "0.000015"},
+    "claude-3-5-haiku-20241022": {"prompt": "0.0000008", "completion": "0.000004"},
+    "gemini-1.5-pro": {"prompt": "0.00000125", "completion": "0.000005"},
+    "gemini-1.5-flash": {"prompt": "0.000000075", "completion": "0.0000003"},
+    "llama-3.3-70b-versatile": {"prompt": "0.00000059", "completion": "0.00000079"},
+    "llama-3.1-8b-instant": {"prompt": "0.00000005", "completion": "0.00000008"},
+    "mixtral-8x7b-32768": {"prompt": "0.00000024", "completion": "0.00000024"},
+    "mistral-large-latest": {"prompt": "0.000002", "completion": "0.000006"},
+    "command-r-plus": {"prompt": "0.0000025", "completion": "0.00001"},
+    "command-r": {"prompt": "0.00000015", "completion": "0.0000006"},
+    "deepseek-chat": {"prompt": "0.00000027", "completion": "0.0000011"},
+    "moonshot-v1-8k": {"prompt": "0.0000002", "completion": "0.0000002"},
+}
+
+
+def _strip_provider(model_id: str) -> str:
+    _, sep, rest = model_id.partition("/")
+    return rest if sep else model_id
+
+
+def _rate(value: Any) -> str | None:
+    """Normalize a published rate to a decimal string (pricing.go:51)."""
+    if isinstance(value, str) and value:
+        return value
+    if isinstance(value, (int, float)) and value >= 0:
+        return f"{value:.12f}".rstrip("0").rstrip(".") or "0"
+    return None
+
+
+def apply_provider_pricing(raw: dict[str, Any] | None, models: list[dict[str, Any]]) -> None:
+    """Copy provider-published (OpenRouter-style) pricing from the raw
+    list body (pricing.go:17-49). Mutates in place."""
+    if not raw:
+        return
+    raw_models = None
+    for key in ("data", "models", "result"):
+        if isinstance(raw.get(key), list):
+            raw_models = raw[key]
+            break
+    if not raw_models:
+        return
+
+    by_name: dict[str, dict[str, str]] = {}
+    for rm in raw_models:
+        if not isinstance(rm, dict):
+            continue
+        pricing = rm.get("pricing")
+        if not isinstance(pricing, dict):
+            continue
+        prompt = _rate(pricing.get("prompt"))
+        completion = _rate(pricing.get("completion"))
+        if prompt is None and completion is None:
+            continue
+        name = rm.get("id") or rm.get("name") or rm.get("model") or ""
+        if isinstance(name, str) and name:
+            by_name[name.removeprefix("models/")] = {
+                "prompt": prompt or "0",
+                "completion": completion or "0",
+            }
+
+    for m in models:
+        if m.get("pricing"):
+            continue
+        name = _strip_provider(m.get("id", ""))
+        if name in by_name:
+            m["pricing"] = by_name[name]
+
+
+def apply_community_pricing(models: list[dict[str, Any]]) -> None:
+    """Community fallback tier (community_pricing.go). Mutates in place."""
+    for m in models:
+        if m.get("pricing"):
+            continue
+        name = _strip_provider(m.get("id", "")).lower()
+        p = COMMUNITY_PRICING.get(name)
+        if p:
+            m["pricing"] = dict(p)

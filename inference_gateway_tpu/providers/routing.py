@@ -1,0 +1,137 @@
+"""Model routing: provider prefixes, allow/deny lists, alias pools.
+
+Capability parity with reference providers/routing/:
+- explicit ``provider/model`` prefix parsing, no name heuristics
+  (model_mapping.go:19-31)
+- ALLOWED_MODELS / DISALLOWED_MODELS case-insensitive sets matching both
+  full and prefix-stripped ids (model_filter.go:10-65)
+- round-robin model-alias pools from YAML with an atomic per-replica
+  cursor and a ≥2-deployments invariant (pool.go:39-105)
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from inference_gateway_tpu.providers.registry import REGISTRY
+
+
+# -- provider/model mapping (model_mapping.go) ------------------------------
+def determine_provider_and_model_name(model: str) -> tuple[str | None, str]:
+    prefix, sep, rest = model.partition("/")
+    if not sep:
+        return None, model
+    pid = prefix.lower()
+    if pid not in REGISTRY:
+        return None, model
+    return pid, rest
+
+
+# -- allow/deny filtering (model_filter.go) ---------------------------------
+def parse_model_set(csv: str) -> set[str]:
+    return {e.strip().lower() for e in csv.split(",") if e.strip()}
+
+
+def model_matches(model_set: set[str], model_id: str) -> bool:
+    mid = model_id.lower()
+    if mid in model_set:
+        return True
+    _, sep, name = mid.partition("/")
+    return bool(sep) and name in model_set
+
+
+def filter_models(models: list[dict[str, Any]], allowed: str, disallowed: str) -> list[dict[str, Any]]:
+    """Allow list wins over deny list; empty lists pass everything."""
+    if allowed:
+        allow_set = parse_model_set(allowed)
+        if not allow_set:
+            return models
+        return [m for m in models if model_matches(allow_set, m.get("id", ""))]
+    if disallowed:
+        deny_set = parse_model_set(disallowed)
+        if not deny_set:
+            return models
+        return [m for m in models if not model_matches(deny_set, m.get("id", ""))]
+    return models
+
+
+def is_model_allowed(model_id: str, allowed: str, disallowed: str) -> bool:
+    return bool(filter_models([{"id": model_id}], allowed, disallowed))
+
+
+# -- routing pools (pool.go) ------------------------------------------------
+@dataclass
+class Deployment:
+    provider: str
+    model: str
+
+
+@dataclass
+class Pool:
+    alias: str
+    deployments: list[Deployment]
+    _cursor: itertools.count = field(default_factory=itertools.count)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def next(self) -> Deployment:
+        with self._lock:
+            idx = next(self._cursor)
+        return self.deployments[idx % len(self.deployments)]
+
+
+class PoolConfigError(ValueError):
+    pass
+
+
+def load_pools_config(path: str) -> dict[str, Pool]:
+    """Parse the YAML pools file. Schema (pool.go:52-66):
+
+        pools:
+          - model: logical-alias
+            deployments:
+              - provider: openai
+                model: gpt-4o
+              - provider: tpu
+                model: llama-3-8b
+    """
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    pools: dict[str, Pool] = {}
+    for entry in raw.get("pools") or []:
+        alias = (entry.get("model") or "").strip()
+        if not alias:
+            raise PoolConfigError("pool entry missing model alias")
+        deployments = [
+            Deployment(provider=(d.get("provider") or "").strip(), model=(d.get("model") or "").strip())
+            for d in entry.get("deployments") or []
+        ]
+        if len(deployments) < 2:
+            # Round-robin over <2 targets is a misconfiguration
+            # (pool.go:77).
+            raise PoolConfigError(f"pool {alias!r} needs at least 2 deployments")
+        for d in deployments:
+            if d.provider not in REGISTRY:
+                raise PoolConfigError(f"pool {alias!r} references unknown provider {d.provider!r}")
+            if not d.model:
+                raise PoolConfigError(f"pool {alias!r} has a deployment without a model")
+        pools[alias] = Pool(alias, deployments)
+    return pools
+
+
+class Selector:
+    """Round-robin alias selector (pool.go:68-105)."""
+
+    def __init__(self, pools: dict[str, Pool]):
+        self._pools = pools
+
+    def select(self, alias: str) -> Deployment | None:
+        pool = self._pools.get(alias)
+        return pool.next() if pool else None
+
+    def aliases(self) -> list[str]:
+        return list(self._pools)

@@ -1,0 +1,62 @@
+"""List-models response transformers.
+
+Capability parity with reference providers/transformers/ (16 files, all
+structurally identical — e.g. anthropic.go:14-28): normalize a provider's
+list-models response to the OpenAI list shape, stamping ``served_by`` and
+the ``provider/`` id prefix. One parameterized function replaces the
+generated per-provider types; provider quirks are table-driven.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from inference_gateway_tpu.providers.registry import REGISTRY
+
+# Providers whose list responses carry models under a non-standard key.
+_DATA_KEYS = {
+    "cohere": ("data", "models"),
+    "cloudflare": ("data", "result"),
+    "google": ("data", "models"),
+    "ollama": ("data", "models"),
+}
+_DEFAULT_KEYS = ("data",)
+
+# Model-name fields, in precedence order, per provider response dialect.
+_ID_FIELDS = ("id", "name", "model")
+
+
+def transform_list_models(provider_id: str, raw: dict[str, Any] | None) -> dict[str, Any]:
+    """Provider response → OpenAI ``ListModelsResponse`` dict."""
+    if provider_id not in REGISTRY:
+        raise KeyError(f"unknown provider {provider_id}")
+    raw = raw or {}
+    models_in: list[Any] = []
+    for key in _DATA_KEYS.get(provider_id, _DEFAULT_KEYS):
+        val = raw.get(key)
+        if isinstance(val, list):
+            models_in = val
+            break
+
+    models_out: list[dict[str, Any]] = []
+    for m in models_in:
+        if not isinstance(m, dict):
+            continue
+        model = dict(m)
+        mid = ""
+        for f in _ID_FIELDS:
+            if isinstance(model.get(f), str) and model[f]:
+                mid = model[f]
+                break
+        # Google publishes "models/gemini-..." resource names.
+        mid = mid.removeprefix("models/")
+        model["id"] = f"{provider_id}/{mid}"
+        model.setdefault("object", "model")
+        model["served_by"] = provider_id
+        models_out.append(model)
+
+    return {
+        "provider": provider_id,
+        "object": raw.get("object") or "list",
+        "data": models_out,
+    }

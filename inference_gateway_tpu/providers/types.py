@@ -1,0 +1,85 @@
+"""Provider-facing message/stream helpers.
+
+Capability parity with reference providers/types/toolcalls.go and
+message.go, operating on plain OpenAI-shape dicts (this framework keeps
+wire payloads as JSON dicts end to end instead of generated struct
+types — the schema source of truth lives in openapi.yaml).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def accumulate_streaming_tool_calls(body: str | bytes) -> list[dict[str, Any]]:
+    """Rebuild complete tool calls from an SSE stream body's per-chunk
+    deltas, indexed by position; nameless calls are dropped
+    (toolcalls.go:11-64)."""
+    if isinstance(body, bytes):
+        body = body.decode("utf-8", errors="replace")
+    accumulated: dict[int, dict[str, Any]] = {}
+
+    for line in body.split("\n"):
+        line = line.strip()
+        data = line[len("data: "):] if line.startswith("data: ") else line
+        if not data or data == "[DONE]":
+            continue
+        try:
+            chunk = json.loads(data)
+        except ValueError:
+            continue
+        choices = chunk.get("choices") or []
+        if not choices:
+            continue
+        deltas = (choices[0].get("delta") or {}).get("tool_calls")
+        if not deltas:
+            continue
+        for delta in deltas:
+            idx = delta.get("index", 0)
+            call = accumulated.setdefault(
+                idx, {"id": "", "type": "function", "function": {"name": "", "arguments": ""}}
+            )
+            if delta.get("id"):
+                call["id"] = delta["id"]
+            if delta.get("type"):
+                call["type"] = delta["type"]
+            fn = delta.get("function")
+            if fn:
+                if fn.get("name"):
+                    call["function"]["name"] = fn["name"]
+                if fn.get("arguments"):
+                    call["function"]["arguments"] += fn["arguments"]
+
+    out = []
+    for i in range(len(accumulated)):
+        call = accumulated.get(i)
+        if call and call["function"]["name"]:
+            out.append(call)
+    return out
+
+
+def has_image_content(message: dict[str, Any]) -> bool:
+    """True when the message's union content includes an image part
+    (message.go:5-21)."""
+    content = message.get("content")
+    if not isinstance(content, list):
+        return False
+    return any(isinstance(p, dict) and p.get("type") == "image_url" for p in content)
+
+
+def strip_image_content(message: dict[str, Any]) -> dict[str, Any]:
+    """Remove image parts, collapsing content per message.go:23-65:
+    0 text parts -> "", 1 -> the string, >1 -> list of text parts."""
+    content = message.get("content")
+    if not isinstance(content, list):
+        return message
+    text_parts = [p for p in content if isinstance(p, dict) and p.get("type") == "text"]
+    out = dict(message)
+    if len(text_parts) == 0:
+        out["content"] = ""
+    elif len(text_parts) == 1:
+        out["content"] = text_parts[0].get("text", "")
+    else:
+        out["content"] = text_parts
+    return out

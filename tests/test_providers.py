@@ -1,0 +1,214 @@
+"""Provider-layer unit tests (reference: tests/providers_test.go,
+providers/routing/*_test.go, providers/types/toolcalls_test.go)."""
+
+import json
+
+import pytest
+
+from inference_gateway_tpu.providers.context_window import (
+    apply_community_context_windows,
+    apply_provider_context_windows,
+)
+from inference_gateway_tpu.providers.pricing import apply_community_pricing, apply_provider_pricing
+from inference_gateway_tpu.providers.registry import REGISTRY, ProviderRegistry
+from inference_gateway_tpu.providers.routing import (
+    Selector,
+    determine_provider_and_model_name,
+    filter_models,
+    load_pools_config,
+    model_matches,
+    parse_model_set,
+)
+from inference_gateway_tpu.providers.transformers import transform_list_models
+from inference_gateway_tpu.providers.types import (
+    accumulate_streaming_tool_calls,
+    has_image_content,
+    strip_image_content,
+)
+
+
+# -- routing ----------------------------------------------------------------
+def test_determine_provider_and_model():
+    assert determine_provider_and_model_name("openai/gpt-4o") == ("openai", "gpt-4o")
+    assert determine_provider_and_model_name("tpu/llama-3-8b") == ("tpu", "llama-3-8b")
+    assert determine_provider_and_model_name("gpt-4o") == (None, "gpt-4o")
+    # Unknown prefix is not treated as a provider.
+    assert determine_provider_and_model_name("unknown/model") == (None, "unknown/model")
+    # No implicit name heuristics (model_mapping.go:19-31).
+    assert determine_provider_and_model_name("claude-3-opus") == (None, "claude-3-opus")
+
+
+def test_model_filtering():
+    models = [{"id": "openai/gpt-4o"}, {"id": "groq/llama3-8b-8192"}, {"id": "tpu/llama-3-8b"}]
+    assert filter_models(models, "", "") == models
+    out = filter_models(models, "gpt-4o", "")
+    assert [m["id"] for m in out] == ["openai/gpt-4o"]
+    out = filter_models(models, "", "openai/gpt-4o")
+    assert [m["id"] for m in out] == ["groq/llama3-8b-8192", "tpu/llama-3-8b"]
+    # Allow list wins over deny list.
+    out = filter_models(models, "tpu/llama-3-8b", "tpu/llama-3-8b")
+    assert [m["id"] for m in out] == ["tpu/llama-3-8b"]
+    # Case-insensitive, prefix-stripped.
+    assert model_matches(parse_model_set("GPT-4O"), "openai/gpt-4o")
+
+
+def test_pools(tmp_path):
+    cfg = tmp_path / "pools.yaml"
+    cfg.write_text(
+        """
+pools:
+  - model: fast
+    deployments:
+      - provider: groq
+        model: llama3-8b-8192
+      - provider: tpu
+        model: llama-3-8b
+"""
+    )
+    pools = load_pools_config(str(cfg))
+    sel = Selector(pools)
+    first = sel.select("fast")
+    second = sel.select("fast")
+    third = sel.select("fast")
+    assert {first.provider, second.provider} == {"groq", "tpu"}
+    assert third.provider == first.provider  # round robin wraps
+    assert sel.select("missing") is None
+
+
+def test_pool_requires_two_deployments(tmp_path):
+    cfg = tmp_path / "pools.yaml"
+    cfg.write_text(
+        """
+pools:
+  - model: solo
+    deployments:
+      - provider: groq
+        model: llama3-8b-8192
+"""
+    )
+    with pytest.raises(ValueError):
+        load_pools_config(str(cfg))
+
+
+# -- transformers -----------------------------------------------------------
+def test_transform_stamps_prefix_and_served_by():
+    raw = {"object": "list", "data": [{"id": "gpt-4o", "created": 1}]}
+    out = transform_list_models("openai", raw)
+    assert out["provider"] == "openai"
+    assert out["data"][0]["id"] == "openai/gpt-4o"
+    assert out["data"][0]["served_by"] == "openai"
+
+
+def test_transform_alt_shapes():
+    assert transform_list_models("cohere", {"models": [{"name": "command-r"}]})["data"][0]["id"] == "cohere/command-r"
+    out = transform_list_models("google", {"models": [{"name": "models/gemini-1.5-pro"}]})
+    assert out["data"][0]["id"] == "google/gemini-1.5-pro"
+    assert transform_list_models("openai", None)["data"] == []
+    assert transform_list_models("openai", {})["object"] == "list"
+
+
+def test_transform_every_registered_provider():
+    # Drift guard: every provider in the registry must transform
+    # (reference tests/provider_drift_test.go:31).
+    for pid in REGISTRY:
+        out = transform_list_models(pid, {"data": [{"id": "m1"}]})
+        assert out["provider"] == pid
+        assert out["data"][0]["id"] == f"{pid}/m1"
+        assert out["data"][0]["served_by"] == pid
+
+
+# -- tool call accumulation -------------------------------------------------
+def test_accumulate_streaming_tool_calls():
+    chunks = [
+        {"choices": [{"delta": {"tool_calls": [
+            {"index": 0, "id": "call_1", "type": "function", "function": {"name": "get_time", "arguments": ""}}]}}]},
+        {"choices": [{"delta": {"tool_calls": [
+            {"index": 0, "function": {"arguments": '{"tz":'}}]}}]},
+        {"choices": [{"delta": {"tool_calls": [
+            {"index": 0, "function": {"arguments": '"UTC"}'}}]}}]},
+        {"choices": [{"delta": {"tool_calls": [
+            {"index": 1, "id": "call_2", "function": {"name": "search", "arguments": "{}"}}]}}]},
+    ]
+    body = "\n".join("data: " + json.dumps(c) for c in chunks) + "\ndata: [DONE]\n"
+    calls = accumulate_streaming_tool_calls(body)
+    assert len(calls) == 2
+    assert calls[0]["id"] == "call_1"
+    assert calls[0]["function"]["name"] == "get_time"
+    assert calls[0]["function"]["arguments"] == '{"tz":"UTC"}'
+    assert calls[1]["function"]["name"] == "search"
+
+
+def test_accumulate_drops_nameless_and_garbage():
+    body = 'data: {"choices":[{"delta":{"tool_calls":[{"index":0,"id":"x","function":{"arguments":"{}"}}]}}]}\nnot json\n'
+    assert accumulate_streaming_tool_calls(body) == []
+
+
+# -- multimodal helpers -----------------------------------------------------
+def test_image_content_helpers():
+    msg = {"role": "user", "content": [
+        {"type": "text", "text": "what is this?"},
+        {"type": "image_url", "image_url": {"url": "data:image/png;base64,xxx"}},
+    ]}
+    assert has_image_content(msg)
+    stripped = strip_image_content(msg)
+    assert stripped["content"] == "what is this?"
+    assert not has_image_content(stripped)
+
+    plain = {"role": "user", "content": "hello"}
+    assert not has_image_content(plain)
+    assert strip_image_content(plain) == plain
+
+    only_img = {"role": "user", "content": [{"type": "image_url", "image_url": {"url": "u"}}]}
+    assert strip_image_content(only_img)["content"] == ""
+
+    two_text = {"role": "user", "content": [
+        {"type": "text", "text": "a"}, {"type": "image_url", "image_url": {"url": "u"}}, {"type": "text", "text": "b"},
+    ]}
+    assert strip_image_content(two_text)["content"] == [
+        {"type": "text", "text": "a"}, {"type": "text", "text": "b"},
+    ]
+
+
+# -- metadata tiers ---------------------------------------------------------
+def test_context_window_tiers():
+    raw = {"data": [{"id": "custom-model", "context_length": 4096}]}
+    models = [{"id": "llamacpp/custom-model", "served_by": "llamacpp"}]
+    apply_provider_context_windows(raw, models)
+    assert models[0]["context_window"] == 4096
+
+    models2 = [{"id": "openai/gpt-4o", "served_by": "openai"}]
+    apply_provider_context_windows({"data": [{"id": "gpt-4o"}]}, models2)
+    assert "context_window" not in models2[0]
+    apply_community_context_windows(models2)
+    assert models2[0]["context_window"] == 128000
+
+    # Provider tier beats community tier; existing values never clobbered.
+    models3 = [{"id": "openai/gpt-4o", "context_window": 1234}]
+    apply_community_context_windows(models3)
+    assert models3[0]["context_window"] == 1234
+
+
+def test_pricing_tiers():
+    raw = {"data": [{"id": "my-model", "pricing": {"prompt": 0.000001, "completion": "0.000002"}}]}
+    models = [{"id": "nvidia/my-model"}]
+    apply_provider_pricing(raw, models)
+    assert models[0]["pricing"] == {"prompt": "0.000001", "completion": "0.000002"}
+
+    models2 = [{"id": "openai/gpt-4o"}]
+    apply_community_pricing(models2)
+    assert models2[0]["pricing"]["prompt"] == "0.0000025"
+
+
+# -- registry ---------------------------------------------------------------
+def test_registry_build_provider_token_guard():
+    from inference_gateway_tpu.config import Config
+
+    cfg = Config.load({})
+    reg = ProviderRegistry(cfg.providers)
+    # auth none providers build without a token.
+    assert reg.build_provider("tpu", client=None).id == "tpu"
+    assert reg.build_provider("ollama", client=None).id == "ollama"
+    with pytest.raises(ValueError):
+        reg.build_provider("openai", client=None)
+    with pytest.raises(KeyError):
+        reg.build_provider("nope", client=None)
