@@ -1,0 +1,92 @@
+"""Runtime context-window resolution.
+
+Capability parity with reference api/context_window.go:21-182: fill each
+local-runtime model's effective context window by probing the runtime's
+admin API — llama.cpp ``/props`` (default_generation_settings.n_ctx),
+Ollama ``/api/show`` (num_ctx parameter or *.context_length model_info) —
+bounded at 4 concurrent lookups. The ``tpu`` sidecar speaks the llama.cpp
+``/props`` dialect (serving/server.py), making it a "runtime tier" source
+exactly like llama.cpp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+from urllib.parse import urlsplit
+
+MAX_RUNTIME_LOOKUPS = 4  # context_window.go:21
+RUNTIME_PROVIDERS = ("llamacpp", "ollama", "tpu")
+
+
+def _server_root(provider_url: str) -> str:
+    """Admin APIs live at the server root, outside the /v1 path prefix
+    (context_window.go:143-150)."""
+    s = urlsplit(provider_url)
+    return f"{s.scheme}://{s.netloc}"
+
+
+async def fetch_llamacpp_context_window(client, provider_url: str, timeout: float = 5.0) -> int:
+    resp = await client.get(_server_root(provider_url) + "/props", timeout=timeout)
+    if resp.status != 200:
+        raise ValueError(f"/props returned {resp.status}")
+    n_ctx = int(((resp.json().get("default_generation_settings") or {}).get("n_ctx")) or 0)
+    if n_ctx <= 0:
+        raise ValueError(f"no usable context size ({n_ctx})")
+    return n_ctx
+
+
+async def fetch_ollama_context_window(client, provider_url: str, model_id: str,
+                                      provider_id: str = "ollama", timeout: float = 5.0) -> int:
+    name = model_id.removeprefix(provider_id + "/")
+    body = json.dumps({"model": name}).encode()
+    resp = await client.post(_server_root(provider_url) + "/api/show", body,
+                             headers={"Content-Type": "application/json"}, timeout=timeout)
+    if resp.status != 200:
+        raise ValueError(f"/api/show returned {resp.status}")
+    show = resp.json()
+    for line in (show.get("parameters") or "").splitlines():
+        fields = line.split()
+        if len(fields) == 2 and fields[0] == "num_ctx":
+            try:
+                n = int(fields[1])
+                if n > 0:
+                    return n
+            except ValueError:
+                pass
+    for key, value in (show.get("model_info") or {}).items():
+        if key.endswith(".context_length") and isinstance(value, (int, float)) and value > 0:
+            return int(value)
+    raise ValueError(f"no context length for {name}")
+
+
+async def resolve_context_windows(client, providers_cfg: dict[str, Any],
+                                  models: list[dict[str, Any]], timeout: float = 5.0,
+                                  logger=None) -> None:
+    """Fill context_window on runtime-provider models, ≤4 concurrent
+    lookups (context_window.go:28-84). Mutates models in place; the
+    runtime tier overrides provider/community values."""
+    sem = asyncio.Semaphore(MAX_RUNTIME_LOOKUPS)
+
+    async def one(model: dict[str, Any]) -> None:
+        served_by = model.get("served_by", "")
+        if served_by not in RUNTIME_PROVIDERS:
+            return
+        cfg = providers_cfg.get(served_by)
+        if cfg is None:
+            return
+        url = cfg.url if hasattr(cfg, "url") else cfg.get("url", "")
+        async with sem:
+            try:
+                if served_by == "ollama":
+                    n = await fetch_ollama_context_window(client, url, model.get("id", ""), timeout=timeout)
+                else:  # llamacpp and tpu both speak /props
+                    n = await fetch_llamacpp_context_window(client, url, timeout=timeout)
+                model["context_window"] = n
+            except Exception as e:
+                if logger:
+                    logger.debug("runtime context window lookup failed",
+                                 "provider", served_by, "error", str(e))
+
+    await asyncio.gather(*(one(m) for m in models))

@@ -1,0 +1,5 @@
+from inference_gateway_tpu.api.middlewares.logger import logger_middleware
+from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware
+from inference_gateway_tpu.api.middlewares.auth import oidc_auth_middleware
+
+__all__ = ["logger_middleware", "telemetry_middleware", "oidc_auth_middleware"]

@@ -1,0 +1,46 @@
+"""Request logging middleware.
+
+Capability parity with reference api/middlewares/logger.go:25-68: one info
+line per request with method/path/status/duration; header values are
+redacted wholesale and sensitive query parameters masked before logging.
+"""
+
+from __future__ import annotations
+
+import time
+
+from inference_gateway_tpu.netio.server import Handler, Request, Response
+
+SENSITIVE_KEYS = ("key", "token", "secret", "password", "authorization", "api_key", "apikey")
+
+
+def is_sensitive_key(key: str) -> bool:
+    lk = key.lower()
+    return any(s in lk for s in SENSITIVE_KEYS)
+
+
+def sanitize_query(query: dict[str, list[str]]) -> dict[str, str]:
+    return {k: ("[REDACTED]" if is_sensitive_key(k) else ",".join(v)) for k, v in query.items()}
+
+
+def sanitize_headers(headers) -> dict[str, str]:
+    """All header values are redacted; only names are logged
+    (logger.go:36-47)."""
+    return {k: "[REDACTED]" for k, _ in headers.items()}
+
+
+def logger_middleware(logger):
+    async def middleware(req: Request, nxt: Handler) -> Response:
+        start = time.perf_counter()
+        resp = await nxt(req)
+        logger.info(
+            "request",
+            "method", req.method,
+            "path", req.path,
+            "status", resp.status,
+            "duration_ms", round((time.perf_counter() - start) * 1000, 2),
+            "query", sanitize_query(req.query),
+        )
+        return resp
+
+    return middleware

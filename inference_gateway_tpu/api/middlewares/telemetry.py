@@ -1,0 +1,166 @@
+"""Telemetry middleware.
+
+Capability parity with reference api/middlewares/telemetry.go:50-284:
+observes inference responses, parses token usage and tool calls out of
+both non-streaming JSON bodies and SSE streams (scanning only the last 4
+chunks of a stream for usage, telemetry.go:195-231), records the GenAI
+metrics, and enriches the active span with provider/model/error. For
+streams the middleware wraps the chunk iterator — a bounded ring of the
+most recent frames replaces the reference's 1 MiB body buffer.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any
+
+from inference_gateway_tpu.netio.server import Handler, Request, Response, StreamingResponse
+from inference_gateway_tpu.providers.routing import determine_provider_and_model_name
+
+INFERENCE_PATHS = ("/v1/chat/completions",)
+USAGE_SCAN_CHUNKS = 4  # telemetry.go:195
+MCP_TOOL_PREFIX = "mcp_"
+
+
+def classify_tool_type(name: str) -> str:
+    """``mcp_`` prefix ⇒ "mcp", else "provider" (telemetry.go:278-283)."""
+    return "mcp" if name.startswith(MCP_TOOL_PREFIX) else "provider"
+
+
+def _provider_and_model(req: Request) -> tuple[str, str]:
+    body = req.ctx.get("parsed_body")
+    if body is None:
+        try:
+            body = req.json()
+        except Exception:
+            body = {}
+    model = body.get("model", "") if isinstance(body, dict) else ""
+    provider = req.query_get("provider")
+    if not provider:
+        detected, _ = determine_provider_and_model_name(model)
+        provider = detected or ""
+    return provider, model
+
+
+def parse_usage(payload: dict[str, Any]) -> tuple[int, int] | None:
+    usage = payload.get("usage")
+    if not isinstance(usage, dict):
+        return None
+    return int(usage.get("prompt_tokens") or 0), int(usage.get("completion_tokens") or 0)
+
+
+def extract_tool_calls(message: dict[str, Any]) -> list[str]:
+    return [
+        tc.get("function", {}).get("name", "")
+        for tc in message.get("tool_calls") or []
+        if isinstance(tc, dict)
+    ]
+
+
+def telemetry_middleware(otel, logger=None, source: str = "gateway"):
+    async def middleware(req: Request, nxt: Handler) -> Response:
+        if req.method != "POST" or req.path not in INFERENCE_PATHS:
+            return await nxt(req)
+
+        provider, model = _provider_and_model(req)
+        team = req.headers.get("X-Team") or ""
+        start = time.perf_counter()
+        resp = await nxt(req)
+        span = req.ctx.get("span")
+        if span is not None:
+            span.set_attribute("gen_ai.provider.name", provider)
+            span.set_attribute("gen_ai.request.model", model)
+
+        def record(error_type: str, usage: tuple[int, int] | None, tool_names: list[str]) -> None:
+            otel.record_request_duration(
+                source, team, provider, model, error_type, time.perf_counter() - start
+            )
+            if usage:
+                otel.record_token_usage(source, team, provider, model, usage[0], usage[1])
+            for name in tool_names:
+                otel.record_tool_call(source, team, provider, model, classify_tool_type(name), name)
+            if error_type and span is not None:
+                span.set_status("ERROR", error_type)
+                span.set_attribute("error.type", error_type)
+
+        if isinstance(resp, StreamingResponse) and resp.chunks is not None:
+            inner = resp.chunks
+            ring: deque[bytes] = deque(maxlen=USAGE_SCAN_CHUNKS)
+
+            async def observed():
+                try:
+                    async for chunk in inner:
+                        if chunk.strip():
+                            ring.append(chunk)
+                        yield chunk
+                finally:
+                    usage = None
+                    tool_names: list[str] = []
+                    for raw in ring:
+                        for line in raw.split(b"\n"):
+                            line = line.strip()
+                            if not line.startswith(b"data:"):
+                                continue
+                            data = line[5:].strip()
+                            if not data or data == b"[DONE]":
+                                continue
+                            try:
+                                payload = json.loads(data)
+                            except ValueError:
+                                continue
+                            usage = parse_usage(payload) or usage
+                            for choice in payload.get("choices") or []:
+                                delta = choice.get("delta") or {}
+                                for tc in delta.get("tool_calls") or []:
+                                    name = (tc.get("function") or {}).get("name")
+                                    if name:
+                                        tool_names.append(name)
+                    record("", usage, tool_names)
+
+            resp.chunks = observed()
+            return resp
+
+        error_type = str(resp.status) if resp.status >= 400 else ""
+        usage = None
+        tool_names: list[str] = []
+        if resp.status == 200 and resp.body:
+            try:
+                payload = json.loads(resp.body)
+                usage = parse_usage(payload)
+                for choice in payload.get("choices") or []:
+                    msg = choice.get("message") or {}
+                    tool_names.extend(n for n in extract_tool_calls(msg) if n)
+            except ValueError:
+                pass
+        record(error_type, usage, tool_names)
+        return resp
+
+    return middleware
+
+
+def tracing_middleware(tracer, skip_paths: tuple[str, ...] = ("/health", "/v1/metrics")):
+    """otelgin equivalent: span per request, honoring inbound traceparent
+    (cmd/gateway/main.go:238-243)."""
+
+    async def middleware(req: Request, nxt: Handler) -> Response:
+        if req.path in skip_paths:
+            return await nxt(req)
+        span = tracer.start_span(
+            f"{req.method} {req.path}", traceparent=req.headers.get("traceparent")
+        )
+        span.set_attribute("http.request.method", req.method)
+        span.set_attribute("url.path", req.path)
+        req.ctx["span"] = span
+        req.ctx["traceparent"] = span.traceparent()
+        try:
+            resp = await nxt(req)
+            span.set_attribute("http.response.status_code", resp.status)
+            if resp.status >= 500:
+                span.set_status("ERROR", str(resp.status))
+            return resp
+        finally:
+            tracer.end_span(span)
+
+    return middleware

@@ -1,0 +1,507 @@
+"""Gateway HTTP handlers.
+
+Capability parity with reference api/routes.go:40-1053 — the 8-endpoint
+router: ListModels (fan-out + metadata enrichment), ChatCompletions
+(selector → provider resolution → allow/deny → vision gate → provider
+call with SSE relay), Messages (Anthropic passthrough, no loopback),
+ListTools (MCP), MetricsIngestion (OTLP push), Proxy (auth attachment +
+streaming relay), Healthcheck, NotFound.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import json
+from typing import Any
+
+from inference_gateway_tpu.api.context_window import resolve_context_windows
+from inference_gateway_tpu.config import Config
+from inference_gateway_tpu.logger import Logger, new_logger
+from inference_gateway_tpu.netio.client import HTTPClient, HTTPClientError
+from inference_gateway_tpu.netio.server import (
+    Handler,
+    Headers,
+    Request,
+    Response,
+    Router,
+    StreamingResponse,
+)
+from inference_gateway_tpu.providers import constants, routing
+from inference_gateway_tpu.providers.core import HTTPError
+from inference_gateway_tpu.providers.registry import (
+    ProviderConfig,
+    ProviderNotConfiguredError,
+    ProviderNotFoundError,
+    ProviderRegistry,
+)
+from inference_gateway_tpu.providers.types import has_image_content, strip_image_content
+
+MAX_BODY_SIZE = 10 << 20  # routes.go:137
+MAX_METRICS_BODY = 4 << 20  # api/metrics.go:15
+INCLUDE_KEYS = ("context_window", "pricing")
+
+
+def error_json(message: str, status: int) -> Response:
+    return Response.json({"error": message}, status=status)
+
+
+def messages_error(status: int, err_type: str, message: str) -> Response:
+    """Anthropic error envelope (routes.go:788-793)."""
+    return Response.json(
+        {"type": "error", "error": {"type": err_type, "message": message}}, status=status
+    )
+
+
+class RouterImpl:
+    """All gateway endpoints (routes.go:52-67 constructor wiring)."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        registry: ProviderRegistry,
+        client: HTTPClient,
+        logger: Logger | None = None,
+        otel=None,
+        mcp_client=None,
+        mcp_agent=None,
+        selector: routing.Selector | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.registry = registry
+        self.client = client
+        self.logger = logger or new_logger()
+        self.otel = otel
+        self.mcp_client = mcp_client
+        self.mcp_agent = mcp_agent
+        self.selector = selector
+
+    # -- wiring --------------------------------------------------------
+    def build_router(self) -> Router:
+        """Route table (cmd/gateway/main.go:256-266)."""
+        r = Router()
+        r.get("/health", self.healthcheck_handler)
+        r.get("/v1/models", self.list_models_handler)
+        r.post("/v1/chat/completions", self.chat_completions_handler)
+        r.post("/v1/messages", self.messages_handler)
+        r.get("/v1/mcp/tools", self.list_tools_handler)
+        r.post("/v1/metrics", self.metrics_ingestion_handler)
+        r.add("GET", "/proxy/:provider/*path", self.proxy_handler)
+        r.add("POST", "/proxy/:provider/*path", self.proxy_handler)
+        r.not_found = self.not_found_handler
+        return r
+
+    # -- helpers -------------------------------------------------------
+    def _build_provider(self, provider_id: str):
+        return self.registry.build_provider(provider_id, self.client)
+
+    def _provider_error(self, e: Exception, provider_id: str, envelope=error_json) -> Response:
+        if isinstance(e, ProviderNotConfiguredError):
+            self.logger.error("provider requires an api key but none configured", e, "provider", provider_id)
+            return envelope("Provider requires an API key. Please configure the provider's API key.", 400)
+        self.logger.error("provider not found or not supported", e, "provider", provider_id)
+        return envelope("Provider not found. Please check the list of supported providers.", 400)
+
+    # -- handlers ------------------------------------------------------
+    async def healthcheck_handler(self, req: Request) -> Response:
+        return Response.json({"message": "OK"})
+
+    async def not_found_handler(self, req: Request) -> Response:
+        self.logger.warn("route not found", "path", req.path, "method", req.method)
+        return error_json("Requested route is not found", 404)
+
+    # ------------------------------------------------------------------
+    async def list_models_handler(self, req: Request) -> Response:
+        """GET /v1/models (routes.go:435-540)."""
+        include_raw = req.query_get("include")
+        include_keys: list[str] = []
+        if include_raw.strip():
+            for part in include_raw.split(","):
+                key = part.strip()
+                if not key:
+                    continue
+                if key not in INCLUDE_KEYS:
+                    return error_json(f"unknown include value {key!r}", 400)
+                if key not in include_keys:
+                    include_keys.append(key)
+
+        ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
+        provider_id = req.query_get("provider")
+        if provider_id:
+            try:
+                provider = self._build_provider(provider_id)
+            except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
+                return self._provider_error(e, provider_id)
+            try:
+                response = await asyncio.wait_for(
+                    provider.list_models(ctx), timeout=self.cfg.server.read_timeout
+                )
+            except asyncio.TimeoutError:
+                return error_json("Request timed out", 504)
+            except (HTTPError, HTTPClientError) as e:
+                self.logger.error("failed to list models", e, "provider", provider_id)
+                return error_json("Failed to list models", 502)
+            models = routing.filter_models(
+                response["data"], self.cfg.allowed_models, self.cfg.disallowed_models
+            )
+            response["data"] = models
+        else:
+            # Parallel fan-out across all configured providers
+            # (routes.go:480-517); per-provider failures are skipped.
+            async def fetch(pid: str) -> list[dict[str, Any]]:
+                try:
+                    provider = self._build_provider(pid)
+                    result = await provider.list_models(ctx)
+                    return result["data"]
+                except Exception as e:
+                    self.logger.error("failed to list models", e, "provider", pid)
+                    return []
+
+            provider_ids = list(self.registry.get_providers())
+            results = await asyncio.wait_for(
+                asyncio.gather(*(fetch(pid) for pid in provider_ids)),
+                timeout=self.cfg.server.read_timeout,
+            )
+            models = [m for sub in results for m in sub]
+            models = routing.filter_models(models, self.cfg.allowed_models, self.cfg.disallowed_models)
+            response = {"object": "list", "data": models}
+            response.pop("provider", None)
+
+        if "context_window" in include_keys:
+            await resolve_context_windows(
+                self.client, self.registry.get_providers(), response["data"], logger=self.logger
+            )
+        return self._render_models_response(response, include_keys)
+
+    def _render_models_response(self, response: dict[str, Any], include_keys: list[str]) -> Response:
+        """Explicit nulls for requested-but-missing keys; strip
+        non-requested metadata (routes.go:355-403)."""
+        for model in response["data"]:
+            for key in INCLUDE_KEYS:
+                if key not in include_keys:
+                    model.pop(key, None)
+                elif key not in model:
+                    model[key] = None
+        return Response.json(response)
+
+    # ------------------------------------------------------------------
+    async def chat_completions_handler(self, req: Request) -> Response:
+        """POST /v1/chat/completions (routes.go:596-782)."""
+        body = req.ctx.get("parsed_body")
+        if body is None:
+            try:
+                body = req.json()
+            except (ValueError, UnicodeDecodeError):
+                return error_json("Failed to decode request", 400)
+        if not isinstance(body, dict):
+            return error_json("Failed to decode request", 400)
+
+        original_model = body.get("model") or ""
+        model = original_model
+        provider_id = req.query_get("provider")
+        routed: routing.Deployment | None = None
+
+        if self.selector is not None and not provider_id:
+            routed = self.selector.select(model)
+            if routed is not None:
+                provider_id = routed.provider
+                model = routed.model
+                self.logger.debug("routed logical model", "alias", original_model,
+                                  "provider", routed.provider, "model", routed.model)
+
+        if not provider_id:
+            detected, model = routing.determine_provider_and_model_name(model)
+            if detected is None:
+                return error_json(
+                    "Unable to determine provider for model. Please specify a provider "
+                    "using the ?provider= query parameter or use the provider/model "
+                    "format (e.g., openai/gpt-4).", 400)
+            provider_id = detected
+
+        body = dict(body)
+        body["model"] = model
+
+        # Allow/deny checks use the original (possibly prefixed) id
+        # (routes.go:641-653).
+        if self.cfg.allowed_models:
+            if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
+                return error_json("Model not allowed. Please check the list of allowed models.", 403)
+        elif self.cfg.disallowed_models:
+            if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
+                return error_json("Model is disallowed. Please use a different model.", 403)
+
+        try:
+            provider = self._build_provider(provider_id)
+        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
+            return self._provider_error(e, provider_id)
+
+        # Vision gate (routes.go:670-706).
+        if self.cfg.enable_vision:
+            messages = body.get("messages") or []
+            if any(has_image_content(m) for m in messages if isinstance(m, dict)):
+                if not provider.supports_vision(model):
+                    self.logger.info("filtering images from non-vision model request",
+                                     "provider", provider_id, "model", model)
+                    body["messages"] = [
+                        strip_image_content(m) if isinstance(m, dict) else m for m in messages
+                    ]
+
+        ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
+        headers_extra = {}
+        if routed is not None:
+            headers_extra = {"X-Selected-Provider": routed.provider, "X-Selected-Model": routed.model}
+
+        if body.get("stream"):
+            try:
+                stream = await provider.stream_chat_completions(body, ctx)
+            except HTTPError as e:
+                return error_json(e.message, e.status_code)
+            except HTTPClientError as e:
+                return error_json(str(e), 502)
+            resp = StreamingResponse.sse(stream)
+            for k, v in headers_extra.items():
+                resp.headers.set(k, v)
+            return resp
+
+        try:
+            result = await asyncio.wait_for(
+                provider.chat_completions(body, ctx), timeout=self.cfg.server.read_timeout
+            )
+        except asyncio.TimeoutError:
+            return error_json("Request timed out", 504)
+        except HTTPError as e:
+            return error_json(e.message, e.status_code)
+        except HTTPClientError as e:
+            return error_json(str(e), 502)
+        resp = Response.json(result)
+        for k, v in headers_extra.items():
+            resp.headers.set(k, v)
+        return resp
+
+    # ------------------------------------------------------------------
+    async def messages_handler(self, req: Request) -> Response:
+        """POST /v1/messages — Anthropic passthrough, no loopback hop
+        (routes.go:808-980)."""
+        if len(req.body) >= MAX_BODY_SIZE:
+            return messages_error(413, "invalid_request_error", "Request body too large")
+        try:
+            parsed = json.loads(req.body)
+        except ValueError:
+            return messages_error(400, "invalid_request_error", "Failed to decode request")
+
+        original_model = parsed.get("model") or ""
+        model = original_model
+        provider_id = req.query_get("provider")
+        if not provider_id:
+            detected, model = routing.determine_provider_and_model_name(model)
+            if detected is None:
+                return messages_error(
+                    400, "invalid_request_error",
+                    "Unable to determine provider for model. Please specify a provider using "
+                    "the ?provider= query parameter or use the provider/model format "
+                    "(e.g., anthropic/claude-sonnet-4-5).")
+            provider_id = detected
+
+        if self.cfg.allowed_models:
+            if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
+                return messages_error(403, "invalid_request_error",
+                                      "Model not allowed. Please check the list of allowed models.")
+        elif self.cfg.disallowed_models:
+            if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
+                return messages_error(403, "invalid_request_error",
+                                      "Model is disallowed. Please use a different model.")
+
+        if provider_id != constants.ANTHROPIC_ID:
+            return messages_error(400, "not_supported_error",
+                                  "The Messages API is not supported by this provider yet.")
+
+        try:
+            provider = self._build_provider(provider_id)
+        except ProviderNotConfiguredError:
+            return messages_error(400, "invalid_request_error",
+                                  "Provider requires an API key. Please configure the provider's API key.")
+        except ProviderNotFoundError:
+            return messages_error(400, "invalid_request_error",
+                                  "Provider not found. Please check the list of supported providers.")
+
+        body = req.body
+        if model != original_model:
+            # Byte-for-byte passthrough except the model rewrite
+            # (routes.go:884-899).
+            parsed["model"] = model
+            body = json.dumps(parsed).encode()
+
+        is_streaming = bool(parsed.get("stream"))
+        upstream_url = provider.cfg.url.rstrip("/") + "/messages"
+        headers = Headers()
+        headers.set("Content-Type", "application/json")
+        headers.set("Accept", "text/event-stream" if is_streaming else "application/json")
+        apply_provider_auth(headers, provider.cfg, None)
+        if req.ctx.get("traceparent"):
+            headers.set("traceparent", req.ctx["traceparent"])
+
+        try:
+            resp = await self.client.post(
+                upstream_url, body, headers=headers, stream=is_streaming,
+                timeout=None if is_streaming else self.cfg.server.read_timeout,
+            )
+        except HTTPClientError as e:
+            self.logger.error("failed to reach upstream server", e, "url", upstream_url)
+            return messages_error(502, "api_error", "Failed to reach upstream server")
+
+        content_type = resp.headers.get("Content-Type") or ""
+        if not is_streaming or not content_type.startswith("text/event-stream"):
+            if is_streaming:
+                chunks = b""
+                async for line in resp.iter_lines():
+                    chunks += line
+                body_out = chunks
+            else:
+                body_out = resp.body
+            out = Response(status=resp.status, body=body_out)
+            out.headers.set("Content-Type", content_type or "application/json")
+            return out
+
+        async def relay():
+            async for line in resp.iter_lines():
+                yield line
+
+        return StreamingResponse.sse(relay())
+
+    # ------------------------------------------------------------------
+    async def list_tools_handler(self, req: Request) -> Response:
+        """GET /v1/mcp/tools (routes.go:1005-1053)."""
+        if not self.cfg.mcp.expose:
+            return error_json("mcp tools endpoint is not exposed", 403)
+        tools: list[dict[str, Any]] = []
+        client = self.mcp_client
+        if client is not None and client.is_initialized():
+            for server_url in client.get_servers():
+                try:
+                    for tool in client.get_server_tools(server_url):
+                        tools.append({
+                            "name": "mcp_" + tool.get("name", ""),
+                            "description": tool.get("description", ""),
+                            "server": server_url,
+                            "input_schema": tool.get("inputSchema") or tool.get("input_schema"),
+                        })
+                except Exception as e:
+                    self.logger.error("failed to get tools from mcp server", e, "server", server_url)
+        return Response.json({"object": "list", "data": tools})
+
+    # ------------------------------------------------------------------
+    async def metrics_ingestion_handler(self, req: Request) -> Response:
+        """POST /v1/metrics — OTLP push ingest, JSON encoding, gzip-aware
+        (api/metrics.go:25-99)."""
+        if self.otel is None:
+            return error_json("metrics push endpoint is not enabled", 403)
+        body = req.body
+        if len(body) > MAX_METRICS_BODY:
+            return error_json("Request body too large", 413)
+        if (req.headers.get("Content-Encoding") or "").lower() == "gzip":
+            try:
+                body = gzip.decompress(body)
+            except OSError:
+                return error_json("invalid gzip body", 400)
+            if len(body) > MAX_METRICS_BODY:
+                return error_json("Request body too large", 413)
+        content_type = (req.headers.get("Content-Type") or "").split(";")[0].strip()
+        if content_type == "application/x-protobuf":
+            # Binary OTLP is accepted but decoded by the protobuf sidecar
+            # codec; JSON is the gateway-native encoding.
+            return error_json("protobuf OTLP is not supported; send application/json", 415)
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return error_json("invalid OTLP JSON payload", 400)
+
+        source = req.headers.get("X-Source") or ""
+        result = self.otel.ingest_metrics(payload, source)
+        response: dict[str, Any] = {}
+        if result["rejected"]:
+            response["partialSuccess"] = {
+                "rejectedDataPoints": result["rejected"],
+                "errorMessage": result.get("error_message", ""),
+            }
+        return Response.json(response)
+
+    # ------------------------------------------------------------------
+    async def proxy_handler(self, req: Request) -> Response:
+        """/proxy/:provider/*path — attach provider auth, forward
+        (routes.go:94-268)."""
+        provider_id = req.params.get("provider", "")
+        try:
+            provider = self._build_provider(provider_id)
+        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
+            return self._provider_error(e, provider_id)
+
+        headers = Headers(req.headers.items())
+        headers.remove("Host")
+        headers.remove("Content-Length")
+        headers.remove("Connection")
+        try:
+            query = apply_provider_auth(headers, provider.cfg, req.query)
+        except ValueError:
+            return error_json("Unsupported auth type", 422)
+        if req.ctx.get("traceparent"):
+            headers.set("traceparent", req.ctx["traceparent"])
+
+        base = provider.cfg.url.rstrip("/")
+        path = req.params.get("path", "/")
+        url = base + "/" + path.lstrip("/")
+        if query:
+            url += "?" + "&".join(f"{k}={v}" for k, vs in query.items() for v in vs)
+
+        accept = req.headers.get("Accept") or ""
+        content_type = req.headers.get("Content-Type") or ""
+        is_streaming = accept == "text/event-stream" or content_type == "text/event-stream"
+
+        if len(req.body) >= MAX_BODY_SIZE:
+            return error_json("Request body too large", 413)
+
+        try:
+            resp = await self.client.request(
+                req.method, url, headers=headers, body=req.body, stream=is_streaming,
+                timeout=None if is_streaming else self.cfg.client.timeout,
+            )
+        except HTTPClientError as e:
+            self.logger.error("failed to reach upstream server", e, "url", url)
+            return error_json(f"Failed to reach upstream server: {e}", 502)
+
+        if is_streaming and resp.status == 200:
+            async def relay():
+                async for line in resp.iter_lines():
+                    yield line
+
+            return StreamingResponse.sse(relay())
+
+        if is_streaming:
+            body_out = b""
+            async for line in resp.iter_lines():
+                body_out += line
+        else:
+            body_out = resp.body
+        out = Response(status=resp.status, body=body_out)
+        out.headers.set("Content-Type", resp.headers.get("Content-Type") or "application/json")
+        return out
+
+
+def apply_provider_auth(headers: Headers, cfg: ProviderConfig,
+                        query: dict[str, list[str]] | None) -> dict[str, list[str]]:
+    """Attach the provider credential per auth type (routes.go:271-294).
+    Returns the (possibly augmented) query dict for query-auth providers."""
+    query = dict(query or {})
+    if cfg.auth_type == constants.AUTH_TYPE_BEARER:
+        headers.set("Authorization", f"Bearer {cfg.token}")
+    elif cfg.auth_type == constants.AUTH_TYPE_XHEADER:
+        headers.set("x-api-key", cfg.token)
+    elif cfg.auth_type == constants.AUTH_TYPE_QUERY:
+        query["key"] = [cfg.token]
+    elif cfg.auth_type == constants.AUTH_TYPE_NONE:
+        pass
+    else:
+        raise ValueError(f"unsupported auth type {cfg.auth_type!r}")
+    for key, values in cfg.extra_headers.items():
+        for value in values:
+            headers.add(key, value)
+    return query

@@ -1,0 +1,210 @@
+"""Gateway assembly and lifecycle.
+
+Capability parity with reference cmd/gateway/main.go:36-344: config load →
+logger → otel init (+ dedicated metrics listener on
+TELEMETRY_METRICS_PORT) → middleware chain (tracing → logger → telemetry →
+auth → mcp; order fixed, MCP last so it sees the authenticated, measured
+request) → self-addressing HTTP client → provider registry → MCP
+client/agent → routing selector → router → API server, with an async
+startup provider-validation pass and graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+from dataclasses import dataclass, field
+from typing import Any
+
+from inference_gateway_tpu.api.middlewares.auth import OIDCAuthenticator, oidc_auth_middleware
+from inference_gateway_tpu.api.middlewares.logger import logger_middleware
+from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware, tracing_middleware
+from inference_gateway_tpu.api.routes import RouterImpl, Response
+from inference_gateway_tpu.config import Config
+from inference_gateway_tpu.logger import Logger, new_logger
+from inference_gateway_tpu.netio.client import ClientConfig, HTTPClient
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Router
+from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.providers import routing
+from inference_gateway_tpu.providers.registry import ProviderRegistry
+from inference_gateway_tpu.version import APPLICATION_NAME, VERSION
+
+
+@dataclass
+class Gateway:
+    """A fully-wired gateway instance plus its listeners."""
+
+    cfg: Config
+    logger: Logger
+    otel: OpenTelemetry | None
+    registry: ProviderRegistry
+    client: HTTPClient
+    router_impl: RouterImpl
+    api_server: HTTPServer
+    metrics_server: HTTPServer | None = None
+    mcp_client: Any = None
+    port: int = 0
+    metrics_port: int = 0
+    _tasks: list[asyncio.Task] = field(default_factory=list)
+
+    async def start(self, host: str | None = None, port: int | None = None) -> int:
+        host = host or self.cfg.server.host
+        port = int(port if port is not None else self.cfg.server.port)
+        if self.metrics_server is not None:
+            self.metrics_port = await self.metrics_server.start(
+                host, int(self.cfg.telemetry.metrics_port)
+            )
+            self.logger.info("metrics server listening", "port", self.metrics_port)
+        if self.mcp_client is not None:
+            await self.mcp_client.initialize_all()
+            self.mcp_client.start_status_polling()
+        self.port = await self.api_server.start(
+            host, port, self.cfg.server.tls_cert_path, self.cfg.server.tls_key_path
+        )
+        # Self-addressing: the provider loopback hop targets this listener
+        # (main.go:167, client.go:66-75).
+        self.client.self_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        self.client.self_port = self.port
+        self.logger.info("gateway listening", "app", APPLICATION_NAME, "version", VERSION,
+                         "host", host, "port", self.port)
+        self._tasks.append(asyncio.create_task(self._validate_providers()))
+        return self.port
+
+    async def _validate_providers(self) -> None:
+        """Async startup validation: log-only ListModels per configured
+        provider (main.go:295-324)."""
+        for pid, pcfg in self.registry.get_providers().items():
+            if pcfg.auth_type != "none" and not pcfg.token:
+                continue
+            try:
+                provider = self.registry.build_provider(pid, self.client)
+                await asyncio.wait_for(provider.list_models(), timeout=10.0)
+                self.logger.info("provider validated", "provider", pid)
+            except Exception as e:
+                self.logger.warn("provider validation failed", "provider", pid, "error", str(e))
+
+    async def shutdown(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self.mcp_client is not None:
+            await self.mcp_client.shutdown()
+        await self.api_server.shutdown()
+        if self.metrics_server is not None:
+            await self.metrics_server.shutdown()
+        self.logger.info("gateway stopped")
+
+
+def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
+                  logger: Logger | None = None, mcp_client=None, mcp_agent=None) -> Gateway:
+    if cfg is None:
+        cfg = Config.load(env, logger=logger)
+    logger = logger or new_logger(cfg.environment)
+
+    otel = None
+    metrics_server = None
+    if cfg.telemetry.enable:
+        otel = OpenTelemetry(
+            environment=cfg.environment,
+            tracing_enable=cfg.telemetry.tracing_enable,
+            tracing_otlp_endpoint=cfg.telemetry.tracing_otlp_endpoint,
+            logger=logger,
+        )
+
+        async def prometheus_handler(req: Request) -> Response:
+            return Response.text(otel.expose_prometheus(), content_type="text/plain; version=0.0.4")
+
+        metrics_router = Router()
+        metrics_router.get("/metrics", prometheus_handler)
+        metrics_server = HTTPServer(metrics_router, logger=logger)
+
+    client = HTTPClient(
+        ClientConfig(
+            timeout=cfg.client.timeout,
+            max_idle_conns_per_host=cfg.client.max_idle_conns_per_host,
+            idle_conn_timeout=cfg.client.idle_conn_timeout,
+            disable_compression=cfg.client.disable_compression,
+        ),
+        self_host="127.0.0.1",
+        self_port=int(cfg.server.port),
+    )
+    registry = ProviderRegistry(cfg.providers, logger=logger)
+
+    selector = None
+    if cfg.routing.enabled:
+        if not cfg.routing.config_path:
+            raise ValueError("ROUTING_CONFIG_PATH is required when ROUTING_ENABLED is true")
+        pools = routing.load_pools_config(cfg.routing.config_path)
+        selector = routing.Selector(pools)
+        logger.info("routing pools loaded", "aliases", selector.aliases())
+
+    # MCP subsystem (main.go:181-213).
+    if mcp_client is None and cfg.mcp.enable and cfg.mcp.servers:
+        from inference_gateway_tpu.mcp.agent import Agent
+        from inference_gateway_tpu.mcp.client import MCPClient
+
+        mcp_client = MCPClient(cfg.mcp, client, logger=logger)
+        mcp_agent = Agent(mcp_client, logger=logger, otel=otel)
+
+    router_impl = RouterImpl(
+        cfg, registry, client, logger=logger, otel=otel,
+        mcp_client=mcp_client, mcp_agent=mcp_agent, selector=selector,
+    )
+
+    # Middleware order matters (main.go:238-254): tracing → logger →
+    # telemetry → auth → mcp. MCP must be last.
+    middlewares = []
+    if otel is not None and cfg.telemetry.tracing_enable:
+        middlewares.append(tracing_middleware(otel.tracer))
+    middlewares.append(logger_middleware(logger))
+    if otel is not None:
+        middlewares.append(telemetry_middleware(otel, logger))
+    authenticator = None
+    if cfg.auth.enable:
+        authenticator = OIDCAuthenticator(
+            cfg.auth.oidc_issuer, cfg.auth.oidc_client_id, client, logger=logger
+        )
+    middlewares.append(oidc_auth_middleware(authenticator, logger))
+    if mcp_client is not None and mcp_agent is not None:
+        from inference_gateway_tpu.api.middlewares.mcp import mcp_middleware
+
+        middlewares.append(mcp_middleware(mcp_client, mcp_agent, registry, client, cfg, logger))
+
+    api_server = HTTPServer(
+        router_impl.build_router(),
+        middlewares=middlewares,
+        read_timeout=cfg.server.read_timeout,
+        write_timeout=cfg.server.write_timeout,
+        idle_timeout=cfg.server.idle_timeout,
+        logger=logger,
+    )
+
+    return Gateway(
+        cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
+        router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
+        mcp_client=mcp_client,
+    )
+
+
+async def run() -> None:
+    """Run until SIGINT/SIGTERM (main.go:326-343)."""
+    gw = build_gateway()
+    await gw.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await asyncio.wait_for(gw.shutdown(), timeout=5.0)
+
+
+def main() -> None:
+    import sys
+
+    if "--version" in sys.argv:
+        print(f"{APPLICATION_NAME} {VERSION}")
+        return
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

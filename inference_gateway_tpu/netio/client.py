@@ -107,18 +107,21 @@ class HTTPClient:
         self._pool_lock = asyncio.Lock()
 
     # -- pool ----------------------------------------------------------
-    async def _connect(self, scheme: str, host: str, port: int):
-        async with self._pool_lock:
-            conns = self._pool.get((scheme, host, port))
-            while conns:
-                reader, writer = conns.pop()
-                if not writer.is_closing():
-                    return reader, writer
+    async def _connect(self, scheme: str, host: str, port: int, fresh: bool = False):
+        """Returns (reader, writer, pooled). ``fresh`` bypasses the pool."""
+        if not fresh:
+            async with self._pool_lock:
+                conns = self._pool.get((scheme, host, port))
+                while conns:
+                    reader, writer = conns.pop()
+                    if not writer.is_closing():
+                        return reader, writer, True
         ssl_ctx = None
         if scheme == "https":
             ssl_ctx = ssl.create_default_context()
             ssl_ctx.minimum_version = ssl.TLSVersion.TLSv1_2
-        return await asyncio.open_connection(host, port, ssl=ssl_ctx)
+        reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
+        return reader, writer, False
 
     async def _release(self, scheme: str, host: str, port: int, reader, writer, reusable: bool):
         if not reusable or writer.is_closing():
@@ -163,18 +166,24 @@ class HTTPClient:
         if "Connection" not in hdrs:
             hdrs.set("Connection", "keep-alive")
 
-        reader, writer = await self._connect(scheme, host, port)
-        try:
-            head = f"{method.upper()} {path} HTTP/1.1\r\n" + "".join(
-                f"{k}: {v}\r\n" for k, v in hdrs.items()
-            ) + "\r\n"
-            writer.write(head.encode("latin-1") + body)
-            await asyncio.wait_for(writer.drain(), timeout=timeout)
+        head = f"{method.upper()} {path} HTTP/1.1\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in hdrs.items()
+        ) + "\r\n"
 
-            status_blob = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=timeout)
-        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
-            writer.close()
-            raise HTTPClientError(f"{type(e).__name__} talking to {host}:{port}") from e
+        # A pooled connection may have been closed by the peer; retry once
+        # on a fresh connection if it dies before the status line arrives.
+        for attempt in (0, 1):
+            reader, writer, pooled = await self._connect(scheme, host, port, fresh=attempt > 0)
+            try:
+                writer.write(head.encode("latin-1") + body)
+                await asyncio.wait_for(writer.drain(), timeout=timeout)
+                status_blob = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=timeout)
+                break
+            except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+                writer.close()
+                if pooled and attempt == 0 and not isinstance(e, asyncio.TimeoutError):
+                    continue
+                raise HTTPClientError(f"{type(e).__name__} talking to {host}:{port}") from e
 
         lines = status_blob.decode("latin-1").split("\r\n")
         try:

@@ -1,0 +1,3 @@
+from inference_gateway_tpu.otel.otel import OpenTelemetry, NoopTelemetry
+
+__all__ = ["OpenTelemetry", "NoopTelemetry"]

@@ -1,0 +1,146 @@
+"""Lightweight metrics core: counters + explicit-bucket histograms with
+labels, and Prometheus text exposition.
+
+The TPU-native stand-in for the reference's otel-SDK meter provider +
+Prometheus exporter (otel/otel.go:85-135): same instrument semantics
+(delta-free cumulative counters, explicit bucket histograms with semconv
+boundaries) without external dependencies.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass, field
+
+LabelValues = tuple[str, ...]
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _sanitize_name(name: str) -> str:
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+@dataclass
+class Counter:
+    name: str
+    description: str
+    label_names: tuple[str, ...]
+    unit: str = ""
+    _values: dict[LabelValues, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def add(self, value: float, labels: dict[str, str] | None = None) -> None:
+        key = tuple((labels or {}).get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def collect(self) -> str:
+        pname = _sanitize_name(self.name)
+        out = [f"# HELP {pname} {self.description}", f"# TYPE {pname} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, val in items:
+            labels = ",".join(
+                f'{_sanitize_name(n)}="{_escape(v)}"' for n, v in zip(self.label_names, key) if v
+            )
+            out.append(f"{pname}{{{labels}}} {val:g}" if labels else f"{pname} {val:g}")
+        return "\n".join(out)
+
+
+@dataclass
+class Histogram:
+    name: str
+    description: str
+    label_names: tuple[str, ...]
+    boundaries: tuple[float, ...]
+    unit: str = ""
+    _counts: dict[LabelValues, list[int]] = field(default_factory=dict)
+    _sums: dict[LabelValues, float] = field(default_factory=dict)
+    _totals: dict[LabelValues, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record(self, value: float, labels: dict[str, str] | None = None) -> None:
+        key = tuple((labels or {}).get(n, "") for n in self.label_names)
+        idx = 0
+        while idx < len(self.boundaries) and value > self.boundaries[idx]:
+            idx += 1
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def collect(self) -> str:
+        pname = _sanitize_name(self.name)
+        out = [f"# HELP {pname} {self.description}", f"# TYPE {pname} histogram"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for key, counts in items:
+            label_str = ",".join(
+                f'{_sanitize_name(n)}="{_escape(v)}"' for n, v in zip(self.label_names, key) if v
+            )
+            prefix = label_str + "," if label_str else ""
+            cum = 0
+            for bound, count in zip(self.boundaries, counts):
+                cum += count
+                out.append(f'{pname}_bucket{{{prefix}le="{bound:g}"}} {cum}')
+            cum += counts[-1]
+            out.append(f'{pname}_bucket{{{prefix}le="+Inf"}} {cum}')
+            sfx = f"{{{label_str}}}" if label_str else ""
+            out.append(f"{pname}_sum{sfx} {sums[key]:g}")
+            out.append(f"{pname}_count{sfx} {totals[key]}")
+        return "\n".join(out)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._instruments: list[Counter | Histogram] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, description: str, label_names: tuple[str, ...], unit: str = "") -> Counter:
+        c = Counter(name, description, label_names, unit)
+        with self._lock:
+            self._instruments.append(c)
+        return c
+
+    def histogram(
+        self, name: str, description: str, label_names: tuple[str, ...],
+        boundaries: tuple[float, ...], unit: str = "",
+    ) -> Histogram:
+        h = Histogram(name, description, label_names, boundaries, unit)
+        with self._lock:
+            self._instruments.append(h)
+        return h
+
+    def expose(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            instruments = list(self._instruments)
+        return "\n".join(i.collect() for i in instruments) + "\n"
+
+
+def replay_histogram(hist: Histogram, bucket_counts: list[int], bounds: list[float],
+                     labels: dict[str, str], cap: int = 10000) -> int:
+    """Approximate a pushed histogram by replaying observations at bucket
+    midpoints, capped (reference otel/ingest.go:140-172). Returns the
+    number of observations replayed."""
+    replayed = 0
+    for i, count in enumerate(bucket_counts):
+        if count <= 0:
+            continue
+        lo = bounds[i - 1] if i > 0 else 0.0
+        hi = bounds[i] if i < len(bounds) else (bounds[-1] * 2 if bounds else lo or 1.0)
+        mid = (lo + hi) / 2 if math.isfinite(hi) else lo
+        n = min(count, cap - replayed)
+        for _ in range(n):
+            hist.record(mid, labels)
+        replayed += n
+        if replayed >= cap:
+            break
+    return replayed

@@ -1,0 +1,241 @@
+"""GenAI telemetry facade.
+
+Capability parity with reference otel/otel.go:50-255: the same 7
+GenAI-semconv instruments with spec'd bucket boundaries, the same record
+methods (token usage, request duration, tool calls), Prometheus exposition
+for the dedicated metrics listener, and OTLP push ingestion (JSON
+encoding) with the reference's delta-only, attribute-allowlisted,
+replay-capped semantics (otel/ingest.go).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from inference_gateway_tpu.otel.metrics import Histogram, Registry, replay_histogram
+from inference_gateway_tpu.otel.tracing import Tracer
+from inference_gateway_tpu.version import APPLICATION_NAME
+
+TEAM_UNKNOWN = "unknown"
+
+# Semconv-recommended boundaries (otel.go:80-83).
+DURATION_BOUNDARIES = (0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64, 1.28, 2.56, 5.12, 10.24, 20.48, 40.96, 81.92)
+TOKEN_BOUNDARIES = (1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216, 67108864)
+
+_BASE_LABELS = ("source", "team", "gen_ai_operation_name", "gen_ai_provider_name", "gen_ai_request_model")
+
+# Data-point attributes accepted from untrusted pushers (ingest.go:22-32).
+ALLOWED_PUSH_ATTRIBUTES = {
+    "gen_ai.provider.name",
+    "gen_ai.system",
+    "gen_ai.request.model",
+    "gen_ai.response.model",
+    "gen_ai.operation.name",
+    "gen_ai.token.type",
+    "gen_ai.tool.name",
+    "gen_ai.tool.type",
+    "error.type",
+}
+
+MAX_REPLAY_OBSERVATIONS = 10000
+
+
+class OpenTelemetry:
+    def __init__(self, environment: str = "production", tracing_enable: bool = False,
+                 tracing_otlp_endpoint: str = "", logger=None) -> None:
+        self.logger = logger
+        self.registry = Registry()
+        r = self.registry
+        self.token_usage = r.histogram(
+            "gen_ai.client.token.usage", "Number of input and output tokens used per operation",
+            _BASE_LABELS + ("gen_ai_token_type",), TOKEN_BOUNDARIES, unit="{token}",
+        )
+        self.server_request_duration = r.histogram(
+            "gen_ai.server.request.duration", "Generative AI server request duration",
+            _BASE_LABELS + ("error_type",), DURATION_BOUNDARIES, unit="s",
+        )
+        self.client_operation_duration = r.histogram(
+            "gen_ai.client.operation.duration", "GenAI operation duration as observed by the client",
+            _BASE_LABELS + ("error_type",), DURATION_BOUNDARIES, unit="s",
+        )
+        self.client_time_to_first_chunk = r.histogram(
+            "gen_ai.client.operation.time_to_first_chunk", "Time to receive the first chunk of a streaming response",
+            _BASE_LABELS + ("error_type",), DURATION_BOUNDARIES, unit="s",
+        )
+        self.server_time_to_first_token = r.histogram(
+            "gen_ai.server.time_to_first_token", "Time to generate the first token of a response",
+            _BASE_LABELS + ("error_type",), DURATION_BOUNDARIES, unit="s",
+        )
+        self.execute_tool_duration = r.histogram(
+            "gen_ai.execute_tool.duration", "GenAI tool execution duration",
+            _BASE_LABELS + ("gen_ai_tool_name", "gen_ai_tool_type"), DURATION_BOUNDARIES, unit="s",
+        )
+        self.tool_call_counter = r.counter(
+            "inference_gateway.tool_calls", "Number of tool calls observed in model responses",
+            _BASE_LABELS + ("gen_ai_tool_name", "gen_ai_tool_type"), unit="{call}",
+        )
+        self.tracer = Tracer(
+            APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
+            enabled=tracing_enable, logger=logger,
+        )
+
+    # -- record methods (otel.go:205-247) --------------------------------
+    @staticmethod
+    def _base(source: str, team: str, provider: str, model: str) -> dict[str, str]:
+        return {
+            "source": source,
+            "team": team or TEAM_UNKNOWN,
+            "gen_ai_operation_name": "chat",
+            "gen_ai_provider_name": provider,
+            "gen_ai_request_model": model,
+        }
+
+    def record_token_usage(self, source: str, team: str, provider: str, model: str,
+                           input_tokens: int, output_tokens: int) -> None:
+        base = self._base(source, team, provider, model)
+        self.token_usage.record(input_tokens, {**base, "gen_ai_token_type": "input"})
+        self.token_usage.record(output_tokens, {**base, "gen_ai_token_type": "output"})
+
+    def record_request_duration(self, source: str, team: str, provider: str, model: str,
+                                error_type: str, seconds: float) -> None:
+        labels = self._base(source, team, provider, model)
+        if error_type:
+            labels["error_type"] = error_type
+        self.server_request_duration.record(seconds, labels)
+
+    def record_tool_call(self, source: str, team: str, provider: str, model: str,
+                         tool_type: str, tool_name: str) -> None:
+        labels = self._base(source, team, provider, model)
+        labels.pop("gen_ai_operation_name")
+        labels.update({"gen_ai_tool_name": tool_name, "gen_ai_tool_type": tool_type})
+        self.tool_call_counter.add(1, labels)
+
+    def expose_prometheus(self) -> str:
+        return self.registry.expose()
+
+    # -- OTLP push ingest (ingest.go:37-218) -----------------------------
+    def ingest_metrics(self, payload: dict[str, Any], source: str) -> dict[str, int | str]:
+        """Map a pushed OTLP-JSON payload onto internal instruments.
+
+        Delta-only for sums/histograms; attributes filtered to the
+        allowlist; histograms replayed at bucket midpoints capped at
+        10k observations; the pusher's service.name becomes the source
+        label unless it impersonates the gateway (ingest.go:190-218).
+        """
+        accepted = 0
+        rejected = 0
+        reasons: list[str] = []
+
+        def reject(points: int, reason: str) -> None:
+            nonlocal rejected
+            rejected += points
+            if reason not in reasons:
+                reasons.append(reason)
+
+        name_to_hist: dict[str, Histogram] = {
+            "gen_ai.client.token.usage": self.token_usage,
+            "gen_ai.client.operation.duration": self.client_operation_duration,
+            "gen_ai.server.request.duration": self.server_request_duration,
+            "gen_ai.client.operation.time_to_first_chunk": self.client_time_to_first_chunk,
+            "gen_ai.server.time_to_first_token": self.server_time_to_first_token,
+            "gen_ai.execute_tool.duration": self.execute_tool_duration,
+        }
+
+        for rm in payload.get("resourceMetrics") or []:
+            svc = _resource_service_name(rm) or source
+            if svc == APPLICATION_NAME:
+                svc = f"push:{source or 'unknown'}"  # anti-impersonation
+            for sm in rm.get("scopeMetrics") or []:
+                for m in sm.get("metrics") or []:
+                    name = m.get("name", "")
+                    if name == "inference_gateway.tool_calls":
+                        accepted_pts, msg = self._ingest_sum(m, svc)
+                        accepted += accepted_pts
+                        if msg:
+                            reject(self._point_count(m), msg)
+                        continue
+                    hist = name_to_hist.get(name)
+                    if hist is None:
+                        reject(self._point_count(m), f"unsupported metric {name!r}")
+                        continue
+                    accepted_pts, msg = self._ingest_histogram(m, hist, svc)
+                    accepted += accepted_pts
+                    if msg:
+                        reject(self._point_count(m), msg)
+
+        result: dict[str, int | str] = {"accepted": accepted, "rejected": rejected}
+        if reasons:
+            result["error_message"] = "; ".join(reasons)
+        return result
+
+    @staticmethod
+    def _point_count(metric: dict[str, Any]) -> int:
+        body = metric.get("histogram") or metric.get("sum") or {}
+        return len(body.get("dataPoints") or [])
+
+    @staticmethod
+    def _labels_from(attrs: list[dict[str, Any]], svc: str) -> dict[str, str]:
+        labels = {"source": svc, "team": TEAM_UNKNOWN}
+        for a in attrs or []:
+            key = a.get("key", "")
+            if key not in ALLOWED_PUSH_ATTRIBUTES:
+                continue
+            if key == "gen_ai.system":
+                key = "gen_ai.provider.name"
+            val = a.get("value") or {}
+            sval = val.get("stringValue") or str(val.get("intValue") or val.get("doubleValue") or "")
+            labels[key.replace(".", "_")] = sval
+        return labels
+
+    def _ingest_sum(self, metric: dict[str, Any], svc: str) -> tuple[int, str]:
+        sum_body = metric.get("sum") or {}
+        if sum_body.get("aggregationTemporality") not in (1, "AGGREGATION_TEMPORALITY_DELTA"):
+            return 0, "cumulative temporality not supported; push deltas"
+        accepted = 0
+        for dp in sum_body.get("dataPoints") or []:
+            val = int(dp.get("asInt") or dp.get("asDouble") or 0)
+            labels = self._labels_from(dp.get("attributes"), svc)
+            if val > 0:
+                self.tool_call_counter.add(val, labels)
+                accepted += 1
+        return accepted, ""
+
+    def _ingest_histogram(self, metric: dict[str, Any], hist: Histogram, svc: str) -> tuple[int, str]:
+        body = metric.get("histogram") or {}
+        if body.get("aggregationTemporality") not in (1, "AGGREGATION_TEMPORALITY_DELTA"):
+            return 0, "cumulative temporality not supported; push deltas"
+        accepted = 0
+        for dp in body.get("dataPoints") or []:
+            labels = self._labels_from(dp.get("attributes"), svc)
+            counts = [int(c) for c in dp.get("bucketCounts") or []]
+            bounds = [float(b) for b in dp.get("explicitBounds") or []]
+            if counts and len(counts) == len(bounds) + 1:
+                replay_histogram(hist, counts, bounds, labels, cap=MAX_REPLAY_OBSERVATIONS)
+                accepted += 1
+            elif dp.get("sum") is not None and int(dp.get("count") or 0) > 0:
+                count = min(int(dp["count"]), MAX_REPLAY_OBSERVATIONS)
+                avg = float(dp["sum"]) / int(dp["count"])
+                for _ in range(count):
+                    hist.record(avg, labels)
+                accepted += 1
+        return accepted, ""
+
+
+def _resource_service_name(rm: dict[str, Any]) -> str:
+    for a in (rm.get("resource") or {}).get("attributes") or []:
+        if a.get("key") == "service.name":
+            return (a.get("value") or {}).get("stringValue", "")
+    return ""
+
+
+class NoopTelemetry(OpenTelemetry):
+    """Telemetry disabled: records go nowhere cheap."""
+
+    def record_token_usage(self, *a, **k) -> None:
+        pass
+
+    def record_request_duration(self, *a, **k) -> None:
+        pass
+
+    def record_tool_call(self, *a, **k) -> None:
+        pass

@@ -1,0 +1,148 @@
+"""Minimal W3C TraceContext tracing.
+
+Capability parity with the reference's tracing surface (otel/otel.go:118-135,
+SURVEY.md §5): spans per request, manual spans for tool execution, W3C
+``traceparent`` propagation into every outbound hop, and batched OTLP/HTTP
+**JSON** export when TELEMETRY_TRACING_ENABLE is set. Implemented natively
+(no otel SDK in the image) with the same wire behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _rand_hex(nbytes: int) -> str:
+    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: str = ""
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status_code: str = "UNSET"
+    status_message: str = ""
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def set_status(self, code: str, message: str = "") -> None:
+        self.status_code = code
+        self.status_message = message
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """Return (trace_id, span_id) from a traceparent header, or None."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+class Tracer:
+    """Collects finished spans; optionally batch-exports OTLP/HTTP JSON."""
+
+    def __init__(self, service_name: str, otlp_endpoint: str = "", enabled: bool = True,
+                 export_interval: float = 5.0, logger=None) -> None:
+        self.service_name = service_name
+        self.otlp_endpoint = otlp_endpoint.rstrip("/")
+        self.enabled = enabled
+        self.export_interval = export_interval
+        self.logger = logger
+        self._finished: list[Span] = []
+        self._lock = threading.Lock()
+
+    def start_span(self, name: str, parent: Span | None = None,
+                   traceparent: str | None = None) -> Span:
+        ctx = parse_traceparent(traceparent)
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif ctx is not None:
+            trace_id, parent_id = ctx
+        else:
+            trace_id, parent_id = _rand_hex(16), ""
+        return Span(
+            name=name, trace_id=trace_id, span_id=_rand_hex(8), parent_span_id=parent_id,
+            start_ns=time.time_ns(),
+        )
+
+    def end_span(self, span: Span) -> None:
+        span.end_ns = time.time_ns()
+        if not self.enabled:
+            return
+        with self._lock:
+            self._finished.append(span)
+            # Bound memory when no exporter drains the buffer.
+            if len(self._finished) > 4096:
+                self._finished = self._finished[-2048:]
+
+    def drain(self) -> list[Span]:
+        with self._lock:
+            out, self._finished = self._finished, []
+        return out
+
+    def export_payload(self, spans: list[Span]) -> dict[str, Any]:
+        """OTLP/HTTP JSON ExportTraceServiceRequest."""
+
+        def attr(k: str, v: Any) -> dict[str, Any]:
+            if isinstance(v, bool):
+                val: dict[str, Any] = {"boolValue": v}
+            elif isinstance(v, int):
+                val = {"intValue": str(v)}
+            elif isinstance(v, float):
+                val = {"doubleValue": v}
+            else:
+                val = {"stringValue": str(v)}
+            return {"key": k, "value": val}
+
+        return {
+            "resourceSpans": [{
+                "resource": {"attributes": [attr("service.name", self.service_name)]},
+                "scopeSpans": [{
+                    "scope": {"name": self.service_name},
+                    "spans": [{
+                        "traceId": s.trace_id,
+                        "spanId": s.span_id,
+                        "parentSpanId": s.parent_span_id,
+                        "name": s.name,
+                        "kind": 2,  # SERVER
+                        "startTimeUnixNano": str(s.start_ns),
+                        "endTimeUnixNano": str(s.end_ns),
+                        "attributes": [attr(k, v) for k, v in s.attributes.items()],
+                        "status": {"code": {"UNSET": 0, "OK": 1, "ERROR": 2}[s.status_code],
+                                   "message": s.status_message},
+                    } for s in spans],
+                }],
+            }]
+        }
+
+    async def export_once(self, client) -> int:
+        """Push drained spans to the OTLP endpoint; returns span count."""
+        spans = self.drain()
+        if not spans or not self.otlp_endpoint:
+            return 0
+        payload = json.dumps(self.export_payload(spans)).encode()
+        try:
+            await client.post(
+                self.otlp_endpoint + "/v1/traces", payload,
+                headers={"Content-Type": "application/json"},
+            )
+        except Exception as e:
+            if self.logger:
+                self.logger.error("otlp trace export failed", e)
+        return len(spans)

@@ -1,0 +1,188 @@
+"""End-to-end gateway tests: gateway → /proxy loopback → TPU sidecar.
+
+The full double-hop architecture (SURVEY.md §3.2) over real sockets: a
+chat completion enters the gateway, the provider targets
+``/proxy/tpu/...`` on the gateway itself, the ProxyHandler forwards to
+the sidecar, and tokens stream back through both hops.
+"""
+
+import json
+
+import pytest
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.sse import iter_sse_payloads
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.server import SidecarServer
+
+
+@pytest.fixture(scope="module")
+def stack(aloop):
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=128,
+                                 dtype="float32", max_prefill_batch=2, use_mesh=False))
+    sidecar = SidecarServer(engine, served_model_name="test-tiny")
+    sidecar_port = aloop.run(sidecar.start("127.0.0.1", 0))
+
+    env = {
+        "TPU_API_URL": f"http://127.0.0.1:{sidecar_port}/v1",
+        # Unreachable fast-fail for the other auth-none local runtimes.
+        "OLLAMA_API_URL": "http://127.0.0.1:1/v1",
+        "LLAMACPP_API_URL": "http://127.0.0.1:1/v1",
+        "SERVER_PORT": "0",
+    }
+    gw = build_gateway(env=env)
+    gw_port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, gw_port, sidecar_port
+    aloop.run(gw.shutdown())
+    aloop.run(sidecar.shutdown())
+
+
+@pytest.fixture
+def client():
+    return HTTPClient()
+
+
+async def test_health(stack, client):
+    _, port, _ = stack
+    resp = await client.get(f"http://127.0.0.1:{port}/health")
+    assert resp.status == 200
+    assert resp.json() == {"message": "OK"}
+
+
+async def test_not_found(stack, client):
+    _, port, _ = stack
+    resp = await client.get(f"http://127.0.0.1:{port}/nope")
+    assert resp.status == 404
+
+
+async def test_list_models_single_provider(stack, client):
+    _, port, _ = stack
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models?provider=tpu")
+    assert resp.status == 200
+    data = resp.json()
+    assert data["data"][0]["id"] == "tpu/test-tiny"
+    assert data["data"][0]["served_by"] == "tpu"
+    # Default payload carries no metadata keys (routes.go:355-365).
+    assert "context_window" not in data["data"][0]
+
+
+async def test_list_models_fanout(stack, client):
+    _, port, _ = stack
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models")
+    assert resp.status == 200
+    ids = [m["id"] for m in resp.json()["data"]]
+    assert "tpu/test-tiny" in ids  # unreachable providers silently skipped
+
+
+async def test_list_models_include_context_window_runtime_tier(stack, client):
+    _, port, _ = stack
+    resp = await client.get(
+        f"http://127.0.0.1:{port}/v1/models?provider=tpu&include=context_window"
+    )
+    assert resp.status == 200
+    model = resp.json()["data"][0]
+    # Runtime tier: resolved live from the sidecar's /props (n_ctx=128).
+    assert model["context_window"] == 128
+
+
+async def test_list_models_include_unknown_key(stack, client):
+    _, port, _ = stack
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models?include=bogus")
+    assert resp.status == 400
+
+
+async def test_chat_completions_non_streaming_double_hop(stack, client):
+    _, port, _ = stack
+    body = {"model": "tpu/test-tiny", "messages": [{"role": "user", "content": "hi"}], "max_tokens": 6}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    assert resp.status == 200
+    data = resp.json()
+    assert data["object"] == "chat.completion"
+    assert data["usage"]["completion_tokens"] > 0
+
+
+async def test_chat_completions_provider_query_param(stack, client):
+    _, port, _ = stack
+    body = {"model": "test-tiny", "messages": [{"role": "user", "content": "hi"}], "max_tokens": 4}
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/chat/completions?provider=tpu", json.dumps(body).encode()
+    )
+    assert resp.status == 200
+
+
+async def test_chat_completions_streaming_double_hop(stack, client):
+    _, port, _ = stack
+    body = {
+        "model": "tpu/test-tiny",
+        "messages": [{"role": "user", "content": "stream me"}],
+        "max_tokens": 6,
+        "stream": True,
+    }
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode(), stream=True
+    )
+    assert resp.status == 200
+    chunks = []
+    async for payload in iter_sse_payloads(resp.iter_lines()):
+        chunks.append(json.loads(payload))
+    assert chunks, "no SSE chunks relayed"
+    assert chunks[0]["object"] == "chat.completion.chunk"
+    # stream_options.include_usage is forced by the provider layer
+    # (provider.go:85-96): usage must ride in the trailing chunks.
+    assert any("usage" in c and c["usage"] for c in chunks[-4:])
+
+
+async def test_unknown_provider_yields_400(stack, client):
+    _, port, _ = stack
+    body = {"model": "unprefixed-model", "messages": [{"role": "user", "content": "x"}]}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    assert resp.status == 400
+
+    body = {"model": "openai/gpt-4o", "messages": [{"role": "user", "content": "x"}]}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    assert resp.status == 400  # token not configured
+
+
+async def test_proxy_handler_direct(stack, client):
+    _, port, _ = stack
+    # Provider base URLs already carry the /v1 prefix, so the proxy path
+    # is endpoint-relative (providers/core/provider.go:81-83).
+    resp = await client.get(f"http://127.0.0.1:{port}/proxy/tpu/models")
+    assert resp.status == 200
+    assert resp.json()["data"][0]["id"] == "test-tiny"  # raw upstream shape
+
+    resp = await client.get(f"http://127.0.0.1:{port}/proxy/doesnotexist/models")
+    assert resp.status == 400
+
+
+async def test_messages_non_anthropic_rejected(stack, client):
+    _, port, _ = stack
+    body = {"model": "tpu/test-tiny", "messages": [], "max_tokens": 4}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/messages", json.dumps(body).encode())
+    assert resp.status == 400
+    assert resp.json()["error"]["type"] == "not_supported_error"
+
+
+async def test_disallowed_model_forbidden(aloop, stack):
+    _, _, sidecar_port = stack
+    env = {
+        "TPU_API_URL": f"http://127.0.0.1:{sidecar_port}/v1",
+        "DISALLOWED_MODELS": "tpu/test-tiny",
+        "SERVER_PORT": "0",
+    }
+    gw = build_gateway(env=env)
+    port = await gw.start("127.0.0.1", 0)
+    try:
+        client = HTTPClient()
+        body = {"model": "tpu/test-tiny", "messages": [{"role": "user", "content": "x"}]}
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+        assert resp.status == 403
+    finally:
+        await gw.shutdown()
+
+
+async def test_mcp_tools_not_exposed(stack, client):
+    _, port, _ = stack
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/mcp/tools")
+    assert resp.status == 403
