@@ -1,0 +1,111 @@
+"""MCP middleware: chat-completion interception for tool calling.
+
+Capability parity with reference api/middlewares/mcp.go:25-330: when MCP
+is enabled, POST /v1/chat/completions is intercepted — discovered tools
+are injected into the request, the agent loop handles any tool_calls,
+and the final (or re-streamed) response reaches the client. The
+``X-MCP-Bypass`` header short-circuits the gateway's own loopback
+self-calls so the proxy hop is never re-intercepted (mcp.go:25, 88).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from inference_gateway_tpu.netio.server import Handler, Request, Response, StreamingResponse
+from inference_gateway_tpu.providers import routing
+
+MCP_BYPASS_HEADER = "X-MCP-Bypass"
+
+
+def get_provider_and_model(req: Request, body: dict) -> tuple[str | None, str]:
+    """Resolve the target provider/model like the handler will
+    (mcp.go:205-234)."""
+    model = body.get("model") or ""
+    provider = req.query_get("provider")
+    if provider:
+        return provider, model
+    detected, stripped = routing.determine_provider_and_model_name(model)
+    return detected, stripped
+
+
+def mcp_middleware(mcp_client, agent, registry, client, cfg, logger):
+    async def middleware(req: Request, nxt: Handler) -> Response:
+        # Bypass checks (mcp.go:88-126).
+        if req.method != "POST" or req.path != "/v1/chat/completions":
+            return await nxt(req)
+        if (req.headers.get(MCP_BYPASS_HEADER) or "").lower() in ("true", "1"):
+            return await nxt(req)
+        if not mcp_client.is_initialized() or not mcp_client.has_available_servers():
+            return await nxt(req)
+
+        try:
+            body = req.json()
+        except (ValueError, UnicodeDecodeError):
+            return Response.json({"error": "Failed to decode request"}, status=400)
+        if not isinstance(body, dict):
+            return Response.json({"error": "Failed to decode request"}, status=400)
+
+        tools = mcp_client.get_all_chat_completion_tools(cfg.mcp.include_tools, cfg.mcp.exclude_tools)
+        if not tools:
+            return await nxt(req)
+
+        body = dict(body)
+        injected = list(body.get("tools") or [])
+        existing = {t.get("function", {}).get("name") for t in injected}
+        injected.extend(t for t in tools if t["function"]["name"] not in existing)
+        body["tools"] = injected
+        req.ctx["parsed_body"] = body  # the handler reuses this (routes.go:599-613)
+
+        provider_id, model = get_provider_and_model(req, body)
+        if provider_id is None:
+            return await nxt(req)
+        try:
+            provider = registry.build_provider(provider_id, client)
+        except Exception:
+            return await nxt(req)  # handler produces the proper error
+
+        body["model"] = model
+        ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
+
+        if body.get("stream"):
+            # Streaming agent loop re-emits chunks through an async queue
+            # (mcp.go:237-303).
+            queue: asyncio.Queue[bytes | None] = asyncio.Queue(maxsize=200)
+
+            async def emit(chunk: bytes) -> None:
+                await queue.put(chunk)
+
+            async def run_agent() -> None:
+                try:
+                    await agent.run_with_stream(provider, body, emit, ctx)
+                except Exception as e:
+                    logger.error("mcp streaming agent failed", e)
+                    err = json.dumps({"error": str(e)})
+                    await queue.put(f"data: {err}\n\n".encode())
+                finally:
+                    await queue.put(None)
+
+            task = asyncio.create_task(run_agent())
+
+            async def gen():
+                try:
+                    while True:
+                        chunk = await queue.get()
+                        if chunk is None:
+                            break
+                        yield chunk
+                finally:
+                    task.cancel()
+
+            return StreamingResponse.sse(gen())
+
+        try:
+            result = await agent.run(provider, body, ctx)
+        except Exception as e:
+            logger.error("mcp agent failed", e)
+            return Response.json({"error": "Failed to process the request with MCP tools"}, status=503)
+        return Response.json(result)
+
+    return middleware

@@ -1,0 +1,153 @@
+"""The MCP agent: the tool-calling loop.
+
+Capability parity with reference internal/mcp/agent.go:21-388: up to 10
+iterations of (model call → parse tool_calls → execute via MCP → append
+tool results → re-call). The streaming variant re-emits every upstream
+chunk to the client while accumulating content and tool-call deltas,
+suppressing intermediate ``[DONE]`` frames and emitting exactly one at
+the end. Each tool execution gets an ``execute_tool <name>`` span with
+GenAI attributes (agent.go:319-336).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from inference_gateway_tpu.logger import Logger, new_logger
+from inference_gateway_tpu.mcp.client import MCPClient, MCPError
+from inference_gateway_tpu.netio import sse
+from inference_gateway_tpu.providers.types import accumulate_streaming_tool_calls
+
+MAX_AGENT_ITERATIONS = 10  # agent.go:21
+
+
+class Agent:
+    def __init__(self, mcp_client: MCPClient, logger: Logger | None = None, otel=None):
+        self.mcp = mcp_client
+        self.logger = logger or new_logger()
+        self.otel = otel
+
+    # ------------------------------------------------------------------
+    async def execute_tools(self, tool_calls: list[dict[str, Any]],
+                            provider_id: str = "", model: str = "") -> list[dict[str, Any]]:
+        """Run each call via MCP; returns ``role:"tool"`` messages
+        (agent.go:299-345)."""
+        results = []
+        for call in tool_calls:
+            name = call.get("function", {}).get("name", "")
+            raw_args = call.get("function", {}).get("arguments") or "{}"
+            try:
+                args = json.loads(raw_args)
+            except ValueError:
+                args = {}
+            span = None
+            if self.otel is not None:
+                span = self.otel.tracer.start_span(f"execute_tool {name}")
+                span.set_attribute("gen_ai.tool.name", name)
+                span.set_attribute("gen_ai.operation.name", "execute_tool")
+            start = time.perf_counter()
+            try:
+                result = await self.mcp.execute_tool(name, args)
+                content = json.dumps(result.get("content", result))
+            except (MCPError, Exception) as e:  # tool failure becomes model-visible
+                content = json.dumps({"error": str(e)})
+                if span is not None:
+                    span.set_status("ERROR", str(e))
+                self.logger.error("tool execution failed", e, "tool", name)
+            finally:
+                if self.otel is not None and span is not None:
+                    self.otel.tracer.end_span(span)
+                    self.otel.execute_tool_duration.record(
+                        time.perf_counter() - start,
+                        {"source": "gateway", "team": "unknown",
+                         "gen_ai_provider_name": provider_id, "gen_ai_request_model": model,
+                         "gen_ai_tool_name": name, "gen_ai_tool_type": "mcp"},
+                    )
+            results.append({
+                "role": "tool",
+                "tool_call_id": call.get("id", ""),
+                "content": content,
+            })
+        return results
+
+    # ------------------------------------------------------------------
+    async def run(self, provider, body: dict[str, Any],
+                  ctx: dict[str, Any] | None = None) -> dict[str, Any]:
+        """Non-streaming agent loop (agent.go:73-122)."""
+        body = dict(body)
+        messages = list(body.get("messages") or [])
+        for _ in range(MAX_AGENT_ITERATIONS):
+            body["messages"] = messages
+            response = await provider.chat_completions(body, ctx)
+            choices = response.get("choices") or []
+            message = (choices[0].get("message") or {}) if choices else {}
+            tool_calls = message.get("tool_calls") or []
+            if not tool_calls:
+                return response
+            messages.append(message)
+            messages.extend(await self.execute_tools(tool_calls, provider.id, body.get("model", "")))
+        return response
+
+    async def run_with_stream(
+        self,
+        provider,
+        body: dict[str, Any],
+        emit: Callable[[bytes], Awaitable[None]],
+        ctx: dict[str, Any] | None = None,
+    ) -> None:
+        """Streaming agent loop (agent.go:134-296): every upstream chunk is
+        re-emitted while deltas accumulate; tool calls trigger execution
+        and another iteration; one [DONE] at the very end."""
+        body = dict(body)
+        messages = list(body.get("messages") or [])
+        try:
+            for _ in range(MAX_AGENT_ITERATIONS):
+                body["messages"] = messages
+                stream = await provider.stream_chat_completions(body, ctx)
+                collected = bytearray()
+                saw_tool_finish = False
+                async for line in stream:
+                    collected += line
+                    stripped = line.strip()
+                    if stripped == b"data: [DONE]" or stripped == b"data:[DONE]":
+                        continue  # suppress intermediate DONE frames
+                    if stripped.startswith(b"data:"):
+                        try:
+                            payload = json.loads(stripped[5:].strip())
+                            for choice in payload.get("choices") or []:
+                                if choice.get("finish_reason") == "tool_calls":
+                                    saw_tool_finish = True
+                        except ValueError:
+                            pass
+                    await emit(line)
+
+                tool_calls = accumulate_streaming_tool_calls(bytes(collected))
+                if not tool_calls and not saw_tool_finish:
+                    return
+                if not tool_calls:
+                    return
+                assistant_text = self._accumulate_content(bytes(collected))
+                messages.append({
+                    "role": "assistant",
+                    "content": assistant_text or None,
+                    "tool_calls": tool_calls,
+                })
+                messages.extend(await self.execute_tools(tool_calls, provider.id, body.get("model", "")))
+        finally:
+            await emit(sse.DONE_FRAME)  # agent.go:147-150
+
+    @staticmethod
+    def _accumulate_content(body: bytes) -> str:
+        text = []
+        for payload in sse.split_sse_payloads(body):
+            try:
+                chunk = json.loads(payload)
+            except ValueError:
+                continue
+            for choice in chunk.get("choices") or []:
+                delta = choice.get("delta") or {}
+                if delta.get("content"):
+                    text.append(delta["content"])
+        return "".join(text)

@@ -1,0 +1,278 @@
+"""MCP client: multi-server lifecycle over JSON-RPC/HTTP.
+
+Capability parity with reference internal/mcp/ (client.go, init.go,
+transport.go, tools.go, health.go):
+
+- per-server initialize with bounded retries + exponential backoff
+  (init.go:150-228)
+- dual-transport: streamable-HTTP first, ``/mcp`` → ``/sse`` URL fallback
+  on 4xx, both at init and mid-flight (init.go:176-193,
+  transport.go:125-187)
+- ``mcp-session-id`` response-header caching re-sent on subsequent calls
+  (transport.go:56-123)
+- SSE-framed JSON-RPC responses normalized to plain JSON
+  (transport.go:40-54)
+- tool discovery / execution / tool→server lookup with the ``mcp_``
+  namespace prefix (tools.go:12-152)
+- health polling via ``tools/list`` probes; an available→unavailable flip
+  triggers background reconnection with in-flight dedup
+  (health.go:20-106, init.go:330-408)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import time
+from typing import Any
+
+from inference_gateway_tpu.config import MCPConfig
+from inference_gateway_tpu.logger import Logger, new_logger
+from inference_gateway_tpu.netio.client import HTTPClient, HTTPClientError
+from inference_gateway_tpu.netio.server import Headers
+
+PROTOCOL_VERSION = "2024-11-05"
+TOOL_PREFIX = "mcp_"
+
+
+class MCPError(Exception):
+    pass
+
+
+class MCPClient:
+    def __init__(self, cfg: MCPConfig, http_client: HTTPClient, logger: Logger | None = None):
+        self.cfg = cfg
+        self.http = http_client
+        self.logger = logger or new_logger()
+        self.servers = [u.strip() for u in (cfg.servers or "").split(",") if u.strip()]
+        self._effective_url: dict[str, str] = {u: u for u in self.servers}
+        self._session_ids: dict[str, str] = {}
+        self._tools: dict[str, list[dict[str, Any]]] = {}
+        self._status: dict[str, bool] = {u: False for u in self.servers}
+        self._initialized = False
+        self._ids = itertools.count(1)
+        self._lock = asyncio.Lock()
+        self._reconnecting: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+
+    # -- rpc transport -------------------------------------------------
+    async def _post_rpc(self, url: str, server: str, method: str, params: dict[str, Any],
+                        timeout: float) -> dict[str, Any]:
+        payload = {"jsonrpc": "2.0", "id": next(self._ids), "method": method, "params": params}
+        headers = Headers()
+        headers.set("Content-Type", "application/json")
+        # Accept both framings; some servers answer POSTs with SSE.
+        headers.set("Accept", "application/json, text/event-stream")
+        session = self._session_ids.get(server)
+        if session:
+            headers.set("Mcp-Session-Id", session)
+
+        resp = await self.http.post(url, json.dumps(payload).encode(), headers=headers, timeout=timeout)
+        if resp.status >= 400:
+            raise MCPError(f"HTTP {resp.status} from {url}")
+
+        sid = resp.headers.get("Mcp-Session-Id")
+        if sid:
+            self._session_ids[server] = sid
+
+        body = resp.body
+        ctype = (resp.headers.get("Content-Type") or "").lower()
+        if "text/event-stream" in ctype:
+            body = self._parse_sse_response(body)
+        try:
+            decoded = json.loads(body)
+        except ValueError as e:
+            raise MCPError(f"malformed JSON-RPC response from {url}") from e
+        if decoded.get("error"):
+            raise MCPError(f"JSON-RPC error from {url}: {decoded['error']}")
+        return decoded.get("result") or {}
+
+    @staticmethod
+    def _parse_sse_response(body: bytes) -> bytes:
+        """Unwrap the first data frame of an SSE-framed JSON-RPC response
+        (transport.go:40-54)."""
+        for line in body.split(b"\n"):
+            line = line.strip()
+            if line.startswith(b"data:"):
+                return line[5:].strip()
+        raise MCPError("SSE response contained no data frame")
+
+    @staticmethod
+    def build_sse_fallback_url(url: str) -> str:
+        """``/mcp`` → ``/sse`` rewrite (transport.go:229-236)."""
+        if url.rstrip("/").endswith("/mcp"):
+            return url.rstrip("/")[: -len("/mcp")] + "/sse"
+        return url.rstrip("/") + "/sse"
+
+    async def _rpc(self, server: str, method: str, params: dict[str, Any],
+                   timeout: float | None = None) -> dict[str, Any]:
+        """RPC with mid-flight SSE fallback on 4xx (transport.go:125-187)."""
+        timeout = timeout if timeout is not None else self.cfg.request_timeout
+        url = self._effective_url.get(server, server)
+        try:
+            return await self._post_rpc(url, server, method, params, timeout)
+        except MCPError as e:
+            msg = str(e)
+            is_4xx = "HTTP 4" in msg
+            if is_4xx and url == server:
+                fallback = self.build_sse_fallback_url(server)
+                result = await self._post_rpc(fallback, server, method, params, timeout)
+                self._effective_url[server] = fallback
+                self.logger.info("mcp transport fell back to sse", "server", server, "url", fallback)
+                return result
+            raise
+
+    # -- lifecycle (init.go) -------------------------------------------
+    async def initialize_all(self) -> None:
+        """Init every server with retry + backoff; zero-up degrades to
+        reconnect mode instead of failing when enabled (init.go:33-77)."""
+        results = await asyncio.gather(
+            *(self._initialize_with_retry(u) for u in self.servers), return_exceptions=True
+        )
+        up = sum(1 for r in results if r is True)
+        self._initialized = True
+        if up == 0 and self.servers:
+            if not self.cfg.enable_reconnect:
+                raise MCPError("no MCP servers available and reconnection is disabled")
+            self.logger.warn("no mcp servers available at startup; relying on background reconnection")
+
+    async def _initialize_with_retry(self, server: str) -> bool:
+        backoff = self.cfg.initial_backoff
+        for attempt in range(max(self.cfg.max_retries, 1)):
+            if await self._initialize_server(server):
+                return True
+            await asyncio.sleep(min(backoff, self.cfg.retry_interval))
+            backoff *= 2
+        if self.cfg.enable_reconnect:
+            self.spawn_background_reconnection(server)
+        return False
+
+    async def _initialize_server(self, server: str) -> bool:
+        """One initialize + tools/list pass; tries streamable-HTTP then the
+        SSE fallback URL (init.go:150-228)."""
+        params = {
+            "protocolVersion": PROTOCOL_VERSION,
+            "capabilities": {},
+            "clientInfo": {"name": "inference-gateway-tpu", "version": "0.1.0"},
+        }
+        for url in (server, self.build_sse_fallback_url(server)):
+            try:
+                await self._post_rpc(url, server, "initialize", params, self.cfg.request_timeout)
+                self._effective_url[server] = url
+                result = await self._post_rpc(url, server, "tools/list", {}, self.cfg.request_timeout)
+                async with self._lock:
+                    self._tools[server] = result.get("tools") or []
+                    self._status[server] = True
+                self.logger.info("mcp server initialized", "server", server,
+                                 "tools", len(self._tools[server]), "transport", url)
+                return True
+            except (MCPError, HTTPClientError, asyncio.TimeoutError) as e:
+                self.logger.warn("mcp server initialization failed", "server", server,
+                                 "url", url, "error", str(e))
+        async with self._lock:
+            self._status[server] = False
+        return False
+
+    # -- background reconnection (init.go:330-408) ----------------------
+    def spawn_background_reconnection(self, server: str) -> None:
+        if self._stopped or server in self._reconnecting:
+            return
+        self._reconnecting.add(server)
+        self._tasks.append(asyncio.create_task(self._reconnect_loop(server)))
+
+    async def _reconnect_loop(self, server: str) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.cfg.reconnect_interval)
+                if await self._initialize_server(server):
+                    self.logger.info("mcp server reconnected", "server", server)
+                    return
+        finally:
+            self._reconnecting.discard(server)
+
+    # -- health polling (health.go) -------------------------------------
+    def start_status_polling(self) -> None:
+        if self.cfg.polling_enable and self.servers:
+            self._tasks.append(asyncio.create_task(self._polling_loop()))
+
+    async def _polling_loop(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(self.cfg.polling_interval)
+            for server in self.servers:
+                healthy = await self._check_server_health(server)
+                async with self._lock:
+                    was = self._status.get(server, False)
+                    self._status[server] = healthy
+                if was and not healthy:
+                    self.logger.warn("mcp server became unavailable", "server", server)
+                    if self.cfg.enable_reconnect:
+                        self.spawn_background_reconnection(server)
+
+    async def _check_server_health(self, server: str) -> bool:
+        try:
+            result = await self._rpc(server, "tools/list", {}, timeout=self.cfg.polling_timeout)
+            async with self._lock:
+                self._tools[server] = result.get("tools") or self._tools.get(server, [])
+            if not self.cfg.disable_healthcheck_logs:
+                self.logger.info("mcp healthcheck ok", "server", server)
+            return True
+        except (MCPError, HTTPClientError, asyncio.TimeoutError):
+            return False
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+
+    # -- introspection (client.go:41-83) --------------------------------
+    def is_initialized(self) -> bool:
+        return self._initialized
+
+    def get_servers(self) -> list[str]:
+        return list(self.servers)
+
+    def get_server_tools(self, server: str) -> list[dict[str, Any]]:
+        return list(self._tools.get(server, []))
+
+    def get_server_statuses(self) -> dict[str, bool]:
+        return dict(self._status)
+
+    def has_available_servers(self) -> bool:
+        return any(self._status.values())
+
+    # -- tools (tools.go) ------------------------------------------------
+    def get_server_for_tool(self, name: str) -> str | None:
+        bare = name.removeprefix(TOOL_PREFIX)
+        for server, tools in self._tools.items():
+            if any(t.get("name") == bare for t in tools):
+                return server
+        return None
+
+    def get_all_chat_completion_tools(self, include_csv: str = "", exclude_csv: str = "") -> list[dict[str, Any]]:
+        """All discovered tools as OpenAI chat tools with the ``mcp_``
+        prefix (tools.go:92-152)."""
+        from inference_gateway_tpu.mcp.filter import filter_tools
+
+        out = []
+        for server in self.servers:
+            for tool in filter_tools(self._tools.get(server, []), include_csv, exclude_csv):
+                out.append({
+                    "type": "function",
+                    "function": {
+                        "name": TOOL_PREFIX + tool.get("name", ""),
+                        "description": tool.get("description", ""),
+                        "parameters": tool.get("inputSchema") or {"type": "object"},
+                    },
+                })
+        return out
+
+    async def execute_tool(self, name: str, arguments: dict[str, Any]) -> dict[str, Any]:
+        """tools/call against the owning server (tools.go:12-60)."""
+        server = self.get_server_for_tool(name)
+        if server is None:
+            raise MCPError(f"no MCP server provides tool {name!r}")
+        bare = name.removeprefix(TOOL_PREFIX)
+        return await self._rpc(server, "tools/call", {"name": bare, "arguments": arguments})

@@ -1,0 +1,31 @@
+"""MCP tool allow/deny filtering.
+
+Capability parity with reference internal/mcp/filter.go:9-68:
+MCP_INCLUDE_TOOLS takes precedence over MCP_EXCLUDE_TOOLS; names are
+normalized case-insensitively with the ``mcp_`` prefix stripped.
+"""
+
+from __future__ import annotations
+
+
+def normalize_tool_name(name: str) -> str:
+    return name.strip().lower().removeprefix("mcp_")
+
+
+def _parse(csv: str) -> set[str]:
+    return {normalize_tool_name(e) for e in csv.split(",") if e.strip()}
+
+
+def is_tool_allowed(name: str, include_csv: str, exclude_csv: str) -> bool:
+    norm = normalize_tool_name(name)
+    include = _parse(include_csv)
+    if include:
+        return norm in include
+    exclude = _parse(exclude_csv)
+    if exclude:
+        return norm not in exclude
+    return True
+
+
+def filter_tools(tools: list[dict], include_csv: str, exclude_csv: str) -> list[dict]:
+    return [t for t in tools if is_tool_allowed(t.get("name", ""), include_csv, exclude_csv)]

@@ -1,0 +1,308 @@
+"""MCP subsystem tests (reference: tests/mcp_test.go,
+tests/middlewares/mcp_test.go, internal/mcp/*_test.go).
+
+Fake MCP servers and a scripted fake upstream provider run on real
+sockets; the gateway runs with MCP enabled and the agent loop executes
+tools end to end, streaming and non-streaming.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from inference_gateway_tpu.mcp.client import MCPClient
+from inference_gateway_tpu.mcp.filter import filter_tools, is_tool_allowed, normalize_tool_name
+from inference_gateway_tpu.config import MCPConfig
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.netio.sse import iter_sse_payloads
+
+
+class FakeMCPServer:
+    """Scriptable JSON-RPC MCP server (reference
+    internal/mcp/client_concurrency_test.go:24-60)."""
+
+    def __init__(self, tools=None, sse_framed=False, reject_mcp_path=False):
+        self.tools = tools or [
+            {"name": "get_time", "description": "Get the current time",
+             "inputSchema": {"type": "object", "properties": {"tz": {"type": "string"}}}},
+        ]
+        self.sse_framed = sse_framed
+        self.reject_mcp_path = reject_mcp_path  # force /sse fallback
+        self.calls: list[dict] = []
+        self.session_header_seen: list[str] = []
+        router = Router()
+        router.post("/mcp", self.handle)
+        router.post("/sse", self.handle)
+        self.server = HTTPServer(router)
+        self.port = 0
+
+    async def start(self):
+        self.port = await self.server.start("127.0.0.1", 0)
+        return self.port
+
+    async def handle(self, req: Request) -> Response:
+        if self.reject_mcp_path and req.path == "/mcp":
+            return Response.json({"error": "use /sse"}, status=405)
+        payload = req.json()
+        self.session_header_seen.append(req.headers.get("Mcp-Session-Id") or "")
+        method = payload.get("method")
+        if method == "initialize":
+            result = {"protocolVersion": "2024-11-05", "serverInfo": {"name": "fake"}}
+        elif method == "tools/list":
+            result = {"tools": self.tools}
+        elif method == "tools/call":
+            self.calls.append(payload["params"])
+            name = payload["params"]["name"]
+            result = {"content": [{"type": "text", "text": f"result-of-{name}"}], "isError": False}
+        else:
+            return Response.json({"jsonrpc": "2.0", "id": payload.get("id"),
+                                  "error": {"code": -32601, "message": "unknown method"}})
+        body = {"jsonrpc": "2.0", "id": payload.get("id"), "result": result}
+        if self.sse_framed:
+            resp = Response.text(f"data: {json.dumps(body)}\n\n", content_type="text/event-stream")
+        else:
+            resp = Response.json(body)
+        resp.headers.set("Mcp-Session-Id", "sess-123")
+        return resp
+
+
+class FakeUpstream:
+    """OpenAI-compatible upstream: first call returns tool_calls, second a
+    final answer. Records the requests it received."""
+
+    def __init__(self):
+        self.requests: list[dict] = []
+        router = Router()
+        router.post("/v1/chat/completions", self.chat)
+        router.get("/v1/models", self.models)
+        self.server = HTTPServer(router)
+        self.port = 0
+
+    async def start(self):
+        self.port = await self.server.start("127.0.0.1", 0)
+        return self.port
+
+    async def models(self, req: Request) -> Response:
+        return Response.json({"object": "list", "data": [{"id": "fake-model"}]})
+
+    def _has_tool_result(self, body) -> bool:
+        return any(m.get("role") == "tool" for m in body.get("messages", []))
+
+    async def chat(self, req: Request) -> Response:
+        body = req.json()
+        self.requests.append(body)
+        final_round = self._has_tool_result(body)
+        if body.get("stream"):
+            return StreamingResponse.sse(self._stream(final_round))
+        if final_round:
+            return Response.json({
+                "id": "cmpl-2", "object": "chat.completion", "created": 1, "model": "fake-model",
+                "choices": [{"index": 0, "message": {"role": "assistant", "content": "The time is noon."},
+                             "finish_reason": "stop"}],
+                "usage": {"prompt_tokens": 10, "completion_tokens": 5, "total_tokens": 15},
+            })
+        return Response.json({
+            "id": "cmpl-1", "object": "chat.completion", "created": 1, "model": "fake-model",
+            "choices": [{"index": 0, "message": {
+                "role": "assistant", "content": None,
+                "tool_calls": [{"id": "call_1", "type": "function",
+                                "function": {"name": "mcp_get_time", "arguments": '{"tz":"UTC"}'}}],
+            }, "finish_reason": "tool_calls"}],
+            "usage": {"prompt_tokens": 8, "completion_tokens": 4, "total_tokens": 12},
+        })
+
+    async def _stream(self, final_round: bool):
+        def chunk(delta, finish=None):
+            return ("data: " + json.dumps({
+                "id": "cmpl-s", "object": "chat.completion.chunk", "created": 1,
+                "model": "fake-model",
+                "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+            }) + "\n\n").encode()
+
+        if final_round:
+            yield chunk({"role": "assistant", "content": ""})
+            yield chunk({"content": "The time "})
+            yield chunk({"content": "is noon."})
+            yield chunk({}, "stop")
+            yield ("data: " + json.dumps({"id": "cmpl-s", "object": "chat.completion.chunk",
+                                          "created": 1, "model": "fake-model", "choices": [],
+                                          "usage": {"prompt_tokens": 10, "completion_tokens": 5,
+                                                    "total_tokens": 15}}) + "\n\n").encode()
+        else:
+            yield chunk({"role": "assistant", "tool_calls": [
+                {"index": 0, "id": "call_1", "type": "function",
+                 "function": {"name": "mcp_get_time", "arguments": ""}}]})
+            yield chunk({"tool_calls": [{"index": 0, "function": {"arguments": '{"tz":"UTC"}'}}]})
+            yield chunk({}, "tool_calls")
+        yield b"data: [DONE]\n\n"
+
+
+# -- unit tests -------------------------------------------------------------
+def test_tool_filter():
+    assert normalize_tool_name("MCP_Get_Time") == "get_time"
+    assert is_tool_allowed("mcp_get_time", "", "")
+    assert is_tool_allowed("mcp_get_time", "get_time", "")
+    assert not is_tool_allowed("mcp_get_time", "other", "")
+    assert not is_tool_allowed("mcp_get_time", "", "get_time")
+    # include wins over exclude (filter.go:32-49)
+    assert is_tool_allowed("mcp_get_time", "get_time", "get_time")
+    tools = [{"name": "a"}, {"name": "b"}]
+    assert [t["name"] for t in filter_tools(tools, "", "b")] == ["a"]
+
+
+def test_sse_fallback_url():
+    assert MCPClient.build_sse_fallback_url("http://h:1/mcp") == "http://h:1/sse"
+    assert MCPClient.build_sse_fallback_url("http://h:1/x") == "http://h:1/x/sse"
+
+
+def test_parse_sse_response():
+    body = b'event: message\ndata: {"jsonrpc":"2.0","result":{}}\n\n'
+    assert MCPClient._parse_sse_response(body) == b'{"jsonrpc":"2.0","result":{}}'
+
+
+# -- client lifecycle -------------------------------------------------------
+async def test_client_init_discovery_and_execute():
+    mcp_srv = FakeMCPServer()
+    port = await mcp_srv.start()
+    cfg = MCPConfig(enable=True, servers=f"http://127.0.0.1:{port}/mcp",
+                    max_retries=1, initial_backoff=0.01, retry_interval=0.05)
+    client = MCPClient(cfg, HTTPClient())
+    await client.initialize_all()
+    assert client.is_initialized()
+    assert client.has_available_servers()
+    tools = client.get_all_chat_completion_tools()
+    assert tools[0]["function"]["name"] == "mcp_get_time"
+    assert client.get_server_for_tool("mcp_get_time") == f"http://127.0.0.1:{port}/mcp"
+
+    result = await client.execute_tool("mcp_get_time", {"tz": "UTC"})
+    assert result["content"][0]["text"] == "result-of-get_time"
+    assert mcp_srv.calls[0]["name"] == "get_time"  # prefix stripped
+    # Cached session id re-sent after first response (transport.go:56-123).
+    assert "sess-123" in mcp_srv.session_header_seen
+    await client.shutdown()
+    await mcp_srv.server.shutdown()
+
+
+async def test_client_sse_transport_fallback():
+    mcp_srv = FakeMCPServer(reject_mcp_path=True, sse_framed=True)
+    port = await mcp_srv.start()
+    cfg = MCPConfig(enable=True, servers=f"http://127.0.0.1:{port}/mcp",
+                    max_retries=1, initial_backoff=0.01)
+    client = MCPClient(cfg, HTTPClient())
+    await client.initialize_all()
+    assert client.has_available_servers()
+    result = await client.execute_tool("mcp_get_time", {})
+    assert result["content"][0]["text"] == "result-of-get_time"
+    await client.shutdown()
+    await mcp_srv.server.shutdown()
+
+
+async def test_client_unreachable_server_degrades():
+    cfg = MCPConfig(enable=True, servers="http://127.0.0.1:1/mcp",
+                    max_retries=1, initial_backoff=0.01, enable_reconnect=True,
+                    reconnect_interval=999)
+    client = MCPClient(cfg, HTTPClient())
+    await client.initialize_all()  # must not raise (init.go:64-77)
+    assert client.is_initialized()
+    assert not client.has_available_servers()
+    await client.shutdown()
+
+
+# -- gateway e2e with agent loop --------------------------------------------
+@pytest.fixture(scope="module")
+def mcp_stack(aloop):
+    mcp_srv = FakeMCPServer()
+    mcp_port = aloop.run(mcp_srv.start())
+    upstream = FakeUpstream()
+    up_port = aloop.run(upstream.start())
+
+    env = {
+        "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+        "MCP_ENABLE": "true",
+        "MCP_EXPOSE": "true",
+        "MCP_SERVERS": f"http://127.0.0.1:{mcp_port}/mcp",
+        "MCP_MAX_RETRIES": "1",
+        "MCP_INITIAL_BACKOFF": "10ms",
+        "MCP_POLLING_INTERVAL": "60s",
+        "SERVER_PORT": "0",
+    }
+    gw = build_gateway(env=env)
+    gw_port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, gw_port, mcp_srv, upstream
+    aloop.run(gw.shutdown())
+    aloop.run(mcp_srv.server.shutdown())
+    aloop.run(upstream.server.shutdown())
+
+
+async def test_list_tools_endpoint(mcp_stack):
+    _, port, _, _ = mcp_stack
+    client = HTTPClient()
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/mcp/tools")
+    assert resp.status == 200
+    data = resp.json()
+    assert data["data"][0]["name"] == "mcp_get_time"
+    assert data["data"][0]["input_schema"]["type"] == "object"
+
+
+async def test_agent_loop_non_streaming(mcp_stack):
+    _, port, mcp_srv, upstream = mcp_stack
+    upstream.requests.clear()
+    mcp_srv.calls.clear()
+    client = HTTPClient()
+    body = {"model": "ollama/fake-model", "messages": [{"role": "user", "content": "what time is it?"}]}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    assert resp.status == 200
+    data = resp.json()
+    assert data["choices"][0]["message"]["content"] == "The time is noon."
+    # Tools were injected into the upstream request (mcp.go:128-134).
+    assert any(t["function"]["name"] == "mcp_get_time" for t in upstream.requests[0]["tools"])
+    # The tool was executed against the MCP server.
+    assert mcp_srv.calls and mcp_srv.calls[0]["name"] == "get_time"
+    # Second upstream call carried the tool result.
+    assert any(m.get("role") == "tool" for m in upstream.requests[1]["messages"])
+
+
+async def test_agent_loop_streaming(mcp_stack):
+    _, port, mcp_srv, upstream = mcp_stack
+    upstream.requests.clear()
+    mcp_srv.calls.clear()
+    client = HTTPClient()
+    body = {"model": "ollama/fake-model", "stream": True,
+            "messages": [{"role": "user", "content": "what time is it?"}]}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                             json.dumps(body).encode(), stream=True)
+    assert resp.status == 200
+
+    payloads = []
+    async for payload in iter_sse_payloads(resp.iter_lines()):
+        payloads.append(json.loads(payload))
+
+    text = "".join(
+        c.get("delta", {}).get("content") or ""
+        for p in payloads for c in p.get("choices", [])
+    )
+    assert text == "The time is noon."
+    assert mcp_srv.calls and mcp_srv.calls[0]["name"] == "get_time"
+    assert len(upstream.requests) == 2
+    # Tool-call deltas from iteration 1 were re-emitted to the client.
+    assert any(
+        c.get("delta", {}).get("tool_calls")
+        for p in payloads for c in p.get("choices", [])
+    )
+
+
+async def test_bypass_header_skips_interception(mcp_stack):
+    _, port, _, upstream = mcp_stack
+    upstream.requests.clear()
+    client = HTTPClient()
+    body = {"model": "ollama/fake-model", "messages": [{"role": "user", "content": "x"}]}
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode(),
+        headers={"X-MCP-Bypass": "true"},
+    )
+    assert resp.status == 200
+    # No tools injected: the upstream saw the raw request.
+    assert "tools" not in upstream.requests[0] or not upstream.requests[0].get("tools")
