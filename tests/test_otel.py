@@ -1,0 +1,229 @@
+"""Observability tests (reference: otel/ingest_test.go,
+tests/api_metrics_test.go, tests/tracing_test.go)."""
+
+import gzip
+import json
+
+import pytest
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.otel.tracing import Tracer, parse_traceparent
+
+
+# -- instruments + prometheus exposition ------------------------------------
+def test_record_and_expose():
+    otel = OpenTelemetry()
+    otel.record_token_usage("gateway", "", "tpu", "llama-3-8b", 100, 50)
+    otel.record_request_duration("gateway", "team-a", "tpu", "llama-3-8b", "", 0.123)
+    otel.record_request_duration("gateway", "", "tpu", "llama-3-8b", "502", 1.5)
+    otel.record_tool_call("gateway", "", "tpu", "llama-3-8b", "mcp", "mcp_get_time")
+
+    text = otel.expose_prometheus()
+    assert "# TYPE gen_ai_client_token_usage histogram" in text
+    assert 'gen_ai_token_type="input"' in text
+    assert 'gen_ai_token_type="output"' in text
+    assert "# TYPE gen_ai_server_request_duration histogram" in text
+    assert 'error_type="502"' in text
+    assert "# TYPE inference_gateway_tool_calls counter" in text
+    assert 'gen_ai_tool_name="mcp_get_time"' in text
+    assert 'team="unknown"' in text  # empty team defaults (otel.go:207)
+    assert 'team="team-a"' in text
+
+
+def test_histogram_buckets_cumulative():
+    otel = OpenTelemetry()
+    for v in (0.005, 0.05, 3.0):
+        otel.record_request_duration("s", "", "p", "m", "", v)
+    text = otel.expose_prometheus()
+    # 0.005 falls in le=0.01; cumulative counts must be monotone.
+    line_001 = next(l for l in text.splitlines() if "request_duration_bucket" in l and 'le="0.01"' in l)
+    assert line_001.endswith(" 1")
+    line_inf = next(l for l in text.splitlines() if "request_duration_bucket" in l and 'le="+Inf"' in l)
+    assert line_inf.endswith(" 3")
+
+
+# -- OTLP JSON ingest --------------------------------------------------------
+def _delta_sum_payload(value=3, service="pusher-svc"):
+    return {
+        "resourceMetrics": [{
+            "resource": {"attributes": [{"key": "service.name", "value": {"stringValue": service}}]},
+            "scopeMetrics": [{
+                "metrics": [{
+                    "name": "inference_gateway.tool_calls",
+                    "sum": {
+                        "aggregationTemporality": 1,
+                        "dataPoints": [{
+                            "asInt": str(value),
+                            "attributes": [
+                                {"key": "gen_ai.tool.name", "value": {"stringValue": "web_search"}},
+                                {"key": "evil.high.cardinality", "value": {"stringValue": "x"}},
+                            ],
+                        }],
+                    },
+                }],
+            }],
+        }]
+    }
+
+
+def test_ingest_delta_sum_with_allowlist():
+    otel = OpenTelemetry()
+    result = otel.ingest_metrics(_delta_sum_payload(), source="client-1")
+    assert result["accepted"] == 1
+    text = otel.expose_prometheus()
+    assert 'gen_ai_tool_name="web_search"' in text
+    assert "evil" not in text  # non-allowlisted attribute dropped
+    assert 'source="pusher-svc"' in text
+
+
+def test_ingest_rejects_cumulative():
+    otel = OpenTelemetry()
+    payload = _delta_sum_payload()
+    payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]["sum"]["aggregationTemporality"] = 2
+    result = otel.ingest_metrics(payload, source="x")
+    assert result["accepted"] == 0
+    assert result["rejected"] == 1
+    assert "delta" in result["error_message"]
+
+
+def test_ingest_gateway_impersonation_guard():
+    otel = OpenTelemetry()
+    result = otel.ingest_metrics(_delta_sum_payload(service="inference-gateway-tpu"), source="sneaky")
+    assert result["accepted"] == 1
+    assert 'source="push:sneaky"' in otel.expose_prometheus()  # ingest.go:190-218
+
+
+def test_ingest_histogram_replay():
+    otel = OpenTelemetry()
+    payload = {
+        "resourceMetrics": [{
+            "resource": {"attributes": []},
+            "scopeMetrics": [{
+                "metrics": [{
+                    "name": "gen_ai.server.time_to_first_token",
+                    "histogram": {
+                        "aggregationTemporality": 1,
+                        "dataPoints": [{
+                            "bucketCounts": ["0", "2", "1"],
+                            "explicitBounds": [0.1, 0.5],
+                            "attributes": [],
+                        }],
+                    },
+                }],
+            }],
+        }]
+    }
+    result = otel.ingest_metrics(payload, source="svc")
+    assert result["accepted"] == 1
+    text = otel.expose_prometheus()
+    line = next(l for l in text.splitlines() if "time_to_first_token_count" in l)
+    assert line.endswith(" 3")
+
+
+# -- tracing ----------------------------------------------------------------
+def test_traceparent_roundtrip():
+    t = Tracer("svc")
+    root = t.start_span("GET /x")
+    header = root.traceparent()
+    parsed = parse_traceparent(header)
+    assert parsed == (root.trace_id, root.span_id)
+    child = t.start_span("child", traceparent=header)
+    assert child.trace_id == root.trace_id
+    assert child.parent_span_id == root.span_id
+    assert parse_traceparent("garbage") is None
+
+
+def test_span_export_payload():
+    t = Tracer("svc", enabled=True)
+    s = t.start_span("op")
+    s.set_attribute("k", "v")
+    s.set_status("ERROR", "boom")
+    t.end_span(s)
+    payload = t.export_payload(t.drain())
+    span = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert span["name"] == "op"
+    assert span["status"]["code"] == 2
+
+
+# -- gateway metrics endpoints ----------------------------------------------
+@pytest.fixture(scope="module")
+def telemetry_gateway(aloop):
+    env = {
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_METRICS_PUSH_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        "SERVER_PORT": "0",
+    }
+    gw = build_gateway(env=env)
+    port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, port
+    aloop.run(gw.shutdown())
+
+
+async def test_metrics_push_endpoint_and_prometheus(telemetry_gateway):
+    gw, port = telemetry_gateway
+    client = HTTPClient()
+
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/metrics",
+        json.dumps(_delta_sum_payload()).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    assert resp.status == 200
+    assert resp.json() == {}
+
+    # gzip-encoded body accepted (api/metrics.go:34-46).
+    gz = gzip.compress(json.dumps(_delta_sum_payload(value=2)).encode())
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/metrics", gz,
+        headers={"Content-Type": "application/json", "Content-Encoding": "gzip"},
+    )
+    assert resp.status == 200
+
+    # Bad JSON -> 400; protobuf -> 415.
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/metrics", b"nope",
+                             headers={"Content-Type": "application/json"})
+    assert resp.status == 400
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/metrics", b"\x00\x01",
+                             headers={"Content-Type": "application/x-protobuf"})
+    assert resp.status == 415
+
+    # Dedicated prometheus listener (main.go:97-115).
+    resp = await client.get(f"http://127.0.0.1:{gw.metrics_port}/metrics")
+    assert resp.status == 200
+    assert "inference_gateway_tool_calls" in resp.body.decode()
+
+
+async def test_telemetry_middleware_records_usage(telemetry_gateway, aloop):
+    """Non-streaming inference response usage lands in the histograms."""
+    from inference_gateway_tpu.netio.server import HTTPServer, Response, Router, Request
+
+    async def chat(req: Request) -> Response:
+        return Response.json({
+            "id": "x", "object": "chat.completion", "created": 1, "model": "fake",
+            "choices": [{"index": 0, "message": {"role": "assistant", "content": "hi"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 7, "completion_tokens": 3, "total_tokens": 10},
+        })
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    r.get("/v1/models", lambda req: Response.json({"data": []}))
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+
+    gw, port = telemetry_gateway
+    # Point ollama at the fake upstream via registry mutation (test-only).
+    gw.registry.get_providers()["ollama"].url = f"http://127.0.0.1:{up_port}/v1"
+
+    client = HTTPClient()
+    body = {"model": "ollama/fake", "messages": [{"role": "user", "content": "x"}]}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+    assert resp.status == 200
+
+    text = gw.otel.expose_prometheus()
+    assert 'gen_ai_provider_name="ollama"' in text
+    assert 'gen_ai_request_model="ollama/fake"' in text
+    await upstream.shutdown()
