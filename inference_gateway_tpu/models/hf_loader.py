@@ -40,6 +40,65 @@ def llama_config_from_hf(hf_cfg: Any) -> LlamaConfig:
     )
 
 
+def mixtral_config_from_hf(hf_cfg: Any):
+    from inference_gateway_tpu.models.mixtral import MixtralConfig
+
+    base = llama_config_from_hf(hf_cfg)
+    return MixtralConfig(
+        **{k: getattr(base, k) for k in (
+            "vocab_size", "hidden_size", "num_layers", "num_heads", "num_kv_heads",
+            "intermediate_size", "head_dim", "rope_theta", "rms_norm_eps",
+            "max_position_embeddings", "tie_word_embeddings", "rope_scaling",
+        )},
+        num_experts=hf_cfg.num_local_experts,
+        experts_per_token=hf_cfg.num_experts_per_tok,
+    )
+
+
+def mixtral_params_from_hf(state_dict: Mapping[str, Any], cfg, dtype=jnp.bfloat16):
+    """HF Mixtral → stacked pytree. Expert tensors: w1=gate, w3=up (both
+    (I,H)), w2=down ((H,I)); router is ``block_sparse_moe.gate``."""
+    L, E = cfg.num_layers, cfg.num_experts
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def get(name: str) -> np.ndarray:
+        return _to_np(sd[name])
+
+    def stack_attn(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        mats = [get(fmt.format(i)) for i in range(L)]
+        return jnp.asarray(np.stack([m.T if transpose else m for m in mats]), dtype)
+
+    def stack_experts(w: str) -> jnp.ndarray:
+        # (L, E, in, out) with our (in, out) convention.
+        per_layer = []
+        for i in range(L):
+            per_expert = [
+                get(f"layers.{i}.block_sparse_moe.experts.{e}.{w}.weight").T for e in range(E)
+            ]
+            per_layer.append(np.stack(per_expert))
+        return jnp.asarray(np.stack(per_layer), dtype)
+
+    params = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "layers": {
+            "attn_norm": stack_attn("layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stack_attn("layers.{}.self_attn.q_proj.weight"),
+            "wk": stack_attn("layers.{}.self_attn.k_proj.weight"),
+            "wv": stack_attn("layers.{}.self_attn.v_proj.weight"),
+            "wo": stack_attn("layers.{}.self_attn.o_proj.weight"),
+            "moe_norm": stack_attn("layers.{}.post_attention_layernorm.weight", transpose=False),
+            "router": stack_attn("layers.{}.block_sparse_moe.gate.weight"),
+            "wg": stack_experts("w1"),
+            "wu": stack_experts("w3"),
+            "wd": stack_experts("w2"),
+        },
+        "final_norm": jnp.asarray(get("norm.weight"), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_to_np(sd["lm_head.weight"]).T, dtype)
+    return params
+
+
 def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig, dtype=jnp.bfloat16):
     """Map HF `model.*` tensors into our stacked pytree.
 

@@ -217,6 +217,82 @@ def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray, targets: jnp.
 
 
 # ---------------------------------------------------------------------------
+# Paged-cache forward (serving fast path)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only"))
+def forward_paged(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # (B, T)
+    positions: jnp.ndarray,  # (B, T)
+    lengths: jnp.ndarray,  # (B,)
+    cache: Params,  # {"k","v"}: (L, P, page_size, Hkv*D)
+    write_idx: jnp.ndarray,  # (B, T) flat page*page_size+offset positions (OOB = drop)
+    page_table: jnp.ndarray,  # (B, max_pages)
+    mode: str = "prefill",
+    last_only: bool = True,
+) -> tuple[jnp.ndarray, Params]:
+    """Like ``forward`` but against the paged KV cache
+    (serving/kv_cache.py). Decode attention runs the Pallas ragged
+    paged-attention kernel (ops/paged_attention.py)."""
+    from inference_gateway_tpu.ops.paged_attention import paged_attention
+
+    B, T = tokens.shape
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    L, P, page_size, HkvD = cache["k"].shape
+    flat = P * page_size
+
+    x = params["embed"][tokens]
+    inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    if mode == "prefill":
+        mask = causal_prefill_mask(positions, lengths)
+    decode = mode == "decode"
+
+    def body(x, per_layer):
+        lp, kc, vc = per_layer
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, Hq, D)
+        k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
+        v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        kc2 = kc.reshape(flat, HkvD)
+        vc2 = vc.reshape(flat, HkvD)
+        k_flat = k.reshape(B, T, HkvD).astype(kc.dtype)
+        v_flat = v.reshape(B, T, HkvD).astype(vc.dtype)
+        kc2 = kc2.at[write_idx].set(k_flat, mode="drop")
+        vc2 = vc2.at[write_idx].set(v_flat, mode="drop")
+        new_kc = kc2.reshape(P, page_size, HkvD)
+        new_vc = vc2.reshape(P, page_size, HkvD)
+
+        if decode:
+            attn = paged_attention(q[:, 0], new_kc, new_vc, page_table, lengths, Hkv)
+            attn = attn[:, None]  # (B, 1, Hq, D)
+        else:
+            attn = gqa_attend(q, k, v, mask)
+        x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
+
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+        return x, (new_kc, new_vc)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    new_cache = {"k": new_k, "v": new_v}
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if last_only:
+        idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
+        x = x[jnp.arange(B), idx]
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
 # Presets
 # ---------------------------------------------------------------------------
 

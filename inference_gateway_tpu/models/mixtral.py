@@ -1,0 +1,209 @@
+"""Mixtral-family sparse-MoE decoder in pure JAX.
+
+Same TPU-first skeleton as models/llama.py (stacked layers + lax.scan,
+one forward for prefill/decode over the slot cache) with the dense MLP
+replaced by a top-2 mixture of 8 experts (ops/moe.py). Expert weights
+carry a leading expert axis sharded on the mesh's ``ep`` axis — the
+expert-parallel layout for BASELINE config 5 (Mixtral-8x7B over v5e-16).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from inference_gateway_tpu.models.llama import LlamaConfig
+from inference_gateway_tpu.ops.attention import causal_prefill_mask, decode_mask, gqa_attend
+from inference_gateway_tpu.ops.moe import default_capacity, moe_capacity, moe_dense
+from inference_gateway_tpu.ops.norms import rms_norm
+from inference_gateway_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 2.0
+    moe_impl: str = "capacity"  # "capacity" (EP-shardable) | "dense" (exact)
+
+
+Params = dict[str, Any]
+
+
+def init_params(rng: jax.Array, cfg: MixtralConfig, dtype=jnp.bfloat16) -> Params:
+    L, H, I, V, E = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_experts
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    keys = jax.random.split(rng, 10)
+
+    def norm(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+
+    params: Params = {
+        "embed": norm(keys[0], (V, H)),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": norm(keys[1], (L, H, Hq * D)),
+            "wk": norm(keys[2], (L, H, Hkv * D)),
+            "wv": norm(keys[3], (L, H, Hkv * D)),
+            "wo": norm(keys[4], (L, Hq * D, H)),
+            "moe_norm": jnp.ones((L, H), dtype),
+            "router": norm(keys[5], (L, H, E)),
+            # Expert FFNs: leading E axis → ep sharding.
+            "wg": norm(keys[6], (L, E, H, I)),
+            "wu": norm(keys[7], (L, E, H, I)),
+            "wd": norm(keys[8], (L, E, I, H)),
+        },
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(keys[9], (H, V))
+    return params
+
+
+def init_cache(cfg: MixtralConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _moe_block(x: jnp.ndarray, lp: Params, cfg: MixtralConfig) -> jnp.ndarray:
+    """x: (B, T, H) → MoE FFN output."""
+    B, T, H = x.shape
+    flat = x.reshape(B * T, H)
+    router_logits = (flat @ lp["router"].astype(flat.dtype)).astype(jnp.float32)
+
+    def expert_fn(inp):  # (E, N', H)
+        g = jnp.einsum("enh,ehi->eni", inp, lp["wg"], preferred_element_type=jnp.float32)
+        u = jnp.einsum("enh,ehi->eni", inp, lp["wu"], preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(g) * u).astype(inp.dtype)
+        return jnp.einsum("eni,eih->enh", act, lp["wd"], preferred_element_type=jnp.float32).astype(inp.dtype)
+
+    if cfg.moe_impl == "dense":
+        out = moe_dense(flat, router_logits, cfg.experts_per_token, expert_fn)
+    else:
+        cap = default_capacity(B * T, cfg.num_experts, cfg.experts_per_token, cfg.capacity_factor)
+        out = moe_capacity(flat, router_logits, cfg.experts_per_token, expert_fn, cap)
+    return out.reshape(B, T, H)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only"))
+def forward(
+    params: Params,
+    cfg: MixtralConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cache: Params | None = None,
+    mode: str = "prefill",
+    last_only: bool = False,
+    slot_ids: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, Params | None]:
+    """Same contract as models/llama.forward."""
+    B, T = tokens.shape
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    x = params["embed"][tokens]
+    inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    decode = mode == "decode"
+    if decode:
+        assert cache is not None
+        S = cache["k"].shape[2]
+        mask = decode_mask(S, lengths)
+        scatter_pos = positions
+    else:
+        mask = causal_prefill_mask(positions, lengths)
+        if cache is not None:
+            S = cache["k"].shape[2]
+            valid = jnp.arange(T)[None, :] < lengths[:, None]
+            scatter_pos = jnp.where(valid, positions, S)
+        else:
+            scatter_pos = None
+
+    def layer(x, lp, kc, vc):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, Hq, D)
+        k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
+        v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        nk = nv = None
+        if kc is not None:
+            rows = (jnp.arange(B) if slot_ids is None else slot_ids)[:, None]
+            nk = kc.at[rows, scatter_pos].set(k.astype(kc.dtype), mode="drop")
+            nv = vc.at[rows, scatter_pos].set(v.astype(vc.dtype), mode="drop")
+        if decode:
+            attn = gqa_attend(q, nk.astype(q.dtype), nv.astype(q.dtype), mask)
+        else:
+            attn = gqa_attend(q, k, v, mask)
+        x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
+
+        h = rms_norm(x, lp["moe_norm"], cfg.rms_norm_eps)
+        x = x + _moe_block(h, lp, cfg)
+        return x, nk, nv
+
+    if cache is not None:
+        def body(x, per_layer):
+            lp, kc, vc = per_layer
+            x, nk, nv = layer(x, lp, kc, vc)
+            return x, (nk, nv)
+
+        x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": new_k, "v": new_v}
+    else:
+        def body(x, lp):
+            x, _, _ = layer(x, lp, None, None)
+            return x, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if last_only:
+        idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
+        x = x[jnp.arange(B), idx]
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits, new_cache
+
+
+def param_specs(cfg: MixtralConfig) -> dict:
+    """PartitionSpecs: experts on ep, tp inside each expert FFN."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "moe_norm": P(None, None),
+            "router": P(None, None, None),
+            "wg": P(None, "ep", None, "tp"),
+            "wu": P(None, "ep", None, "tp"),
+            "wd": P(None, "ep", "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+PRESETS: dict[str, MixtralConfig] = {
+    "mixtral-test-tiny": MixtralConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        intermediate_size=96, num_experts=4, experts_per_token=2,
+        max_position_embeddings=512,
+    ),
+    "mixtral-8x7b": MixtralConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,
+        intermediate_size=14336, num_experts=8, experts_per_token=2,
+        rope_theta=1000000.0, max_position_embeddings=32768,
+    ),
+}

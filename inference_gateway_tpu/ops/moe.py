@@ -1,0 +1,87 @@
+"""Mixture-of-experts ops: top-k routing + expert dispatch/combine.
+
+TPU-first design (SURVEY.md §2.4 EP row): dispatch is expressed as
+einsums against one-hot dispatch/combine tensors with a *static* expert
+capacity — the GShard/Switch pattern. Under a mesh with tokens sharded
+on ``dp`` and experts sharded on ``ep``, XLA lowers the dispatch and
+combine einsums to the ragged all-to-alls the reference plan calls for,
+with no hand-written collectives.
+
+Two paths share the routing math:
+- ``moe_dense``: every expert runs on every token, outputs weighted by
+  router probs. Exact; O(E) compute. Numerics oracle + tiny models.
+- ``moe_capacity``: capacity-bounded dispatch (tokens over capacity are
+  dropped, like the reference MoE serving systems). EP-shardable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def router_topk(logits: jnp.ndarray, top_k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k routing with renormalized softmax weights.
+
+    logits: (N, E) → (weights (N, k), idx (N, k)). Matches Mixtral: softmax
+    over the top-k logits only.
+    """
+    vals, idx = jax.lax.top_k(logits, top_k)  # (N, k)
+    weights = jax.nn.softmax(vals.astype(jnp.float32), axis=-1)
+    return weights, idx
+
+
+def moe_dense(x: jnp.ndarray, router_logits: jnp.ndarray, top_k: int, expert_fn) -> jnp.ndarray:
+    """Exact MoE: run all experts, combine by routing weight.
+
+    x: (N, H); router_logits: (N, E); expert_fn: (E, N, H) -> (E, N, H).
+    """
+    N, H = x.shape
+    E = router_logits.shape[-1]
+    weights, idx = router_topk(router_logits, top_k)  # (N, k)
+    # Scatter top-k weights into a dense (N, E) combine matrix.
+    combine = jnp.zeros((N, E), jnp.float32)
+    combine = combine.at[jnp.arange(N)[:, None], idx].add(weights)
+    expert_out = expert_fn(jnp.broadcast_to(x, (E, N, H)))  # (E, N, H)
+    return jnp.einsum("ne,enh->nh", combine, expert_out.astype(jnp.float32)).astype(x.dtype)
+
+
+def moe_capacity(
+    x: jnp.ndarray,  # (N, H)
+    router_logits: jnp.ndarray,  # (N, E)
+    top_k: int,
+    expert_fn,  # (E, C, H) -> (E, C, H)
+    capacity: int,
+) -> jnp.ndarray:
+    """Capacity-bounded dispatch/combine (GShard-style einsum MoE)."""
+    N, H = x.shape
+    E = router_logits.shape[-1]
+    weights, idx = router_topk(router_logits, top_k)  # (N, k)
+
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (N, k, E)
+    # Position of each (token, choice) within its expert's queue: tokens
+    # first by sequence position, then by choice rank.
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * N, E)  # choices-major
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # (k*N, E)
+    position = pos_flat.reshape(top_k, N, E).transpose(1, 0, 2)  # (N, k, E)
+    position = jnp.sum(position * onehot, axis=-1)  # (N, k)
+
+    keep = position < capacity
+    w = weights * keep.astype(weights.dtype)
+
+    pos_onehot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)  # (N, k, C)
+    # dispatch/combine tensors: (N, E, C)
+    dispatch = jnp.einsum("nke,nkc->nec", onehot * keep[..., None].astype(jnp.float32), pos_onehot)
+    combine = jnp.einsum("nke,nkc,nk->nec", onehot, pos_onehot, w)
+
+    expert_in = jnp.einsum("nec,nh->ech", dispatch, x.astype(jnp.float32)).astype(x.dtype)
+    expert_out = expert_fn(expert_in)  # (E, C, H)
+    out = jnp.einsum("nec,ech->nh", combine, expert_out.astype(jnp.float32))
+    return out.astype(x.dtype)
+
+
+def default_capacity(n_tokens: int, num_experts: int, top_k: int, capacity_factor: float = 2.0) -> int:
+    """Static per-expert queue length; generous default so balanced loads
+    rarely drop."""
+    raw = int(n_tokens * top_k * capacity_factor / num_experts)
+    return max(8, min(n_tokens, ((raw + 7) // 8) * 8))

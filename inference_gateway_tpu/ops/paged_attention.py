@@ -1,0 +1,215 @@
+"""Ragged paged attention for single-token decode.
+
+The serving hot op (SURVEY.md §7 stage 5; RPA paper in PAPERS.md): each
+decode step attends a query token per slot against that slot's KV pages.
+Reading *only* the pages a sequence actually occupies makes decode
+bandwidth proportional to live tokens instead of the cache's static max
+length — the core paged-attention win.
+
+Layout: kv pages are (num_pages, page_size, Hkv*D) with heads folded
+into the last axis. That keeps the DMA'd minor dimension 128-lane
+aligned (Mosaic requires it: D alone is often 64), while per-head views
+are free VMEM slices inside the kernel. The page table is (B, max_pages)
+int32; lengths (B,) count valid tokens per slot.
+
+Two implementations, one contract:
+
+- ``paged_attention_jax``: pure-JAX reference (gather pages → dense
+  masked attention). CPU/test path and numerics oracle.
+- ``paged_attention_tpu``: Pallas kernel. Grid over (slot,); each
+  instance streams its slot's pages HBM→VMEM with double-buffered async
+  DMA while a flash-style (m, l, acc) accumulator folds pages in; tail
+  pages are masked by length.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (also the CPU path)
+# ---------------------------------------------------------------------------
+def paged_attention_jax(
+    q: jnp.ndarray,  # (B, Hq, D)
+    k_pages: jnp.ndarray,  # (P, page_size, Hkv*D)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # (B, max_pages) int32
+    lengths: jnp.ndarray,  # (B,) int32 — valid tokens (0 = inactive slot)
+    num_kv_heads: int,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    _, page_size, HkvD = k_pages.shape
+    Hkv = num_kv_heads
+    G = Hq // Hkv
+    max_pages = page_table.shape[1]
+    S = max_pages * page_size
+
+    k = k_pages[page_table].reshape(B, S, Hkv, D)
+    v = v_pages[page_table].reshape(B, S, Hkv, D)
+
+    qg = q.reshape(B, Hkv, G, D)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * (D ** -0.5)
+    valid = jnp.arange(S)[None, :] < lengths[:, None]  # (B, S)
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return out.reshape(B, Hq, D).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _paged_attn_kernel(
+    # scalar prefetch
+    page_table_ref,  # (B, max_pages) SMEM
+    length_ref,  # (B, 1) SMEM
+    # inputs
+    q_ref,  # (1, Hq, D) VMEM block for this slot
+    k_pages_hbm,  # (P, page_size, Hkv*D) in ANY/HBM
+    v_pages_hbm,
+    # output
+    out_ref,  # (1, Hq, D) VMEM
+    # scratch
+    k_buf,  # (2, page_size, Hkv*D) VMEM
+    v_buf,
+    sems,  # DMA semaphores (2, 2)
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    groups: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    length = length_ref[b, 0]
+    n_pages = pl.cdiv(length, page_size)
+    scale = head_dim ** -0.5
+    Hkv, G, D = num_kv_heads, groups, head_dim
+    Hq = Hkv * G
+
+    def page_dma(slot, page_pos):
+        page_idx = page_table_ref[b, page_pos]
+        k_dma = pltpu.make_async_copy(k_pages_hbm.at[page_idx], k_buf.at[slot], sems.at[slot, 0])
+        v_dma = pltpu.make_async_copy(v_pages_hbm.at[page_idx], v_buf.at[slot], sems.at[slot, 1])
+        return k_dma, v_dma
+
+    @pl.when(n_pages > 0)
+    def _():
+        for dma in page_dma(0, 0):
+            dma.start()
+
+    q = q_ref[0].astype(jnp.float32)  # (Hq, D)
+
+    def body(p, carry):
+        m, l, acc = carry  # (Hq,1), (Hq,1), (Hq,D)
+        slot = jax.lax.rem(p, 2)
+        next_slot = jax.lax.rem(p + 1, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _():
+            for dma in page_dma(next_slot, p + 1):
+                dma.start()
+
+        for dma in page_dma(slot, p):
+            dma.wait()
+
+        k_page = k_buf[slot].astype(jnp.float32)  # (page_size, Hkv*D)
+        v_page = v_buf[slot].astype(jnp.float32)
+
+        token_pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        valid = token_pos < length  # (1, page_size)
+
+        # Per-kv-head slices of the folded axis; static unroll over Hkv.
+        score_rows = []
+        for h in range(Hkv):
+            k_h = k_page[:, h * D:(h + 1) * D]  # (page_size, D)
+            q_h = q[h * G:(h + 1) * G]  # (G, D)
+            score_rows.append(jax.lax.dot_general(
+                q_h, k_h, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))  # (G, page_size)
+        scores = jnp.concatenate(score_rows, axis=0) * scale  # (Hq, page_size)
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p_ij = jnp.exp(scores - m_new)  # (Hq, page_size)
+        l_new = l * alpha + jnp.sum(p_ij, axis=-1, keepdims=True)
+
+        pv_rows = []
+        for h in range(Hkv):
+            v_h = v_page[:, h * D:(h + 1) * D]  # (page_size, D)
+            p_h = p_ij[h * G:(h + 1) * G]  # (G, page_size)
+            pv_rows.append(jnp.dot(p_h, v_h, preferred_element_type=jnp.float32))  # (G, D)
+        pv = jnp.concatenate(pv_rows, axis=0)  # (Hq, D)
+
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((Hq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hq, 1), jnp.float32)
+    acc0 = jnp.zeros((Hq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("num_kv_heads", "interpret"))
+def paged_attention_tpu(
+    q: jnp.ndarray,  # (B, Hq, D)
+    k_pages: jnp.ndarray,  # (P, page_size, Hkv*D)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # (B, max_pages)
+    lengths: jnp.ndarray,  # (B,)
+    num_kv_heads: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    P, page_size, HkvD = k_pages.shape
+    G = Hq // num_kv_heads
+
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        page_size=page_size,
+        num_kv_heads=num_kv_heads,
+        groups=G,
+        head_dim=D,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, HkvD), k_pages.dtype),
+            pltpu.VMEM((2, page_size, HkvD), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.reshape(B, 1).astype(jnp.int32), q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int) -> jnp.ndarray:
+    """Dispatch: Pallas kernel on TPU (when the folded head axis is
+    lane-aligned), JAX reference elsewhere."""
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon") and k_pages.shape[-1] % 128 == 0:
+        return paged_attention_tpu(q, k_pages, v_pages, page_table, lengths, num_kv_heads)
+    return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads)

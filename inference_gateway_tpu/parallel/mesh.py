@@ -23,6 +23,7 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 AXES = ("dp", "sp", "tp")
+MOE_AXES = ("dp", "sp", "ep", "tp")
 
 
 def create_mesh(
@@ -41,6 +42,20 @@ def create_mesh(
         raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
     dev_array = mesh_utils.create_device_mesh(shape, devices=devices, allow_split_physical_axes=True)
     return Mesh(dev_array, axis_names)
+
+
+def create_moe_mesh(dp: int = 1, sp: int = 1, ep: int = 1, tp: int = 1, devices=None) -> Mesh:
+    """(dp, sp, ep, tp) mesh for expert-parallel MoE serving: experts on
+    ``ep`` ride ICI for the dispatch all-to-alls; ``tp`` shards within
+    each expert (BASELINE config 5: Mixtral-8x7B over v5e-16)."""
+    shape = (dp, sp, ep, tp)
+    n = math.prod(shape)
+    if devices is None:
+        devices = jax.devices()[:n]
+    if len(devices) != n:
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    dev_array = mesh_utils.create_device_mesh(shape, devices=devices, allow_split_physical_axes=True)
+    return Mesh(dev_array, MOE_AXES)
 
 
 def default_mesh_shape(n_devices: int, max_tp: int = 8) -> tuple[int, int, int]:

@@ -50,6 +50,9 @@ class EngineConfig:
     top_k: int = 64
     seed: int = 0
     use_mesh: bool = True  # shard over all visible devices when >1
+    attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
+    page_size: int = 32
+    num_pages: int = 0  # 0 = full reservation
 
 
 @dataclass
@@ -91,15 +94,34 @@ class Engine:
             params = shard_params(params, self.mesh, llama_param_specs(self.model_cfg))
         self.params = params
 
-        cache = llama.init_cache(self.model_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
-        if self.mesh is not None:
-            # Slot axis stays replicated (slots are scheduled host-side);
-            # kv-heads shard on tp.
-            from jax.sharding import PartitionSpec as P
+        # Paged attention is single-device this round; tp-sharded paged
+        # decode lands with shard_map integration.
+        self.paged = config.attention == "paged" and self.mesh is None
+        self.allocator = None
+        if self.paged:
+            from inference_gateway_tpu.serving.kv_cache import (
+                PagedCacheConfig,
+                PageAllocator,
+                init_paged_cache,
+            )
 
-            cache_specs = {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}
-            cache = jax.device_put(cache, named(self.mesh, cache_specs))
-        self.cache = cache
+            self.page_cfg = PagedCacheConfig(
+                page_size=config.page_size, num_pages=config.num_pages,
+                max_slots=config.max_slots, max_seq_len=config.max_seq_len,
+            )
+            self.allocator = PageAllocator(self.page_cfg)
+            self.cache = init_paged_cache(self.model_cfg, self.page_cfg, dtype=self.dtype)
+            self._flat_size = self.allocator.num_pages * config.page_size
+        else:
+            cache = llama.init_cache(self.model_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
+            if self.mesh is not None:
+                # Slot axis stays replicated (slots are scheduled
+                # host-side); kv-heads shard on tp.
+                from jax.sharding import PartitionSpec as P
+
+                cache_specs = {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}
+                cache = jax.device_put(cache, named(self.mesh, cache_specs))
+            self.cache = cache
 
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_counter = 0
@@ -161,6 +183,28 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
+    @partial(jax.jit, static_argnames=("self",))
+    def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
+                          page_table, temps, top_ps, rng):
+        logits, cache = llama.forward_paged(
+            params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
+            page_table, mode="prefill", last_only=True,
+        )
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _decode_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
+                         page_table, temps, top_ps, rng):
+        logits, cache = llama.forward_paged(
+            params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
+            page_table, mode="decode", last_only=True,
+        )
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
     # ------------------------------------------------------------------
     def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float], top_ps: list[float]) -> list[PrefillResult]:
         """Prefill a batch of prompts into their slots; returns each
@@ -184,11 +228,23 @@ class Engine:
         positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (Bp, bucket))
 
         with self._lock:
-            toks, logprobs, self.cache = self._prefill_fn(
-                self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
-                jnp.asarray(p_arr), self._next_rng(),
-            )
+            if self.paged:
+                write_idx = np.full((Bp, bucket), self._flat_size, np.int64)  # OOB = drop
+                for i, (prompt, slot) in enumerate(zip(prompts, slots)):
+                    self.allocator.ensure_capacity(slot, len(prompt))
+                    write_idx[i, : len(prompt)] = self.allocator.flat_write_indices(slot, 0, len(prompt))
+                toks, logprobs, self.cache = self._prefill_fn_paged(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lengths), jnp.asarray(write_idx),
+                    jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
+                    jnp.asarray(p_arr), self._next_rng(),
+                )
+            else:
+                toks, logprobs, self.cache = self._prefill_fn(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
+                    jnp.asarray(p_arr), self._next_rng(),
+                )
             self.metrics["prefill_tokens"] += int(lengths.sum())
             self.metrics["prefill_batches"] += 1
         toks = np.asarray(toks)
@@ -205,18 +261,39 @@ class Engine:
         S = self.config.max_slots
         assert tokens.shape == (S,)
         with self._lock:
-            toks, logprobs, self.cache = self._decode_fn(
-                self.params, self.cache,
-                jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
-                jnp.asarray(lengths), jnp.asarray(temps), jnp.asarray(top_ps),
-                self._next_rng(),
-            )
+            if self.paged:
+                write_idx = np.full((S, 1), self._flat_size, np.int64)
+                for slot in range(S):
+                    if lengths[slot] > 0:
+                        pos = int(positions[slot])
+                        self.allocator.ensure_capacity(slot, pos + 1)
+                        write_idx[slot, 0] = self.allocator.flat_write_indices(slot, pos, 1)[0]
+                toks, logprobs, self.cache = self._decode_fn_paged(
+                    self.params, self.cache,
+                    jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+                    jnp.asarray(lengths), jnp.asarray(write_idx),
+                    jnp.asarray(self.allocator.page_table()), jnp.asarray(temps),
+                    jnp.asarray(top_ps), self._next_rng(),
+                )
+            else:
+                toks, logprobs, self.cache = self._decode_fn(
+                    self.params, self.cache,
+                    jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+                    jnp.asarray(lengths), jnp.asarray(temps), jnp.asarray(top_ps),
+                    self._next_rng(),
+                )
             active = int((lengths > 0).sum())
             self.metrics["decode_tokens"] += active
             self.metrics["decode_steps"] += 1
         return np.asarray(toks), np.asarray(logprobs)
 
     # ------------------------------------------------------------------
+    def release_slot(self, slot: int) -> None:
+        """Return a finished slot's KV pages to the pool."""
+        if self.allocator is not None:
+            with self._lock:
+                self.allocator.release(slot)
+
     def context_window(self) -> int:
         return min(self.config.max_seq_len, self.model_cfg.max_position_embeddings)
 
@@ -229,4 +306,5 @@ class Engine:
             np.zeros((S,), np.float32), np.ones((S,), np.float32),
         )
         self.prefill([[1, 2, 3]], [0], [0.0], [1.0])
+        self.release_slot(0)
         return time.perf_counter() - t0

@@ -1,0 +1,101 @@
+"""Paged KV cache: device pages + host-side page allocator.
+
+vLLM-style paging re-designed for XLA's static-shape world (SURVEY.md §7
+"the hard parts"): the device holds a fixed pool of KV pages per layer,
+(L, num_pages, page_size, Hkv*D) — heads folded into the minor axis for
+lane-aligned page DMA (ops/paged_attention.py). The allocator is plain
+host Python: slots own ordered page lists, pages are allocated at
+prefill admission and lazily when decode crosses a page boundary, and
+freeing a slot returns its pages to the pool. The jitted step functions
+only ever see dense int32 arrays (page table, flat write indices), so no
+recompilation happens as requests come and go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from inference_gateway_tpu.models.llama import LlamaConfig
+
+
+class OutOfPagesError(RuntimeError):
+    pass
+
+
+@dataclass
+class PagedCacheConfig:
+    page_size: int = 32
+    num_pages: int = 0  # 0 = full reservation: max_slots * max_seq_len / page_size
+    max_slots: int = 8
+    max_seq_len: int = 512
+
+    def resolve_num_pages(self) -> int:
+        if self.num_pages:
+            return self.num_pages
+        return self.max_slots * ((self.max_seq_len + self.page_size - 1) // self.page_size)
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        return (self.max_seq_len + self.page_size - 1) // self.page_size
+
+
+class PageAllocator:
+    """Host-side page bookkeeping; not thread-safe (engine holds the lock)."""
+
+    def __init__(self, cfg: PagedCacheConfig):
+        self.cfg = cfg
+        self.num_pages = cfg.resolve_num_pages()
+        self._free: list[int] = list(range(self.num_pages))
+        self._slot_pages: dict[int, list[int]] = {}
+        # Dense page table handed to jit; row per slot, padded with
+        # num_pages (an out-of-range page the kernels never dereference
+        # because lengths bound the walk).
+        self._table = np.zeros((cfg.max_slots, self.cfg.max_pages_per_slot), np.int32)
+
+    def free_page_count(self) -> int:
+        return len(self._free)
+
+    def pages_of(self, slot: int) -> list[int]:
+        return self._slot_pages.get(slot, [])
+
+    def ensure_capacity(self, slot: int, n_tokens: int) -> None:
+        """Grow the slot's page list to cover n_tokens total tokens."""
+        pages = self._slot_pages.setdefault(slot, [])
+        needed = (n_tokens + self.cfg.page_size - 1) // self.cfg.page_size
+        if needed > self.cfg.max_pages_per_slot:
+            raise OutOfPagesError(f"slot {slot} needs {needed} pages > per-slot max")
+        while len(pages) < needed:
+            if not self._free:
+                raise OutOfPagesError("KV page pool exhausted")
+            page = self._free.pop()
+            self._table[slot, len(pages)] = page
+            pages.append(page)
+
+    def release(self, slot: int) -> None:
+        pages = self._slot_pages.pop(slot, [])
+        self._free.extend(pages)
+        self._table[slot, :] = 0
+
+    def page_table(self) -> np.ndarray:
+        return self._table
+
+    def flat_write_indices(self, slot: int, start: int, count: int) -> np.ndarray:
+        """Flat (page*page_size + offset) cache positions for tokens
+        [start, start+count) of this slot."""
+        ps = self.cfg.page_size
+        pages = self._slot_pages.get(slot, [])
+        out = np.empty((count,), np.int64)
+        for i in range(count):
+            t = start + i
+            out[i] = pages[t // ps] * ps + (t % ps)
+        return out
+
+
+def init_paged_cache(model_cfg: LlamaConfig, cache_cfg: PagedCacheConfig, dtype=jnp.bfloat16):
+    """Device arrays: k/v of shape (L, num_pages, page_size, Hkv*D)."""
+    P = cache_cfg.resolve_num_pages()
+    shape = (model_cfg.num_layers, P, cache_cfg.page_size, model_cfg.num_kv_heads * model_cfg.hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -166,6 +166,7 @@ class Scheduler:
         return finished, reason
 
     def _release(self, slot: int, reason: str | None) -> None:
+        self.engine.release_slot(slot)  # frees KV pages in paged mode
         with self._wake:
             self._free.append(slot)
             self._wake.notify()
