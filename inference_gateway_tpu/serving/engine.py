@@ -53,6 +53,12 @@ class EngineConfig:
     attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
     page_size: int = 32
     num_pages: int = 0  # 0 = full reservation
+    # Decode steps fused into one jitted scan per host roundtrip. Token
+    # sampling feeds back on-device; the host reads a (chunk, slots)
+    # token block once per chunk. Larger chunks amortize host↔device
+    # latency (dominant through remote-TPU tunnels) at the cost of up to
+    # chunk-1 wasted steps per finished request.
+    decode_chunk: int = 8
 
 
 @dataclass
@@ -163,7 +169,7 @@ class Engine:
         return jax.random.fold_in(self._rng, self._step_counter)
 
     # ------------------------------------------------------------------
-    @partial(jax.jit, static_argnames=("self",))
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
         logits, cache = llama.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
@@ -173,7 +179,7 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
-    @partial(jax.jit, static_argnames=("self",))
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _decode_fn(self, params, cache, tokens, positions, lengths, temps, top_ps, rng):
         logits, cache = llama.forward(
             params, self.model_cfg, tokens, positions, lengths, cache, mode="decode",
@@ -183,7 +189,51 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
-    @partial(jax.jit, static_argnames=("self",))
+    @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
+    def _decode_chunk_fn(self, params, cache, tokens, positions, temps, top_ps, rng, n_steps):
+        """n_steps fused decode steps (lax.scan); sampling feeds back
+        on-device so the host syncs once per chunk."""
+
+        def step(carry, i):
+            cache, tok, pos = carry
+            logits, cache = llama.forward(
+                params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache, mode="decode",
+            )
+            logits = logits[:, 0]
+            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps, top_k=self.config.top_k)
+            nxt = nxt.astype(jnp.int32)
+            logprobs = compute_logprobs(logits, nxt)
+            return (cache, nxt, pos + 1), (nxt, logprobs)
+
+        (cache, _, _), (toks, logprobs) = jax.lax.scan(
+            step, (cache, tokens, positions), jnp.arange(n_steps)
+        )
+        return toks, logprobs, cache  # (n, S)
+
+    @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
+    def _decode_chunk_fn_paged(self, params, cache, tokens, positions, write_idx,
+                               page_table, temps, top_ps, rng, n_steps):
+        """Paged variant: write_idx is (S, n_steps) precomputed flat cache
+        positions (OOB = drop)."""
+
+        def step(carry, inputs):
+            cache, tok, pos = carry
+            i, w_idx = inputs
+            logits, cache = llama.forward_paged(
+                params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache,
+                w_idx[:, None], page_table, mode="decode", last_only=True,
+            )
+            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps, top_k=self.config.top_k)
+            nxt = nxt.astype(jnp.int32)
+            logprobs = compute_logprobs(logits, nxt)
+            return (cache, nxt, pos + 1), (nxt, logprobs)
+
+        (cache, _, _), (toks, logprobs) = jax.lax.scan(
+            step, (cache, tokens, positions), (jnp.arange(n_steps), write_idx.T)
+        )
+        return toks, logprobs, cache
+
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
                           page_table, temps, top_ps, rng):
         logits, cache = llama.forward_paged(
@@ -194,7 +244,7 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
-    @partial(jax.jit, static_argnames=("self",))
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _decode_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
                          page_table, temps, top_ps, rng):
         logits, cache = llama.forward_paged(
@@ -287,6 +337,44 @@ class Engine:
             self.metrics["decode_steps"] += 1
         return np.asarray(toks), np.asarray(logprobs)
 
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray, active: np.ndarray,
+                     temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None):
+        """Run ``n_steps`` fused decode steps for ALL slots.
+
+        tokens/positions: (S,) pending token + its write position per
+        slot; active: (S,) bool. Returns (tokens, logprobs) as numpy
+        (n_steps, S) — one host readback per chunk.
+        """
+        S = self.config.max_slots
+        n = n_steps or self.config.decode_chunk
+        with self._lock:
+            if self.paged:
+                write_idx = np.full((S, n), self._flat_size, np.int64)
+                for slot in range(S):
+                    if active[slot]:
+                        pos = int(positions[slot])
+                        cap = min(pos + n, self.config.max_seq_len)
+                        valid = max(0, cap - pos)
+                        if valid:
+                            self.allocator.ensure_capacity(slot, cap)
+                            write_idx[slot, :valid] = self.allocator.flat_write_indices(slot, pos, valid)
+                toks, logprobs, self.cache = self._decode_chunk_fn_paged(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(write_idx), jnp.asarray(self.allocator.page_table()),
+                    jnp.asarray(temps), jnp.asarray(top_ps), self._next_rng(), n_steps=n,
+                )
+            else:
+                toks, logprobs, self.cache = self._decode_chunk_fn(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(temps), jnp.asarray(top_ps), self._next_rng(), n_steps=n,
+                )
+            n_active = int(active.sum())
+            self.metrics["decode_tokens"] += n_active * n
+            self.metrics["decode_steps"] += n
+            # Single fused readback (tokens + logprobs in one transfer).
+            both = np.asarray(jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0))
+        return both[:n].astype(np.int32), both[n:]
+
     # ------------------------------------------------------------------
     def release_slot(self, slot: int) -> None:
         """Return a finished slot's KV pages to the pool."""
@@ -304,6 +392,14 @@ class Engine:
         self.decode(
             np.zeros((S,), np.int32), np.zeros((S,), np.int32), np.zeros((S,), np.int32),
             np.zeros((S,), np.float32), np.ones((S,), np.float32),
+        )
+        self.decode_chunk(
+            np.zeros((S,), np.int32), np.zeros((S,), np.int32), np.zeros((S,), bool),
+            np.zeros((S,), np.float32), np.ones((S,), np.float32),
+        )
+        self.decode_chunk(
+            np.zeros((S,), np.int32), np.zeros((S,), np.int32), np.zeros((S,), bool),
+            np.zeros((S,), np.float32), np.ones((S,), np.float32), n_steps=1,
         )
         self.prefill([[1, 2, 3]], [0], [0.0], [1.0])
         self.release_slot(0)

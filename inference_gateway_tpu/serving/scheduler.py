@@ -122,31 +122,46 @@ class Scheduler:
             self._slots[res.slot] = state
 
     def _decode_step(self) -> None:
+        """One fused decode chunk for all active slots.
+
+        The engine scans ``decode_chunk`` steps on-device and the host
+        reads the whole (chunk, slots) token block back once — the only
+        per-chunk host↔device sync. Requests that finish mid-chunk have
+        their trailing tokens discarded (bounded wasted work).
+        """
         S = self.engine.config.max_slots
         tokens = np.zeros((S,), np.int32)
         positions = np.zeros((S,), np.int32)
-        lengths = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
         temps = np.zeros((S,), np.float32)
         top_ps = np.ones((S,), np.float32)
         for slot, st in self._slots.items():
             tokens[slot] = st.pending_token
             positions[slot] = st.pos
-            lengths[slot] = st.pos + 1
+            active[slot] = True
             temps[slot] = st.req.temperature
             top_ps[slot] = st.req.top_p
 
-        toks, logprobs = self.engine.decode(tokens, positions, lengths, temps, top_ps)
+        # Shrink the chunk when new work is waiting so admission latency
+        # stays bounded; otherwise run the full configured chunk.
+        n = self.engine.config.decode_chunk
+        with self._wake:
+            if self._waiting and self._free:
+                n = 1
+        toks, logprobs = self.engine.decode_chunk(tokens, positions, active, temps, top_ps, n_steps=n)
 
         for slot in list(self._slots):
             st = self._slots[slot]
-            st.pos += 1
-            st.pending_token = int(toks[slot])
-            st.pending_logprob = float(logprobs[slot])
-            st.generated += 1
-            finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
-            if finished:
-                del self._slots[slot]
-                self._release(slot, reason)
+            for j in range(toks.shape[0]):
+                st.pos += 1
+                st.pending_token = int(toks[j, slot])
+                st.pending_logprob = float(logprobs[j, slot])
+                st.generated += 1
+                finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
+                if finished:
+                    del self._slots[slot]
+                    self._release(slot, reason)
+                    break
 
     def _emit(self, st: _SlotState, token: int, logprob: float) -> tuple[bool, str | None]:
         """Send one token to the request's callback; decide termination."""
