@@ -40,6 +40,81 @@ def llama_config_from_hf(hf_cfg: Any) -> LlamaConfig:
     )
 
 
+def clip_vision_config_from_hf(hf_cfg: Any, projector_hidden: int = 4096):
+    from inference_gateway_tpu.models.vision import VisionConfig
+
+    return VisionConfig(
+        image_size=hf_cfg.image_size,
+        patch_size=hf_cfg.patch_size,
+        hidden_size=hf_cfg.hidden_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        intermediate_size=hf_cfg.intermediate_size,
+        layer_norm_eps=hf_cfg.layer_norm_eps,
+        projector_hidden=projector_hidden,
+    )
+
+
+def clip_vision_params_from_hf(state_dict: Mapping[str, Any], cfg, dtype=jnp.bfloat16,
+                               projector: Mapping[str, Any] | None = None, rng=None):
+    """HF CLIPVisionModel → our vision pytree. The projector (LLaVA
+    mm_projector) is taken from ``projector`` or random-initialized."""
+    import jax
+
+    from inference_gateway_tpu.models import vision as vision_mod
+
+    L = cfg.num_layers
+    sd = {k.removeprefix("vision_model."): v for k, v in state_dict.items()}
+
+    def get(name):
+        return _to_np(sd[name])
+
+    def stack(fmt, transpose=True):
+        mats = [get(fmt.format(i)) for i in range(L)]
+        return jnp.asarray(np.stack([m.T if transpose else m for m in mats]), dtype)
+
+    conv = get("embeddings.patch_embedding.weight")  # (H, 3, ph, pw)
+    H = conv.shape[0]
+    patch_embed = conv.reshape(H, -1).T  # (3*ph*pw, H), channel-major
+
+    params = {
+        "patch_embed": jnp.asarray(patch_embed, dtype),
+        "class_embed": jnp.asarray(get("embeddings.class_embedding").reshape(-1), dtype),
+        "pos_embed": jnp.asarray(get("embeddings.position_embedding.weight"), dtype),
+        "pre_ln_scale": jnp.asarray(get("pre_layrnorm.weight"), dtype),
+        "pre_ln_bias": jnp.asarray(get("pre_layrnorm.bias"), dtype),
+        "layers": {
+            "ln1_scale": stack("encoder.layers.{}.layer_norm1.weight", transpose=False),
+            "ln1_bias": stack("encoder.layers.{}.layer_norm1.bias", transpose=False),
+            "wq": stack("encoder.layers.{}.self_attn.q_proj.weight"),
+            "bq": stack("encoder.layers.{}.self_attn.q_proj.bias", transpose=False),
+            "wk": stack("encoder.layers.{}.self_attn.k_proj.weight"),
+            "bk": stack("encoder.layers.{}.self_attn.k_proj.bias", transpose=False),
+            "wv": stack("encoder.layers.{}.self_attn.v_proj.weight"),
+            "bv": stack("encoder.layers.{}.self_attn.v_proj.bias", transpose=False),
+            "wo": stack("encoder.layers.{}.self_attn.out_proj.weight"),
+            "bo": stack("encoder.layers.{}.self_attn.out_proj.bias", transpose=False),
+            "ln2_scale": stack("encoder.layers.{}.layer_norm2.weight", transpose=False),
+            "ln2_bias": stack("encoder.layers.{}.layer_norm2.bias", transpose=False),
+            "w1": stack("encoder.layers.{}.mlp.fc1.weight"),
+            "b1": stack("encoder.layers.{}.mlp.fc1.bias", transpose=False),
+            "w2": stack("encoder.layers.{}.mlp.fc2.weight"),
+            "b2": stack("encoder.layers.{}.mlp.fc2.bias", transpose=False),
+        },
+        "post_ln_scale": jnp.asarray(get("post_layernorm.weight"), dtype),
+        "post_ln_bias": jnp.asarray(get("post_layernorm.bias"), dtype),
+    }
+    if projector is not None:
+        params["projector"] = {k: jnp.asarray(_to_np(v), dtype) for k, v in projector.items()}
+    else:
+        import jax.numpy as _jnp
+
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        full = vision_mod.init_params(key, cfg, dtype=dtype)
+        params["projector"] = full["projector"]
+    return params
+
+
 def mixtral_config_from_hf(hf_cfg: Any):
     from inference_gateway_tpu.models.mixtral import MixtralConfig
 

@@ -148,6 +148,7 @@ def forward(
     mode: str = "prefill",  # "prefill" | "decode"
     last_only: bool = False,
     slot_ids: jnp.ndarray | None = None,  # (B,) cache rows for this batch
+    embeds: jnp.ndarray | None = None,  # (B, T, H) overrides embed[tokens] (multimodal)
 ) -> tuple[jnp.ndarray, Params | None]:
     """Run the decoder. Returns (logits, updated_cache).
 
@@ -159,7 +160,7 @@ def forward(
              attends to the whole cache masked to ``lengths``.
     """
     B, T = tokens.shape
-    x = params["embed"][tokens]  # (B, T, H)
+    x = params["embed"][tokens] if embeds is None else embeds.astype(params["embed"].dtype)
     inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_cos_sin(positions, inv_freq)
 

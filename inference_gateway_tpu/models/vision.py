@@ -1,0 +1,180 @@
+"""Vision tower + projector for multimodal (LLaVA-style) serving.
+
+The on-device half of the gateway's ENABLE_VISION path (BASELINE config
+4): a CLIP-style ViT encoder in pure JAX (stacked layers + lax.scan, same
+TPU-first skeleton as the decoders) whose patch features pass through a
+2-layer MLP projector into the language model's embedding space, then
+splice into the token-embedding sequence at image placeholder positions.
+
+Numerics conventions match HF's CLIPVisionModel (pre-LN transformer,
+quick-GELU, class token + learned position embeddings) so real
+checkpoints load through models/hf_loader-style conversion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    layer_norm_eps: float = 1e-5
+    projector_hidden: int = 4096  # decoder hidden size
+    # "patch" drops the class token before projecting (LLaVA default).
+    select_feature: str = "patch"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+Params = dict[str, Any]
+
+
+def init_params(rng: jax.Array, cfg: VisionConfig, dtype=jnp.bfloat16) -> Params:
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    Ph = cfg.patch_size
+    keys = jax.random.split(rng, 12)
+
+    def norm(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "patch_embed": norm(keys[0], (3 * Ph * Ph, H)),  # unfolded conv as matmul (MXU-friendly)
+        "class_embed": norm(keys[1], (H,)),
+        "pos_embed": norm(keys[2], (cfg.num_patches + 1, H)),
+        "pre_ln_scale": jnp.ones((H,), dtype),
+        "pre_ln_bias": jnp.zeros((H,), dtype),
+        "layers": {
+            "ln1_scale": jnp.ones((L, H), dtype),
+            "ln1_bias": jnp.zeros((L, H), dtype),
+            "wq": norm(keys[3], (L, H, H)),
+            "bq": jnp.zeros((L, H), dtype),
+            "wk": norm(keys[4], (L, H, H)),
+            "bk": jnp.zeros((L, H), dtype),
+            "wv": norm(keys[5], (L, H, H)),
+            "bv": jnp.zeros((L, H), dtype),
+            "wo": norm(keys[6], (L, H, H)),
+            "bo": jnp.zeros((L, H), dtype),
+            "ln2_scale": jnp.ones((L, H), dtype),
+            "ln2_bias": jnp.zeros((L, H), dtype),
+            "w1": norm(keys[7], (L, H, I)),
+            "b1": jnp.zeros((L, I), dtype),
+            "w2": norm(keys[8], (L, I, H)),
+            "b2": jnp.zeros((L, H), dtype),
+        },
+        "post_ln_scale": jnp.ones((H,), dtype),
+        "post_ln_bias": jnp.zeros((H,), dtype),
+        "projector": {
+            "w1": norm(keys[9], (H, cfg.projector_hidden)),
+            "b1": jnp.zeros((cfg.projector_hidden,), dtype),
+            "w2": norm(keys[10], (cfg.projector_hidden, cfg.projector_hidden)),
+            "b2": jnp.zeros((cfg.projector_hidden,), dtype),
+        },
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """(B, H, W, 3) -> (B, N, 3*patch*patch), channel-major per patch to
+    match conv-weight unfolding."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 5, 2, 4)  # (B, gh, gw, C, ph, pw)
+    return x.reshape(B, gh * gw, C * patch * patch)
+
+
+@partial(jax.jit, static_argnames=("cfg", "project"))
+def encode_images(params: Params, cfg: VisionConfig, images: jnp.ndarray, project: bool = True) -> jnp.ndarray:
+    """(B, H, W, 3) float images → projected features
+    (B, num_patches, projector_hidden)."""
+    B = images.shape[0]
+    Hd, nH = cfg.hidden_size, cfg.num_heads
+    D = Hd // nH
+
+    patches = patchify(images.astype(params["patch_embed"].dtype), cfg.patch_size)
+    x = patches @ params["patch_embed"]  # (B, N, H)
+    cls = jnp.broadcast_to(params["class_embed"], (B, 1, Hd))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    x = _layer_norm(x, params["pre_ln_scale"], params["pre_ln_bias"], cfg.layer_norm_eps)
+
+    T = x.shape[1]
+
+    def body(x, lp):
+        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, T, nH, D)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, nH, D)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, nH, D)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(scores * (D ** -0.5), axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + attn.reshape(B, T, Hd) @ lp["wo"] + lp["bo"]
+        h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
+        x = x + _quick_gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    if not project:
+        return x  # raw encoder hidden states (pre post-LN), for parity tests
+
+    if cfg.select_feature == "patch":
+        feats = x[:, 1:]  # drop class token (LLaVA)
+    else:
+        feats = x
+    # LLaVA projects the pre-post-LN hidden states of the selected layer;
+    # we use the final block output, then the 2-layer GELU projector.
+    p = params["projector"]
+    out = jax.nn.gelu(feats @ p["w1"] + p["b1"], approximate=False) @ p["w2"] + p["b2"]
+    return out
+
+
+def splice_image_embeddings(
+    token_embeds: jnp.ndarray,  # (T, H) one row's token embeddings
+    image_feats: jnp.ndarray,  # (N_img, num_patches, H)
+    image_positions: jnp.ndarray,  # (N_img,) start offset of each image's span
+) -> jnp.ndarray:
+    """Overwrite placeholder spans with projected image features."""
+    out = token_embeds
+    n_patches = image_feats.shape[1]
+    for i in range(image_feats.shape[0]):
+        out = jax.lax.dynamic_update_slice(
+            out, image_feats[i].astype(out.dtype), (image_positions[i], 0)
+        )
+    return out
+
+
+PRESETS: dict[str, VisionConfig] = {
+    "vision-test-tiny": VisionConfig(
+        image_size=32, patch_size=8, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, projector_hidden=64,
+    ),
+    "clip-vit-l-336": VisionConfig(
+        image_size=336, patch_size=14, hidden_size=1024, num_layers=24, num_heads=16,
+        intermediate_size=4096, projector_hidden=4096,
+    ),
+}

@@ -50,6 +50,7 @@ class EngineConfig:
     top_k: int = 64
     seed: int = 0
     use_mesh: bool = True  # shard over all visible devices when >1
+    vision_model: str | None = None  # vision preset (models/vision.py) for multimodal
     attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
     page_size: int = 32
     num_pages: int = 0  # 0 = full reservation
@@ -129,6 +130,17 @@ class Engine:
                 cache = jax.device_put(cache, named(self.mesh, cache_specs))
             self.cache = cache
 
+        # Optional vision tower for the ENABLE_VISION multimodal path.
+        self.vision_cfg = None
+        self.vision_params = None
+        if config.vision_model:
+            from inference_gateway_tpu.models import vision
+
+            self.vision_cfg = vision.PRESETS[config.vision_model]
+            self.vision_params = vision.init_params(
+                jax.random.PRNGKey(config.seed + 7), self.vision_cfg, dtype=self.dtype
+            )
+
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_counter = 0
         self._lock = threading.Lock()
@@ -185,6 +197,18 @@ class Engine:
             params, self.model_cfg, tokens, positions, lengths, cache, mode="decode",
         )
         logits = logits[:, 0]
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
+        """Multimodal prefill: precomputed (image-spliced) embeddings
+        replace the token-embedding lookup."""
+        logits, cache = llama.forward(
+            params, self.model_cfg, tokens, positions, lengths, cache,
+            mode="prefill", last_only=True, slot_ids=slot_ids, embeds=embeds,
+        )
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
@@ -256,9 +280,35 @@ class Engine:
         return toks, logprobs, cache
 
     # ------------------------------------------------------------------
-    def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float], top_ps: list[float]) -> list[PrefillResult]:
+    IMAGE_PLACEHOLDER_ID = 0
+
+    def prepare_multimodal(self, prompt_ids: list[int], images: list[np.ndarray]):
+        """Encode images and build the spliced embedding row.
+
+        images: (H, W, 3) float arrays in the vision tower's resolution.
+        Returns (ids, embeds (T, hidden)) — ids carry placeholder runs at
+        the front (LLaVA-style image-first layout).
+        """
+        assert self.vision_cfg is not None, "engine has no vision tower configured"
+        from inference_gateway_tpu.models.vision import encode_images, splice_image_embeddings
+
+        n_patches = self.vision_cfg.num_patches
+        ids = [self.IMAGE_PLACEHOLDER_ID] * (n_patches * len(images)) + list(prompt_ids)
+        tok_embeds = self.params["embed"][jnp.asarray(ids, jnp.int32)]
+        feats = encode_images(
+            self.vision_params, self.vision_cfg,
+            jnp.asarray(np.stack(images), self.dtype),
+        )  # (N_img, n_patches, H)
+        starts = jnp.asarray([i * n_patches for i in range(len(images))], jnp.int32)
+        embeds = splice_image_embeddings(tok_embeds, feats, starts)
+        return ids, embeds
+
+    def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float],
+                top_ps: list[float], embeds: list | None = None) -> list[PrefillResult]:
         """Prefill a batch of prompts into their slots; returns each
-        prompt's sampled first token. Pads to (max_prefill_batch, bucket)."""
+        prompt's sampled first token. Pads to (max_prefill_batch, bucket).
+        ``embeds`` optionally carries per-row (T_i, H) multimodal
+        embedding overrides (from prepare_multimodal)."""
         assert prompts and len(prompts) == len(slots)
         Bp = self.config.max_prefill_batch
         assert len(prompts) <= Bp
@@ -277,8 +327,21 @@ class Engine:
             p_arr[i] = top_ps[i]
         positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (Bp, bucket))
 
+        has_mm = embeds is not None and any(e is not None for e in embeds)
         with self._lock:
-            if self.paged:
+            if has_mm and not self.paged:
+                H = self.model_cfg.hidden_size
+                full = self.params["embed"][jnp.asarray(tokens, jnp.int32)]
+                for i, e in enumerate(embeds or []):
+                    if e is not None:
+                        e = jnp.asarray(e, full.dtype)
+                        full = jax.lax.dynamic_update_slice(full, e[None], (i, 0, 0))
+                toks, logprobs, self.cache = self._prefill_fn_mm(
+                    self.params, self.cache, full, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
+                    jnp.asarray(p_arr), self._next_rng(),
+                )
+            elif self.paged:
                 write_idx = np.full((Bp, bucket), self._flat_size, np.int64)  # OOB = drop
                 for i, (prompt, slot) in enumerate(zip(prompts, slots)):
                     self.allocator.ensure_capacity(slot, len(prompt))

@@ -37,6 +37,7 @@ class GenRequest:
     stop_token_ids: frozenset[int] = frozenset()
     callback: TokenCallback = lambda *a: None
     request_id: str = ""
+    embeds: object = None  # (T, H) multimodal embedding override row
 
 
 @dataclass
@@ -108,9 +109,11 @@ class Scheduler:
             self.queue_depth = len(self._waiting)
         if not batch:
             return
+        embeds = [r.embeds for r in batch]
         results = self.engine.prefill(
             [r.prompt_ids for r in batch], slots,
             [r.temperature for r in batch], [r.top_p for r in batch],
+            embeds=embeds if any(e is not None for e in embeds) else None,
         )
         for req, res in zip(batch, results):
             state = _SlotState(req, pos=len(req.prompt_ids), pending_token=res.first_token,

@@ -101,9 +101,45 @@ class SidecarServer:
         return Response.json(m)
 
     # ------------------------------------------------------------------
+    def _decode_images(self, messages: list[dict[str, Any]]) -> list:
+        """Pull image_url parts (data: URLs) into vision-ready arrays."""
+        import base64
+        import io
+
+        import numpy as np
+
+        cfg = self.engine.vision_cfg
+        images = []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                continue
+            for part in content:
+                if not (isinstance(part, dict) and part.get("type") == "image_url"):
+                    continue
+                url = (part.get("image_url") or {}).get("url", "")
+                if not url.startswith("data:"):
+                    continue  # zero-egress: only inline images
+                try:
+                    from PIL import Image
+
+                    b64 = url.split(",", 1)[1]
+                    img = Image.open(io.BytesIO(base64.b64decode(b64))).convert("RGB")
+                    img = img.resize((cfg.image_size, cfg.image_size))
+                    arr = np.asarray(img, np.float32) / 127.5 - 1.0  # CLIP-style [-1, 1]
+                    images.append(arr)
+                except Exception:
+                    self.logger.warn("failed to decode inline image")
+        return images
+
     def _prepare(self, body: dict[str, Any]) -> tuple[GenRequest, dict[str, Any]]:
         messages = body.get("messages") or []
         prompt_ids = self.engine.tokenizer.apply_chat_template(messages)
+        embeds = None
+        if self.engine.vision_cfg is not None:
+            images = self._decode_images(messages)
+            if images:
+                prompt_ids, embeds = self.engine.prepare_multimodal(prompt_ids, images)
         max_tokens = body.get("max_completion_tokens") or body.get("max_tokens") or 256
         stop = body.get("stop")
         stop_strings: list[str] = [stop] if isinstance(stop, str) else list(stop or [])
@@ -112,6 +148,7 @@ class SidecarServer:
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature") or 0.0),
             top_p=float(body.get("top_p") or 1.0),
+            embeds=embeds,
         )
         meta = {
             "id": "chatcmpl-" + uuid.uuid4().hex[:24],

@@ -1,0 +1,112 @@
+"""Multimodal serving path: image → vision tower → spliced prefill →
+generation, end to end through the sidecar (BASELINE config 4)."""
+
+import base64
+import io
+import json
+
+import numpy as np
+import pytest
+
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler, generate_sync
+from inference_gateway_tpu.serving.server import SidecarServer
+
+
+@pytest.fixture(scope="module")
+def vision_engine():
+    return Engine(EngineConfig(
+        model="test-tiny", vision_model="vision-test-tiny", max_slots=4,
+        max_seq_len=256, dtype="float32", max_prefill_batch=2, use_mesh=False,
+        prefill_buckets=(64, 128, 256),
+    ))
+
+
+def test_prepare_multimodal(vision_engine):
+    e = vision_engine
+    rng = np.random.default_rng(0)
+    prompt = [int(x) for x in rng.integers(1, 250, size=6)]
+    image = rng.normal(size=(32, 32, 3)).astype(np.float32)
+    ids, embeds = e.prepare_multimodal(prompt, [image])
+    n_patches = e.vision_cfg.num_patches  # 16
+    assert len(ids) == n_patches + 6
+    assert embeds.shape == (len(ids), e.model_cfg.hidden_size)
+    # Image span differs from raw placeholder embeddings; text span matches.
+    tok_embeds = np.asarray(e.params["embed"][np.asarray(ids)])
+    assert not np.allclose(np.asarray(embeds[:n_patches]), tok_embeds[:n_patches])
+    np.testing.assert_allclose(np.asarray(embeds[n_patches:]), tok_embeds[n_patches:])
+
+
+def test_multimodal_generation_differs_from_text_only(vision_engine):
+    """The image content must influence generation."""
+    e = vision_engine
+    sched = Scheduler(e)
+    sched.start()
+    try:
+        rng = np.random.default_rng(1)
+        prompt = [int(x) for x in rng.integers(1, 250, size=8)]
+        img_a = rng.normal(size=(32, 32, 3)).astype(np.float32)
+        img_b = rng.normal(size=(32, 32, 3)).astype(np.float32) * 3.0
+
+        def gen(image):
+            ids, embeds = e.prepare_multimodal(prompt, [image])
+            import queue as q
+
+            outq = q.Queue()
+            sched.submit(GenRequest(
+                prompt_ids=ids, max_tokens=8, temperature=0.0, embeds=np.asarray(embeds),
+                callback=lambda t, lp, fin, r: outq.put((t, fin)),
+            ))
+            toks = []
+            while True:
+                t, fin = outq.get(timeout=60)
+                toks.append(t)
+                if fin:
+                    return toks
+
+        out_a = gen(img_a)
+        out_a2 = gen(img_a)
+        out_b = gen(img_b)
+        assert out_a == out_a2  # deterministic greedy
+        assert out_a != out_b  # image changes the result
+    finally:
+        sched.stop()
+
+
+async def test_sidecar_image_request(aloop):
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    engine = Engine(EngineConfig(
+        model="test-tiny", vision_model="vision-test-tiny", max_slots=2,
+        max_seq_len=256, dtype="float32", max_prefill_batch=2, use_mesh=False,
+        prefill_buckets=(64, 128, 256),
+    ))
+    server = SidecarServer(engine, served_model_name="tpu-mm")
+    port = await server.start("127.0.0.1", 0)
+    try:
+        buf = io.BytesIO()
+        Image.new("RGB", (8, 8), (200, 30, 90)).save(buf, format="PNG")
+        data_url = "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+        body = {
+            "model": "tpu-mm",
+            "max_tokens": 6,
+            "messages": [{
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "what is this?"},
+                    {"type": "image_url", "image_url": {"url": data_url}},
+                ],
+            }],
+        }
+        client = HTTPClient()
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+        assert resp.status == 200
+        data = resp.json()
+        assert data["choices"][0]["finish_reason"] in ("stop", "length")
+        # Prompt grew by the image's patch span.
+        assert data["usage"]["prompt_tokens"] > 20
+    finally:
+        await server.shutdown()

@@ -1,0 +1,69 @@
+"""Vision tower tests: CLIP numerics vs HF, patchify, splicing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from inference_gateway_tpu.models import vision
+
+
+def test_patchify_roundtrip_order():
+    # 1 image, 2x2 patches of 2x2: values encode (row, col) so the
+    # channel-major flattening order is observable.
+    img = np.arange(4 * 4 * 3, dtype=np.float32).reshape(1, 4, 4, 3)
+    out = np.asarray(vision.patchify(jnp.asarray(img), 2))
+    assert out.shape == (1, 4, 12)
+    # First patch = top-left 2x2 block, channel-major.
+    top_left = img[0, :2, :2, :]  # (2,2,3)
+    expect = top_left.transpose(2, 0, 1).reshape(-1)
+    np.testing.assert_array_equal(out[0, 0], expect)
+
+
+def test_encoder_matches_hf_clip():
+    torch = pytest.importorskip("torch")
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    from inference_gateway_tpu.models.hf_loader import (
+        clip_vision_config_from_hf,
+        clip_vision_params_from_hf,
+    )
+
+    hf_cfg = CLIPVisionConfig(
+        image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+    )
+    torch.manual_seed(0)
+    model = CLIPVisionModel(hf_cfg).eval()
+
+    cfg = clip_vision_config_from_hf(hf_cfg, projector_hidden=64)
+    params = clip_vision_params_from_hf(model.state_dict(), cfg, dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = model(
+            torch.tensor(images.transpose(0, 3, 1, 2)), output_hidden_states=True
+        ).hidden_states[-1].numpy()
+
+    ours = vision.encode_images(params, cfg, jnp.asarray(images), project=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_projected_features_shape():
+    cfg = vision.PRESETS["vision-test-tiny"]
+    params = vision.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    images = jnp.ones((2, 32, 32, 3))
+    feats = vision.encode_images(params, cfg, images)
+    assert feats.shape == (2, cfg.num_patches, cfg.projector_hidden)
+    assert not np.any(np.isnan(np.asarray(feats)))
+
+
+def test_splice_image_embeddings():
+    T, H, N = 10, 4, 3
+    tok = jnp.zeros((T, H))
+    feats = jnp.ones((1, N, H)) * 7
+    out = vision.splice_image_embeddings(tok, feats, jnp.asarray([2]))
+    out = np.asarray(out)
+    assert (out[2:5] == 7).all()
+    assert (out[:2] == 0).all() and (out[5:] == 0).all()
