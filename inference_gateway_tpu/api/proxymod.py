@@ -1,0 +1,106 @@
+"""Development-mode proxy instrumentation.
+
+Capability parity with reference internal/proxy/proxy.go:18-217: in
+development mode the ProxyHandler logs smart-truncated request and
+response bodies — word-capped content sections, message-count caps, and
+gzip-aware response decoding limited to small bodies; streaming responses
+are never buffered.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from typing import Any
+
+MAX_DECOMPRESS_BYTES = 4096  # proxy.go:147 gunzips ≤4 KiB bodies
+
+
+def truncate_words(text: str, max_words: int) -> str:
+    words = text.split()
+    if len(words) <= max_words:
+        return text
+    return " ".join(words[:max_words]) + f"... ({len(words) - max_words} more words)"
+
+
+def create_smart_body_preview(body: bytes, truncate_words_n: int = 10, max_messages: int = 100) -> Any:
+    """Compact, redaction-friendly preview of a chat request/response body
+    (proxy.go:96-145)."""
+    try:
+        payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        text = body.decode("utf-8", errors="replace")
+        return truncate_words(text, truncate_words_n)
+    if not isinstance(payload, dict):
+        return payload
+
+    preview = dict(payload)
+    messages = payload.get("messages")
+    if isinstance(messages, list):
+        shown = []
+        for m in messages[:max_messages]:
+            if not isinstance(m, dict):
+                continue
+            mm = dict(m)
+            content = mm.get("content")
+            if isinstance(content, str):
+                mm["content"] = truncate_words(content, truncate_words_n)
+            elif isinstance(content, list):
+                mm["content"] = [
+                    {**p, "text": truncate_words(p.get("text", ""), truncate_words_n)}
+                    if isinstance(p, dict) and p.get("type") == "text"
+                    else {"type": p.get("type", "?"), "omitted": True}
+                    for p in content
+                ]
+            shown.append(mm)
+        if len(messages) > max_messages:
+            shown.append({"omitted_messages": len(messages) - max_messages})
+        preview["messages"] = shown
+    for choice in preview.get("choices") or []:
+        if isinstance(choice, dict):
+            msg = choice.get("message")
+            if isinstance(msg, dict) and isinstance(msg.get("content"), str):
+                msg["content"] = truncate_words(msg["content"], truncate_words_n)
+    return preview
+
+
+class DevRequestModifier:
+    """Logs outbound proxy request bodies in development (proxy.go:53)."""
+
+    def __init__(self, logger, truncate_words_n: int = 10, max_messages: int = 100):
+        self.logger = logger
+        self.truncate_words_n = truncate_words_n
+        self.max_messages = max_messages
+
+    def modify(self, url: str, body: bytes) -> None:
+        if not body:
+            return
+        self.logger.debug(
+            "proxy request", "url", url,
+            "body", create_smart_body_preview(body, self.truncate_words_n, self.max_messages),
+        )
+
+
+class DevResponseModifier:
+    """Logs upstream response bodies in development; skips streams,
+    gunzips only small bodies (proxy.go:147-217)."""
+
+    def __init__(self, logger):
+        self.logger = logger
+
+    def modify(self, url: str, status: int, content_type: str, content_encoding: str, body: bytes) -> None:
+        if content_type.startswith("text/event-stream"):
+            return  # never buffer streams
+        if content_encoding == "gzip":
+            if len(body) > MAX_DECOMPRESS_BYTES:
+                self.logger.debug("proxy response", "url", url, "status", status,
+                                  "body", f"<gzip {len(body)} bytes>")
+                return
+            try:
+                body = gzip.decompress(body)
+            except OSError:
+                return
+        self.logger.debug(
+            "proxy response", "url", url, "status", status,
+            "body", create_smart_body_preview(body),
+        )

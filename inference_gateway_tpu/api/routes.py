@@ -459,6 +459,14 @@ class RouterImpl:
         if len(req.body) >= MAX_BODY_SIZE:
             return error_json("Request body too large", 413)
 
+        # Development-mode body logging (reference internal/proxy).
+        if self.cfg.environment == "development":
+            from inference_gateway_tpu.api.proxymod import DevRequestModifier
+
+            DevRequestModifier(
+                self.logger, self.cfg.debug_content_truncate_words, self.cfg.debug_max_messages
+            ).modify(url, req.body)
+
         try:
             resp = await self.client.request(
                 req.method, url, headers=headers, body=req.body, stream=is_streaming,
@@ -481,6 +489,13 @@ class RouterImpl:
                 body_out += line
         else:
             body_out = resp.body
+        if self.cfg.environment == "development":
+            from inference_gateway_tpu.api.proxymod import DevResponseModifier
+
+            DevResponseModifier(self.logger).modify(
+                url, resp.status, resp.headers.get("Content-Type") or "",
+                (resp.headers.get("Content-Encoding") or "").lower(), body_out,
+            )
         out = Response(status=resp.status, body=body_out)
         out.headers.set("Content-Type", resp.headers.get("Content-Type") or "application/json")
         return out

@@ -1,0 +1,3 @@
+from inference_gateway_tpu.codegen.generate import main
+
+raise SystemExit(main())

@@ -1,0 +1,242 @@
+"""Spec-driven generation and drift guards.
+
+Capability parity with the reference's codegen toolchain
+(cmd/generate/main.go + internal/codegen + internal/mdgen +
+internal/dockergen, orchestrated by `task generate`): openapi.yaml is the
+single source of truth for the provider registry and the env-var config
+surface. This CLI
+
+- generates ``Configurations.md`` (env-var docs) from ``x-config``
+- generates ``examples/docker-compose/basic/.env.example``
+- verifies the in-code registry/constants/config against the spec
+  (the reference's drift guards: provider_drift_test + CI dirty check)
+
+Usage: ``python -m inference_gateway_tpu.codegen [-type MD|Env|Check|All]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import yaml
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+SPEC_PATH = REPO_ROOT / "openapi.yaml"
+
+
+def load_spec(path: Path = SPEC_PATH) -> dict:
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+# ---------------------------------------------------------------------------
+# Generation
+# ---------------------------------------------------------------------------
+def generate_configurations_md(spec: dict) -> str:
+    out = [
+        "# Configurations",
+        "",
+        "_Generated from openapi.yaml `x-config` — do not edit by hand; run"
+        " `python -m inference_gateway_tpu.codegen -type MD`._",
+        "",
+    ]
+    for section, entries in spec["x-config"].items():
+        out.append(f"## {section.title()}")
+        out.append("")
+        out.append("| Environment variable | Default | Description |")
+        out.append("|---|---|---|")
+        for e in entries:
+            default = str(e.get("default", ""))
+            out.append(f"| `{e['env']}` | `{default}` | {e['description']} |")
+        out.append("")
+    out.append("## Providers")
+    out.append("")
+    out.append("| Provider | `<ID>_API_URL` default | Auth |")
+    out.append("|---|---|---|")
+    for pid, cfg in spec["x-provider-configs"].items():
+        out.append(f"| {cfg['name']} | `{cfg['url']}` | {cfg['auth_type']} |")
+    out.append("")
+    return "\n".join(out)
+
+
+def generate_env_example(spec: dict) -> str:
+    lines = ["# Generated from openapi.yaml x-config — python -m inference_gateway_tpu.codegen -type Env", ""]
+    for section, entries in spec["x-config"].items():
+        lines.append(f"# --- {section} ---")
+        for e in entries:
+            lines.append(f"# {e['description']}")
+            lines.append(f"{e['env']}={e.get('default', '')}")
+        lines.append("")
+    lines.append("# --- providers (API keys are required for non-local providers) ---")
+    for pid, cfg in spec["x-provider-configs"].items():
+        lines.append(f"# {pid.upper()}_API_URL={cfg['url']}")
+        if cfg.get("auth_type") != "none":
+            lines.append(f"# {pid.upper()}_API_KEY=")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Drift guards
+# ---------------------------------------------------------------------------
+def check_provider_registry(spec: dict) -> list[str]:
+    """Registry/constants must match x-provider-configs exactly."""
+    from inference_gateway_tpu.providers import constants
+    from inference_gateway_tpu.providers.registry import REGISTRY
+
+    problems = []
+    spec_providers = spec["x-provider-configs"]
+    if set(spec_providers) != set(REGISTRY):
+        problems.append(
+            f"provider id sets differ: spec-only={set(spec_providers) - set(REGISTRY)}, "
+            f"code-only={set(REGISTRY) - set(spec_providers)}"
+        )
+    for pid, s in spec_providers.items():
+        cfg = REGISTRY.get(pid)
+        if cfg is None:
+            continue
+        if cfg.name != s["name"]:
+            problems.append(f"{pid}: name {cfg.name!r} != spec {s['name']!r}")
+        if cfg.url != s["url"]:
+            problems.append(f"{pid}: url {cfg.url!r} != spec {s['url']!r}")
+        if cfg.auth_type != s["auth_type"]:
+            problems.append(f"{pid}: auth_type {cfg.auth_type!r} != spec {s['auth_type']!r}")
+        if cfg.supports_vision != s.get("supports_vision", False):
+            problems.append(f"{pid}: supports_vision mismatch")
+        if cfg.endpoints.models != s["endpoints"]["models"] or cfg.endpoints.chat != s["endpoints"]["chat"]:
+            problems.append(f"{pid}: endpoints mismatch")
+        spec_headers = {k: list(v) for k, v in (s.get("extra_headers") or {}).items()}
+        if cfg.extra_headers != spec_headers:
+            problems.append(f"{pid}: extra_headers mismatch")
+        if constants.DEFAULT_BASE_URLS.get(pid) != s["url"]:
+            problems.append(f"{pid}: constants.DEFAULT_BASE_URLS drift")
+    # Every spec provider must transform (reference provider_drift_test).
+    from inference_gateway_tpu.providers.transformers import transform_list_models
+
+    for pid in spec_providers:
+        try:
+            transform_list_models(pid, {"data": [{"id": "x"}]})
+        except Exception as e:
+            problems.append(f"{pid}: transformer failed: {e}")
+    return problems
+
+
+def check_config_defaults(spec: dict) -> list[str]:
+    """Config dataclass defaults must match x-config defaults."""
+    from inference_gateway_tpu.config import Config
+    from inference_gateway_tpu.utils.durations import parse_duration
+
+    cfg = Config.load({})
+    flat = {
+        "ENVIRONMENT": cfg.environment,
+        "ALLOWED_MODELS": cfg.allowed_models,
+        "DISALLOWED_MODELS": cfg.disallowed_models,
+        "ENABLE_VISION": cfg.enable_vision,
+        "DEBUG_CONTENT_TRUNCATE_WORDS": cfg.debug_content_truncate_words,
+        "DEBUG_MAX_MESSAGES": cfg.debug_max_messages,
+        "TELEMETRY_ENABLE": cfg.telemetry.enable,
+        "TELEMETRY_METRICS_PUSH_ENABLE": cfg.telemetry.metrics_push_enable,
+        "TELEMETRY_METRICS_PORT": cfg.telemetry.metrics_port,
+        "TELEMETRY_TRACING_ENABLE": cfg.telemetry.tracing_enable,
+        "TELEMETRY_TRACING_OTLP_ENDPOINT": cfg.telemetry.tracing_otlp_endpoint,
+        "MCP_ENABLE": cfg.mcp.enable,
+        "MCP_EXPOSE": cfg.mcp.expose,
+        "MCP_SERVERS": cfg.mcp.servers,
+        "MCP_INCLUDE_TOOLS": cfg.mcp.include_tools,
+        "MCP_EXCLUDE_TOOLS": cfg.mcp.exclude_tools,
+        "MCP_CLIENT_TIMEOUT": cfg.mcp.client_timeout,
+        "MCP_DIAL_TIMEOUT": cfg.mcp.dial_timeout,
+        "MCP_TLS_HANDSHAKE_TIMEOUT": cfg.mcp.tls_handshake_timeout,
+        "MCP_RESPONSE_HEADER_TIMEOUT": cfg.mcp.response_header_timeout,
+        "MCP_EXPECT_CONTINUE_TIMEOUT": cfg.mcp.expect_continue_timeout,
+        "MCP_REQUEST_TIMEOUT": cfg.mcp.request_timeout,
+        "MCP_MAX_RETRIES": cfg.mcp.max_retries,
+        "MCP_RETRY_INTERVAL": cfg.mcp.retry_interval,
+        "MCP_INITIAL_BACKOFF": cfg.mcp.initial_backoff,
+        "MCP_ENABLE_RECONNECT": cfg.mcp.enable_reconnect,
+        "MCP_RECONNECT_INTERVAL": cfg.mcp.reconnect_interval,
+        "MCP_POLLING_ENABLE": cfg.mcp.polling_enable,
+        "MCP_POLLING_INTERVAL": cfg.mcp.polling_interval,
+        "MCP_POLLING_TIMEOUT": cfg.mcp.polling_timeout,
+        "MCP_DISABLE_HEALTHCHECK_LOGS": cfg.mcp.disable_healthcheck_logs,
+        "AUTH_ENABLE": cfg.auth.enable,
+        "AUTH_OIDC_ISSUER": cfg.auth.oidc_issuer,
+        "AUTH_OIDC_CLIENT_ID": cfg.auth.oidc_client_id,
+        "AUTH_OIDC_CLIENT_SECRET": cfg.auth.oidc_client_secret,
+        "SERVER_HOST": cfg.server.host,
+        "SERVER_PORT": cfg.server.port,
+        "SERVER_READ_TIMEOUT": cfg.server.read_timeout,
+        "SERVER_WRITE_TIMEOUT": cfg.server.write_timeout,
+        "SERVER_IDLE_TIMEOUT": cfg.server.idle_timeout,
+        "SERVER_TLS_CERT_PATH": cfg.server.tls_cert_path,
+        "SERVER_TLS_KEY_PATH": cfg.server.tls_key_path,
+        "CLIENT_TIMEOUT": cfg.client.timeout,
+        "CLIENT_MAX_IDLE_CONNS": cfg.client.max_idle_conns,
+        "CLIENT_MAX_IDLE_CONNS_PER_HOST": cfg.client.max_idle_conns_per_host,
+        "CLIENT_IDLE_CONN_TIMEOUT": cfg.client.idle_conn_timeout,
+        "CLIENT_TLS_MIN_VERSION": cfg.client.tls_min_version,
+        "CLIENT_DISABLE_COMPRESSION": cfg.client.disable_compression,
+        "CLIENT_RESPONSE_HEADER_TIMEOUT": cfg.client.response_header_timeout,
+        "CLIENT_EXPECT_CONTINUE_TIMEOUT": cfg.client.expect_continue_timeout,
+        "ROUTING_ENABLED": cfg.routing.enabled,
+        "ROUTING_CONFIG_PATH": cfg.routing.config_path,
+    }
+    problems = []
+    seen = set()
+    for section, entries in spec["x-config"].items():
+        for e in entries:
+            env = e["env"]
+            seen.add(env)
+            if env not in flat:
+                problems.append(f"{env}: in spec but not loaded by Config")
+                continue
+            actual = flat[env]
+            want = e.get("default", "")
+            if isinstance(actual, bool):
+                want_b = str(want).strip().lower() in ("1", "t", "true", "yes", "on")
+                ok = actual == want_b
+            elif isinstance(actual, (int,)) and not isinstance(actual, bool):
+                ok = str(actual) == str(want)
+            elif isinstance(actual, float):
+                ok = abs(actual - parse_duration(str(want))) < 1e-9
+            else:
+                ok = str(actual) == str(want)
+            if not ok:
+                problems.append(f"{env}: code default {actual!r} != spec default {want!r}")
+    missing = set(flat) - seen
+    if missing:
+        problems.append(f"Config fields missing from spec: {sorted(missing)}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="spec-driven generation + drift guards")
+    parser.add_argument("-type", dest="gen_type", default="All",
+                        choices=["MD", "Env", "Check", "All"])
+    args = parser.parse_args(argv)
+    spec = load_spec()
+
+    if args.gen_type in ("MD", "All"):
+        (REPO_ROOT / "Configurations.md").write_text(generate_configurations_md(spec))
+        print("wrote Configurations.md")
+    if args.gen_type in ("Env", "All"):
+        target = REPO_ROOT / "examples" / "docker-compose" / "basic" / ".env.example"
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(generate_env_example(spec))
+        print(f"wrote {target.relative_to(REPO_ROOT)}")
+    if args.gen_type in ("Check", "All"):
+        problems = check_provider_registry(spec) + check_config_defaults(spec)
+        if problems:
+            print("DRIFT DETECTED:")
+            for p in problems:
+                print(" -", p)
+            return 1
+        print("drift check: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

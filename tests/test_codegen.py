@@ -1,0 +1,44 @@
+"""Codegen drift guards (reference: tests/provider_drift_test.go + the CI
+`go generate` dirty check): openapi.yaml is the source of truth; the
+in-code registry, constants, and config defaults must match it, and the
+generated docs must be current."""
+
+from pathlib import Path
+
+from inference_gateway_tpu.codegen.generate import (
+    check_config_defaults,
+    check_provider_registry,
+    generate_configurations_md,
+    generate_env_example,
+    load_spec,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_provider_registry_matches_spec():
+    assert check_provider_registry(load_spec()) == []
+
+
+def test_config_defaults_match_spec():
+    assert check_config_defaults(load_spec()) == []
+
+
+def test_generated_docs_are_current():
+    spec = load_spec()
+    on_disk = (REPO / "Configurations.md").read_text()
+    assert on_disk == generate_configurations_md(spec), (
+        "Configurations.md is stale — run `python -m inference_gateway_tpu.codegen -type MD`"
+    )
+    env_path = REPO / "examples" / "docker-compose" / "basic" / ".env.example"
+    assert env_path.read_text() == generate_env_example(spec), (
+        ".env.example is stale — run `python -m inference_gateway_tpu.codegen -type Env`"
+    )
+
+
+def test_spec_covers_all_routes():
+    spec = load_spec()
+    paths = set(spec["paths"])
+    for route in ("/health", "/v1/models", "/v1/chat/completions", "/v1/messages",
+                  "/v1/mcp/tools", "/v1/metrics", "/proxy/{provider}/{path}"):
+        assert route in paths, f"route {route} missing from openapi.yaml"
