@@ -1,0 +1,9 @@
+# Gateway image (CPU-only: the gateway never computes; reference parity:
+# single small artifact).
+FROM python:3.12-slim AS base
+WORKDIR /app
+COPY pyproject.toml README.md openapi.yaml ./
+COPY inference_gateway_tpu ./inference_gateway_tpu
+RUN pip install --no-cache-dir pyyaml && pip install --no-cache-dir -e . --no-deps
+EXPOSE 8080 9464
+ENTRYPOINT ["python", "-m", "inference_gateway_tpu.main"]

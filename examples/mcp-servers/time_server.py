@@ -1,0 +1,88 @@
+"""Sample MCP server: current time + timezone conversion.
+
+Reference parity: examples/docker-compose/mcp/time/main.go — a minimal
+streamable-HTTP MCP server that doubles as an integration fixture. Built
+on the framework's own netio stack; run with
+``python examples/mcp-servers/time_server.py --port 3001``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import datetime
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router
+
+TOOLS = [
+    {
+        "name": "get_current_time",
+        "description": "Get the current UTC time in ISO-8601 format",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+    {
+        "name": "offset_time",
+        "description": "Get the current time offset by N hours",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"hours": {"type": "number", "description": "offset in hours"}},
+            "required": ["hours"],
+        },
+    },
+]
+
+
+def call_tool(name: str, args: dict) -> str:
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if name == "get_current_time":
+        return now.isoformat()
+    if name == "offset_time":
+        return (now + datetime.timedelta(hours=float(args.get("hours", 0)))).isoformat()
+    raise ValueError(f"unknown tool {name}")
+
+
+async def handle(req: Request) -> Response:
+    payload = req.json()
+    method = payload.get("method")
+    if method == "initialize":
+        result = {
+            "protocolVersion": "2024-11-05",
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "time-server", "version": "1.0.0"},
+        }
+    elif method == "tools/list":
+        result = {"tools": TOOLS}
+    elif method == "tools/call":
+        params = payload.get("params") or {}
+        try:
+            text = call_tool(params.get("name", ""), params.get("arguments") or {})
+            result = {"content": [{"type": "text", "text": text}], "isError": False}
+        except Exception as e:
+            result = {"content": [{"type": "text", "text": str(e)}], "isError": True}
+    else:
+        return Response.json({"jsonrpc": "2.0", "id": payload.get("id"),
+                              "error": {"code": -32601, "message": f"unknown method {method}"}})
+    return Response.json({"jsonrpc": "2.0", "id": payload.get("id"), "result": result})
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=3001)
+    args = p.parse_args()
+    router = Router()
+    router.post("/mcp", handle)
+    router.post("/sse", handle)
+    server = HTTPServer(router)
+    port = await server.start(args.host, args.port)
+    print(json.dumps({"msg": "time mcp server listening", "port": port}), flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
