@@ -1,0 +1,75 @@
+"""Multi-host distributed runtime.
+
+The framework's DCN story (SURVEY.md §2.4 communication-backend row): a
+single ``jax.distributed`` initialization + mesh construction that spans
+hosts. Inside a pod slice, collectives ride ICI; across slices/hosts they
+ride DCN — both derived by XLA from the same mesh axes, so model code
+never changes between single-host and multi-host.
+
+Env convention (standard JAX multi-host):
+  COORDINATOR_ADDRESS  host:port of process 0
+  NUM_PROCESSES        world size
+  PROCESS_ID           this process's rank
+
+On TPU pods these resolve automatically from the TPU metadata; the env
+vars are the override path for manual/k8s deployments.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from inference_gateway_tpu.parallel.mesh import AXES, MOE_AXES, create_mesh, create_moe_mesh
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize jax.distributed when running multi-host; no-op (False)
+    for single-process runs."""
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else int(os.environ.get("NUM_PROCESSES", "0") or 0)
+    process_id = process_id if process_id is not None else int(os.environ.get("PROCESS_ID", "-1") or -1)
+
+    if coordinator_address and num_processes > 1 and process_id >= 0:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    # TPU pods auto-discover peers; initialize() with no args is safe there.
+    if os.environ.get("TPU_WORKER_HOSTNAMES") and num_processes > 1:
+        jax.distributed.initialize()
+        return True
+    return False
+
+
+def global_mesh(dp: int = 1, sp: int = 1, tp: int | None = None, ep: int = 0):
+    """Build a mesh over *all* global devices (multi-host aware).
+
+    With ``ep`` > 0 returns a (dp, sp, ep, tp) MoE mesh. ``tp=None``
+    absorbs the remaining device count into tensor parallelism — the
+    common serving layout (dp/sp chosen, tp = rest).
+    """
+    n = len(jax.devices())
+    if ep:
+        if tp is None:
+            tp = n // (dp * sp * ep)
+        return create_moe_mesh(dp=dp, sp=sp, ep=ep, tp=tp)
+    if tp is None:
+        tp = n // (dp * sp)
+    return create_mesh(dp=dp, sp=sp, tp=tp)
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

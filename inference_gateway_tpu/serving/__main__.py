@@ -22,6 +22,12 @@ def main() -> None:
     p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
     args = p.parse_args()
 
+    # Multi-host pods: join the jax.distributed world before touching
+    # devices (no-op single-host).
+    from inference_gateway_tpu.parallel.distributed import initialize_distributed
+
+    initialize_distributed()
+
     cfg = EngineConfig(
         model=args.model,
         max_slots=args.max_slots,

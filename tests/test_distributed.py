@@ -1,0 +1,22 @@
+"""Distributed runtime helpers (single-process behavior)."""
+
+import jax
+
+from inference_gateway_tpu.parallel.distributed import global_mesh, initialize_distributed, process_info
+
+
+def test_initialize_noop_single_process():
+    assert initialize_distributed() is False  # no coordinator configured
+
+
+def test_global_mesh_shapes():
+    mesh = global_mesh(dp=2, sp=1)
+    assert dict(mesh.shape) == {"dp": 2, "sp": 1, "tp": 4}
+    moe = global_mesh(dp=1, sp=1, ep=2)
+    assert dict(moe.shape) == {"dp": 1, "sp": 1, "ep": 2, "tp": 4}
+
+
+def test_process_info():
+    info = process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8
