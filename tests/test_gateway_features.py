@@ -1,0 +1,272 @@
+"""Deeper gateway feature tests: Anthropic Messages passthrough, OIDC
+auth end-to-end (real RSA JWTs against a fake issuer), routing pools.
+
+Reference genres: tests/api_routes_test.go (messages), middleware auth
+tests, providers/routing pool tests.
+"""
+
+import base64
+import json
+import time
+
+import pytest
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.netio.sse import iter_sse_payloads
+
+
+# ---------------------------------------------------------------------------
+# Anthropic Messages passthrough
+# ---------------------------------------------------------------------------
+class FakeAnthropic:
+    def __init__(self):
+        self.requests = []
+        router = Router()
+        router.post("/v1/messages", self.messages)
+        self.server = HTTPServer(router)
+        self.port = 0
+
+    async def start(self):
+        self.port = await self.server.start("127.0.0.1", 0)
+        return self.port
+
+    async def messages(self, req: Request) -> Response:
+        self.requests.append({"headers": dict(req.headers.items()), "body": req.json()})
+        if req.json().get("stream"):
+            async def events():
+                yield b'event: message_start\ndata: {"type":"message_start"}\n\n'
+                yield b'event: content_block_delta\ndata: {"type":"content_block_delta","delta":{"type":"text_delta","text":"hi"}}\n\n'
+                yield b'event: message_stop\ndata: {"type":"message_stop"}\n\n'
+            return StreamingResponse.sse(events())
+        return Response.json({
+            "id": "msg_1", "type": "message", "role": "assistant", "model": req.json()["model"],
+            "content": [{"type": "text", "text": "hello"}],
+            "usage": {"input_tokens": 5, "output_tokens": 2},
+        })
+
+
+@pytest.fixture(scope="module")
+def anthropic_stack(aloop):
+    upstream = FakeAnthropic()
+    port = aloop.run(upstream.start())
+    gw = build_gateway(env={
+        "ANTHROPIC_API_URL": f"http://127.0.0.1:{port}/v1",
+        "ANTHROPIC_API_KEY": "sk-ant-test",
+        "SERVER_PORT": "0",
+    })
+    gw_port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, gw_port, upstream
+    aloop.run(gw.shutdown())
+    aloop.run(upstream.server.shutdown())
+
+
+async def test_messages_passthrough_rewrites_model_and_auth(anthropic_stack):
+    _, port, upstream = anthropic_stack
+    upstream.requests.clear()
+    client = HTTPClient()
+    body = {"model": "anthropic/claude-test", "max_tokens": 16,
+            "messages": [{"role": "user", "content": "hi"}],
+            "cache_control_marker": {"custom": "field passes through"}}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/messages", json.dumps(body).encode())
+    assert resp.status == 200
+    assert resp.json()["content"][0]["text"] == "hello"
+    seen = upstream.requests[0]
+    # Model prefix stripped; unknown fields forwarded byte-for-byte.
+    assert seen["body"]["model"] == "claude-test"
+    assert seen["body"]["cache_control_marker"] == {"custom": "field passes through"}
+    # xheader auth + anthropic-version extra header applied.
+    headers = {k.lower(): v for k, v in seen["headers"].items()}
+    assert headers.get("x-api-key") == "sk-ant-test"
+    assert headers.get("anthropic-version") == "2023-06-01"
+
+
+async def test_messages_streaming_relays_anthropic_envelope(anthropic_stack):
+    _, port, upstream = anthropic_stack
+    client = HTTPClient()
+    body = {"model": "anthropic/claude-test", "stream": True, "max_tokens": 16,
+            "messages": [{"role": "user", "content": "hi"}]}
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/messages", json.dumps(body).encode(), stream=True)
+    assert resp.status == 200
+    raw = b""
+    async for line in resp.iter_lines():
+        raw += line
+    # Anthropic event envelope relayed verbatim (event: lines intact).
+    assert b"event: message_start" in raw
+    assert b'"text_delta"' in raw
+    assert b"event: message_stop" in raw
+
+
+# ---------------------------------------------------------------------------
+# OIDC auth end-to-end
+# ---------------------------------------------------------------------------
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+@pytest.fixture(scope="module")
+def rsa_issuer(aloop):
+    """Fake OIDC issuer: discovery + JWKS + a signing helper."""
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def int_b64(n: int) -> str:
+        raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+        return _b64url(raw)
+
+    state = {"issuer": ""}
+    router = Router()
+
+    async def discovery(req: Request) -> Response:
+        return Response.json({"issuer": state["issuer"], "jwks_uri": state["issuer"] + "/keys"})
+
+    async def keys(req: Request) -> Response:
+        return Response.json({"keys": [{"kty": "RSA", "kid": "k1", "alg": "RS256",
+                                        "n": int_b64(pub.n), "e": int_b64(pub.e)}]})
+
+    router.get("/.well-known/openid-configuration", discovery)
+    router.get("/keys", keys)
+    server = HTTPServer(router)
+    port = aloop.run(server.start("127.0.0.1", 0))
+    state["issuer"] = f"http://127.0.0.1:{port}"
+
+    def sign(claims: dict) -> str:
+        header = {"alg": "RS256", "kid": "k1", "typ": "JWT"}
+        h = _b64url(json.dumps(header).encode())
+        p = _b64url(json.dumps(claims).encode())
+        sig = key.sign(f"{h}.{p}".encode(), padding.PKCS1v15(), SHA256())
+        return f"{h}.{p}.{_b64url(sig)}"
+
+    yield state["issuer"], sign
+    aloop.run(server.shutdown())
+
+
+@pytest.fixture(scope="module")
+def auth_gateway(aloop, rsa_issuer):
+    issuer, _ = rsa_issuer
+    gw = build_gateway(env={
+        "AUTH_ENABLE": "true",
+        "AUTH_OIDC_ISSUER": issuer,
+        "AUTH_OIDC_CLIENT_ID": "test-client",
+        "SERVER_PORT": "0",
+    })
+    port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, port
+    aloop.run(gw.shutdown())
+
+
+async def test_auth_rejects_missing_and_bad_tokens(auth_gateway):
+    _, port = auth_gateway
+    client = HTTPClient()
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models")
+    assert resp.status == 401
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models",
+                            headers={"Authorization": "Bearer not.a.jwt"})
+    assert resp.status == 401
+    # /health is exempt (auth.go:55-58).
+    resp = await client.get(f"http://127.0.0.1:{port}/health")
+    assert resp.status == 200
+
+
+async def test_auth_accepts_valid_jwt(auth_gateway, rsa_issuer):
+    issuer, sign = rsa_issuer
+    _, port = auth_gateway
+    token = sign({"iss": issuer, "aud": "test-client", "sub": "u1",
+                  "exp": time.time() + 300})
+    client = HTTPClient()
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models",
+                            headers={"Authorization": f"Bearer {token}"})
+    assert resp.status == 200
+
+
+async def test_auth_rejects_expired_and_wrong_audience(auth_gateway, rsa_issuer):
+    issuer, sign = rsa_issuer
+    _, port = auth_gateway
+    client = HTTPClient()
+    expired = sign({"iss": issuer, "aud": "test-client", "exp": time.time() - 10})
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models",
+                            headers={"Authorization": f"Bearer {expired}"})
+    assert resp.status == 401
+    wrong_aud = sign({"iss": issuer, "aud": "other", "exp": time.time() + 300})
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models",
+                            headers={"Authorization": f"Bearer {wrong_aud}"})
+    assert resp.status == 401
+
+
+# ---------------------------------------------------------------------------
+# Routing pools through the gateway
+# ---------------------------------------------------------------------------
+class FakeOpenAIStyle:
+    def __init__(self, tag: str):
+        self.tag = tag
+        self.models_served: list[str] = []
+        router = Router()
+        router.post("/v1/chat/completions", self.chat)
+        self.server = HTTPServer(router)
+        self.port = 0
+
+    async def start(self):
+        self.port = await self.server.start("127.0.0.1", 0)
+        return self.port
+
+    async def chat(self, req: Request) -> Response:
+        body = req.json()
+        self.models_served.append(body["model"])
+        return Response.json({
+            "id": "x", "object": "chat.completion", "created": 1, "model": body["model"],
+            "choices": [{"index": 0, "message": {"role": "assistant", "content": self.tag},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1, "total_tokens": 2},
+        })
+
+
+async def test_routing_pool_round_robin(aloop, tmp_path_factory):
+    up_a = FakeOpenAIStyle("A")
+    up_b = FakeOpenAIStyle("B")
+    port_a = await up_a.start()
+    port_b = await up_b.start()
+
+    pools = tmp_path_factory.mktemp("pools") / "pools.yaml"
+    pools.write_text(f"""
+pools:
+  - model: fast-model
+    deployments:
+      - provider: ollama
+        model: model-a
+      - provider: llamacpp
+        model: model-b
+""")
+    gw = build_gateway(env={
+        "OLLAMA_API_URL": f"http://127.0.0.1:{port_a}/v1",
+        "LLAMACPP_API_URL": f"http://127.0.0.1:{port_b}/v1",
+        "LLAMACPP_API_KEY": "k",
+        "ROUTING_ENABLED": "true",
+        "ROUTING_CONFIG_PATH": str(pools),
+        "SERVER_PORT": "0",
+    })
+    port = await gw.start("127.0.0.1", 0)
+    try:
+        client = HTTPClient()
+        tags = []
+        providers = []
+        for _ in range(4):
+            body = {"model": "fast-model", "messages": [{"role": "user", "content": "x"}]}
+            resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                     json.dumps(body).encode())
+            assert resp.status == 200
+            tags.append(resp.json()["choices"][0]["message"]["content"])
+            providers.append(resp.headers.get("X-Selected-Provider"))
+        # Round-robin alternation over the two deployments.
+        assert sorted(tags[:2]) == ["A", "B"]
+        assert tags[:2] != tags[2:3] + tags[3:4] or tags[0] != tags[1]
+        assert set(providers) == {"ollama", "llamacpp"}
+        assert up_a.models_served and all(m == "model-a" for m in up_a.models_served)
+        assert up_b.models_served and all(m == "model-b" for m in up_b.models_served)
+    finally:
+        await gw.shutdown()
+        await up_a.server.shutdown()
+        await up_b.server.shutdown()
