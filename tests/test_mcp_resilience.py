@@ -1,0 +1,151 @@
+"""MCP failure detection + recovery (reference tests/mcp_test.go:968
+unreachable-server/background-reconnect genre, health flip semantics)."""
+
+import asyncio
+import json
+
+import pytest
+
+from inference_gateway_tpu.config import MCPConfig
+from inference_gateway_tpu.mcp.client import MCPClient
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router
+
+
+class FlakyMCPServer:
+    """Scriptable failure injection: down → up transitions."""
+
+    def __init__(self):
+        self.up = True
+        self.initialize_count = 0
+        router = Router()
+        router.post("/mcp", self.handle)
+        router.post("/sse", self.handle)
+        self.server = HTTPServer(router)
+        self.port = 0
+
+    async def start(self):
+        self.port = await self.server.start("127.0.0.1", 0)
+        return self.port
+
+    async def handle(self, req: Request) -> Response:
+        if not self.up:
+            return Response.json({"error": "down"}, status=503)
+        payload = req.json()
+        method = payload.get("method")
+        if method == "initialize":
+            self.initialize_count += 1
+            result = {"protocolVersion": "2024-11-05"}
+        elif method == "tools/list":
+            result = {"tools": [{"name": "ping", "description": "pong", "inputSchema": {}}]}
+        elif method == "tools/call":
+            result = {"content": [{"type": "text", "text": "pong"}], "isError": False}
+        else:
+            result = {}
+        return Response.json({"jsonrpc": "2.0", "id": payload.get("id"), "result": result})
+
+
+async def test_health_flip_triggers_reconnection():
+    srv = FlakyMCPServer()
+    port = await srv.start()
+    cfg = MCPConfig(
+        enable=True, servers=f"http://127.0.0.1:{port}/mcp",
+        max_retries=1, initial_backoff=0.01, retry_interval=0.02,
+        enable_reconnect=True, reconnect_interval=0.1,
+        polling_enable=True, polling_interval=0.1, polling_timeout=0.5,
+    )
+    client = MCPClient(cfg, HTTPClient())
+    await client.initialize_all()
+    assert client.has_available_servers()
+    client.start_status_polling()
+
+    # Kill the server: polling must flip status and spawn reconnection.
+    srv.up = False
+    for _ in range(40):
+        await asyncio.sleep(0.1)
+        if not client.has_available_servers():
+            break
+    assert not client.has_available_servers()
+
+    # Bring it back: the background loop must re-initialize.
+    srv.up = True
+    for _ in range(60):
+        await asyncio.sleep(0.1)
+        if client.has_available_servers():
+            break
+    assert client.has_available_servers()
+    assert srv.initialize_count >= 2  # initial + reconnect
+    await client.shutdown()
+    await srv.server.shutdown()
+
+
+async def test_concurrent_tool_calls_during_polling():
+    """Hammer execute_tool while health polling runs (reference
+    internal/mcp/client_concurrency_test.go)."""
+    srv = FlakyMCPServer()
+    port = await srv.start()
+    cfg = MCPConfig(
+        enable=True, servers=f"http://127.0.0.1:{port}/mcp",
+        max_retries=1, initial_backoff=0.01,
+        polling_enable=True, polling_interval=0.05, polling_timeout=0.5,
+    )
+    client = MCPClient(cfg, HTTPClient())
+    await client.initialize_all()
+    client.start_status_polling()
+
+    async def one(i):
+        result = await client.execute_tool("mcp_ping", {})
+        assert result["content"][0]["text"] == "pong"
+
+    await asyncio.gather(*(one(i) for i in range(30)))
+    await client.shutdown()
+    await srv.server.shutdown()
+
+
+async def test_telemetry_streaming_usage_recorded(aloop):
+    """Streaming SSE responses: usage parsed from the trailing chunks and
+    recorded (reference middlewares/telemetry.go:195-231)."""
+    import numpy as np
+
+    from inference_gateway_tpu.main import build_gateway
+    from inference_gateway_tpu.netio.server import StreamingResponse
+
+    async def chat(req: Request) -> Response:
+        async def chunks():
+            base = {"id": "s", "object": "chat.completion.chunk", "created": 1, "model": "m"}
+            yield ("data: " + json.dumps({**base, "choices": [{"index": 0, "delta": {"content": "x"}, "finish_reason": None}]}) + "\n\n").encode()
+            yield ("data: " + json.dumps({**base, "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}) + "\n\n").encode()
+            yield ("data: " + json.dumps({**base, "choices": [], "usage": {"prompt_tokens": 11, "completion_tokens": 7, "total_tokens": 18}}) + "\n\n").encode()
+            yield b"data: [DONE]\n\n"
+        return StreamingResponse.sse(chunks())
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+
+    gw = build_gateway(env={
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+        "SERVER_PORT": "0",
+    })
+    port = await gw.start("127.0.0.1", 0)
+    try:
+        client = HTTPClient()
+        body = {"model": "ollama/m", "stream": True, "messages": [{"role": "user", "content": "x"}]}
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                 json.dumps(body).encode(), stream=True)
+        drained = b""
+        async for line in resp.iter_lines():
+            drained += line
+        assert b"[DONE]" in drained
+        await asyncio.sleep(0.1)  # let the finally-block record
+        text = gw.otel.expose_prometheus()
+        assert 'gen_ai_token_type="input"' in text
+        line = next(l for l in text.splitlines()
+                    if "token_usage_count" in l and 'gen_ai_token_type="input"' in l)
+        assert line.endswith(" 1")
+    finally:
+        await gw.shutdown()
+        await upstream.shutdown()
