@@ -12,6 +12,7 @@ from inference_gateway_tpu.serving.server import serve
 def main() -> None:
     p = argparse.ArgumentParser(description="TPU serving sidecar (OpenAI-compatible)")
     p.add_argument("--model", default="tinyllama-1.1b", help="preset name or local HF checkpoint path")
+    p.add_argument("--checkpoint", default=None, help="orbax checkpoint directory to restore")
     p.add_argument("--served-model-name", default=None)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
@@ -30,6 +31,7 @@ def main() -> None:
 
     cfg = EngineConfig(
         model=args.model,
+        checkpoint_path=args.checkpoint,
         max_slots=args.max_slots,
         max_seq_len=args.max_seq_len,
         max_prefill_batch=args.max_prefill_batch,

@@ -1,0 +1,57 @@
+"""Model checkpoint save/restore.
+
+SURVEY.md §5 checkpoint/resume: the gateway is stateless; persistence
+lives in the sidecar — model weights save/restore via Orbax (the
+TPU-native checkpointing library: async, sharding-aware, multi-host
+safe). Checkpoints carry the model config alongside the params pytree so
+a sidecar restarts from a path alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from inference_gateway_tpu.models.llama import LlamaConfig
+
+
+def save_checkpoint(path: str, params: Any, model_cfg: LlamaConfig, extra: dict | None = None) -> None:
+    """Write params + config to ``path`` (a directory)."""
+    import orbax.checkpoint as ocp
+
+    target = Path(path).absolute()
+    target.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(target / "params", params, force=True)
+    meta = {
+        "model_config": dataclasses.asdict(model_cfg),
+        "model_type": type(model_cfg).__name__,
+        **(extra or {}),
+    }
+    (target / "meta.json").write_text(json.dumps(meta, indent=2, default=str))
+
+
+def load_checkpoint(path: str, dtype=None) -> tuple[Any, LlamaConfig]:
+    """Restore (params, model_cfg) from a checkpoint directory."""
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    from inference_gateway_tpu.models.mixtral import MixtralConfig
+
+    target = Path(path).absolute()
+    meta = json.loads((target / "meta.json").read_text())
+    cfg_cls = MixtralConfig if meta.get("model_type") == "MixtralConfig" else LlamaConfig
+    raw = dict(meta["model_config"])
+    if isinstance(raw.get("rope_scaling"), list):
+        raw["rope_scaling"] = {k: v for k, v in raw["rope_scaling"]}
+    cfg = cfg_cls(**raw)
+
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(target / "params")
+    if dtype is not None:
+        params = jax.tree.map(lambda x: x.astype(dtype) if hasattr(x, "astype") else x, params)
+    return params, cfg

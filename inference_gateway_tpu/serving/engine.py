@@ -50,6 +50,7 @@ class EngineConfig:
     top_k: int = 64
     seed: int = 0
     use_mesh: bool = True  # shard over all visible devices when >1
+    checkpoint_path: str | None = None  # orbax checkpoint dir (serving/checkpoint.py)
     vision_model: str | None = None  # vision preset (models/vision.py) for multimodal
     attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
     page_size: int = 32
@@ -78,6 +79,10 @@ class Engine:
 
         if model_cfg is not None:
             self.model_cfg = model_cfg
+        elif config.checkpoint_path:
+            from inference_gateway_tpu.serving.checkpoint import load_checkpoint
+
+            params, self.model_cfg = load_checkpoint(config.checkpoint_path, dtype=self.dtype)
         elif config.model in llama.PRESETS:
             self.model_cfg = llama.PRESETS[config.model]
         else:
@@ -439,6 +444,11 @@ class Engine:
         return both[:n].astype(np.int32), both[n:]
 
     # ------------------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        from inference_gateway_tpu.serving.checkpoint import save_checkpoint
+
+        save_checkpoint(path, self.params, self.model_cfg)
+
     def release_slot(self, slot: int) -> None:
         """Return a finished slot's KV pages to the pool."""
         if self.allocator is not None:
