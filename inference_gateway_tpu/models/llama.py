@@ -127,7 +127,10 @@ def _layer(
         new_v_cache = v_cache.at[rows, scatter_pos].set(v.astype(v_cache.dtype), mode="drop")
 
     if decode:
-        attn = gqa_attend(q, new_k_cache.astype(q.dtype), new_v_cache.astype(q.dtype), mask)
+        # Attend over cache rows; gather when batch rows map onto slots.
+        kc = new_k_cache if slot_ids is None else new_k_cache[slot_ids]
+        vc = new_v_cache if slot_ids is None else new_v_cache[slot_ids]
+        attn = gqa_attend(q, kc.astype(q.dtype), vc.astype(q.dtype), mask)
     else:
         attn = gqa_attend(q, k, v, mask)
     x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
@@ -158,6 +161,11 @@ def forward(
              write into a large slot cache (continuous batching).
     decode:  T must be 1 and the batch must cover every cache row;
              attends to the whole cache masked to ``lengths``.
+    prefill_chunk: chunked prefill — this call's tokens are written at
+             ``positions`` and queries attend to the WHOLE cache row
+             causally (prior chunks + this one); batch rows must align
+             with cache rows. ``lengths`` = tokens valid after this
+             chunk. Bounds prefill memory to O(chunk × cache).
     """
     B, T = tokens.shape
     x = params["embed"][tokens] if embeds is None else embeds.astype(params["embed"].dtype)
@@ -169,6 +177,17 @@ def forward(
         S = cache["k"].shape[2]
         mask = decode_mask(S, lengths)
         scatter_pos = positions
+    elif mode == "prefill_chunk":
+        assert cache is not None
+        S = cache["k"].shape[2]
+        span = jnp.arange(S)
+        # Key visible iff its cache position is ≤ the query's absolute
+        # position and within the row's valid length.
+        mask = (span[None, None, :] <= positions[:, :, None]) & (
+            span[None, None, :] < lengths[:, None, None]
+        )
+        valid = positions < lengths[:, None]
+        scatter_pos = jnp.where(valid, positions, S)
     else:
         valid = jnp.arange(T)[None, :] < lengths[:, None]
         mask = causal_prefill_mask(positions, lengths)
@@ -178,19 +197,19 @@ def forward(
         else:
             scatter_pos = None
 
-    decode = mode == "decode"
+    attend_cache = mode in ("decode", "prefill_chunk")
 
     if cache is not None:
         def body(x, per_layer):
             lp, kc, vc = per_layer
-            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, slot_ids, scatter_pos, mask, cfg, decode)
+            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, slot_ids, scatter_pos, mask, cfg, attend_cache)
             return x, (nk, nv)
 
         x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
         new_cache = {"k": new_k, "v": new_v}
     else:
         def body(x, lp):
-            x, _, _ = _layer(x, lp, cos, sin, None, None, None, None, mask, cfg, decode)
+            x, _, _ = _layer(x, lp, cos, sin, None, None, None, None, mask, cfg, attend_cache)
             return x, None
 
         x, _ = jax.lax.scan(body, x, params["layers"])
@@ -198,7 +217,12 @@ def forward(
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if last_only:
-        idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
+        if mode == "decode":
+            idx = jnp.zeros_like(lengths)
+        else:
+            # Local index of each row's last valid token: chunks start at
+            # positions[:, 0] (0 for fresh prefill).
+            idx = jnp.maximum(lengths - 1 - positions[:, 0], 0)
         x = x[jnp.arange(B), idx]  # (B, H)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)

@@ -207,6 +207,18 @@ class Engine:
         return toks, logprobs, cache
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    def _prefill_chunk_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
+        """One chunk of a long prompt: write at positions, attend the
+        whole cache row causally (llama.forward mode=prefill_chunk)."""
+        logits, cache = llama.forward(
+            params, self.model_cfg, tokens, positions, lengths, cache,
+            mode="prefill_chunk", last_only=True, slot_ids=slot_ids,
+        )
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
         """Multimodal prefill: precomputed (image-spliced) embeddings
         replace the token-embedding lookup."""
@@ -315,6 +327,23 @@ class Engine:
         ``embeds`` optionally carries per-row (T_i, H) multimodal
         embedding overrides (from prepare_multimodal)."""
         assert prompts and len(prompts) == len(slots)
+        # Prompts beyond the largest bucket go through chunked prefill
+        # (dense cache path); the rest batch normally.
+        biggest = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
+        if not self.paged and any(len(p) > biggest for p in prompts):
+            results = []
+            short_idx = [i for i, p in enumerate(prompts) if len(p) <= biggest]
+            for i, p in enumerate(prompts):
+                if len(p) > biggest:
+                    results.append((i, self._prefill_one_chunked(p, slots[i], temps[i], top_ps[i])))
+            if short_idx:
+                sub = self.prefill(
+                    [prompts[i] for i in short_idx], [slots[i] for i in short_idx],
+                    [temps[i] for i in short_idx], [top_ps[i] for i in short_idx],
+                    embeds=[(embeds or [None] * len(prompts))[i] for i in short_idx] if embeds else None,
+                )
+                results.extend(zip(short_idx, sub))
+            return [r for _, r in sorted(results)]
         Bp = self.config.max_prefill_batch
         assert len(prompts) <= Bp
         bucket = self.bucket_for(max(len(p) for p in prompts))
@@ -404,6 +433,28 @@ class Engine:
             self.metrics["decode_tokens"] += active
             self.metrics["decode_steps"] += 1
         return np.asarray(toks), np.asarray(logprobs)
+
+    def _prefill_one_chunked(self, prompt: list[int], slot: int, temp: float, top_p: float) -> PrefillResult:
+        """Chunked prefill for one long prompt (chunk = largest bucket)."""
+        chunk = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
+        total = len(prompt)
+        toks = logprobs = None
+        with self._lock:
+            for start in range(0, total, chunk):
+                piece = prompt[start:start + chunk]
+                tokens = np.zeros((1, chunk), np.int32)
+                tokens[0, : len(piece)] = piece
+                positions = (start + np.arange(chunk, dtype=np.int32))[None, :]
+                lengths = np.asarray([start + len(piece)], np.int32)
+                toks, logprobs, self.cache = self._prefill_chunk_fn(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lengths), jnp.asarray([slot], np.int32),
+                    jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
+                    self._next_rng(),
+                )
+            self.metrics["prefill_tokens"] += total
+            self.metrics["prefill_batches"] += 1
+        return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
 
     def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray, active: np.ndarray,
                      temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None):
