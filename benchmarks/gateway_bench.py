@@ -1,0 +1,127 @@
+"""Gateway micro-benchmarks.
+
+Reference parity: `task benchmark` (tests/providers_test.go:518-646 and
+tests/api_context_window_bench_test.go) — chat-completion, list-models,
+and transformer micro-benches reporting per-op latency, CPU time, and
+peak heap. CPU-only (fake upstream); run:
+
+    python benchmarks/gateway_bench.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import resource
+import sys
+import time
+import tracemalloc
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router
+from inference_gateway_tpu.providers.transformers import transform_list_models
+
+
+def _cpu_ms() -> float:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return (ru.ru_utime + ru.ru_stime) * 1000
+
+
+async def bench_chat_completions(n: int = 200) -> dict:
+    async def chat(req: Request) -> Response:
+        return Response.json({
+            "id": "b", "object": "chat.completion", "created": 1, "model": "m",
+            "choices": [{"index": 0, "message": {"role": "assistant", "content": "ok"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 2, "total_tokens": 12},
+        })
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+    gw = build_gateway(env={"OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1", "SERVER_PORT": "0"})
+    port = await gw.start("127.0.0.1", 0)
+    client = HTTPClient()
+    body = json.dumps({"model": "ollama/m", "messages": [{"role": "user", "content": "x" * 64}]}).encode()
+
+    # warmup
+    for _ in range(10):
+        await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body)
+    cpu0, t0 = _cpu_ms(), time.perf_counter()
+    for _ in range(n):
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body)
+        assert resp.status == 200
+    wall = (time.perf_counter() - t0) / n * 1000
+    cpu = (_cpu_ms() - cpu0) / n
+    await gw.shutdown()
+    await upstream.shutdown()
+    return {"bench": "chat_completions_double_hop", "ms_per_op": round(wall, 3),
+            "cpu_ms_per_op": round(cpu, 3), "ops": n}
+
+
+def bench_transformers(n_models: int = 1000, iters: int = 200) -> dict:
+    raw = {"object": "list", "data": [
+        {"id": f"model-{i}", "created": i, "context_length": 8192} for i in range(n_models)
+    ]}
+    tracemalloc.start()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = transform_list_models("openai", raw)
+    wall = (time.perf_counter() - t0) / iters * 1000
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert len(out["data"]) == n_models
+    return {"bench": f"transform_{n_models}_models", "ms_per_op": round(wall, 3),
+            "peak_heap_mb": round(peak / 1e6, 2), "ops": iters}
+
+
+async def bench_sse_relay(n_chunks: int = 2000) -> dict:
+    from inference_gateway_tpu.netio.server import StreamingResponse
+
+    async def chat(req: Request) -> Response:
+        async def chunks():
+            frame = b'data: {"choices":[{"delta":{"content":"x"},"index":0}]}\n\n'
+            for _ in range(n_chunks):
+                yield frame
+            yield b"data: [DONE]\n\n"
+        return StreamingResponse.sse(chunks())
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+    gw = build_gateway(env={"OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1", "SERVER_PORT": "0"})
+    port = await gw.start("127.0.0.1", 0)
+    client = HTTPClient()
+    body = json.dumps({"model": "ollama/m", "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]}).encode()
+    t0 = time.perf_counter()
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body, stream=True)
+    count = 0
+    async for line in resp.iter_lines():
+        if line.startswith(b"data:"):
+            count += 1
+    wall = time.perf_counter() - t0
+    await gw.shutdown()
+    await upstream.shutdown()
+    return {"bench": "sse_relay_double_hop", "chunks_per_sec": round(count / wall),
+            "chunks": count}
+
+
+async def main() -> None:
+    results = [
+        await bench_chat_completions(),
+        bench_transformers(),
+        await bench_sse_relay(),
+    ]
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
