@@ -77,6 +77,8 @@ class Engine:
         self.config = config
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
+        from inference_gateway_tpu.models import mixtral
+
         if model_cfg is not None:
             self.model_cfg = model_cfg
         elif config.checkpoint_path:
@@ -85,30 +87,57 @@ class Engine:
             params, self.model_cfg = load_checkpoint(config.checkpoint_path, dtype=self.dtype)
         elif config.model in llama.PRESETS:
             self.model_cfg = llama.PRESETS[config.model]
+        elif config.model in mixtral.PRESETS:
+            self.model_cfg = mixtral.PRESETS[config.model]
         else:
             self.model_cfg, params = self._load_hf(config.model)
+
+        # Model-family dispatch: MixtralConfig → MoE forward; plain
+        # LlamaConfig → dense forward. Same call contract either way.
+        self.is_moe = isinstance(self.model_cfg, mixtral.MixtralConfig)
+        self._model = mixtral if self.is_moe else llama
         self.tokenizer = load_tokenizer(config.tokenizer or (None if config.model in llama.PRESETS else config.model))
 
         self.mesh = None
         n_dev = len(jax.devices())
         if config.use_mesh and n_dev > 1:
-            dp, sp, tp = default_mesh_shape(n_dev)
-            # tp must tile the model; degrade toward dp otherwise.
-            while tp > 1 and (self.model_cfg.num_kv_heads % tp or self.model_cfg.intermediate_size % tp):
-                tp //= 2
-            dp = n_dev // (sp * tp)
-            self.mesh = create_mesh(dp=dp, sp=sp, tp=tp)
-            check_divisibility(self.model_cfg, self.mesh)
+            if self.is_moe:
+                # Experts ride a dedicated ep axis; tp shards within each
+                # expert (BASELINE config 5 layout).
+                from inference_gateway_tpu.parallel.mesh import create_moe_mesh
+
+                ep = 1
+                for cand in (8, 4, 2):
+                    if n_dev % cand == 0 and self.model_cfg.num_experts % cand == 0:
+                        ep = cand
+                        break
+                tp = 1
+                rem = n_dev // ep
+                for cand in (4, 2):
+                    if rem % cand == 0 and self.model_cfg.num_kv_heads % cand == 0:
+                        tp = cand
+                        break
+                dp = n_dev // (ep * tp)
+                self.mesh = create_moe_mesh(dp=dp, sp=1, ep=ep, tp=tp)
+            else:
+                dp, sp, tp = default_mesh_shape(n_dev)
+                # tp must tile the model; degrade toward dp otherwise.
+                while tp > 1 and (self.model_cfg.num_kv_heads % tp or self.model_cfg.intermediate_size % tp):
+                    tp //= 2
+                dp = n_dev // (sp * tp)
+                self.mesh = create_mesh(dp=dp, sp=sp, tp=tp)
+                check_divisibility(self.model_cfg, self.mesh)
 
         if params is None:
-            params = llama.init_params(jax.random.PRNGKey(config.seed), self.model_cfg, dtype=self.dtype)
+            params = self._model.init_params(jax.random.PRNGKey(config.seed), self.model_cfg, dtype=self.dtype)
         if self.mesh is not None:
-            params = shard_params(params, self.mesh, llama_param_specs(self.model_cfg))
+            specs = self._model.param_specs(self.model_cfg) if self.is_moe else llama_param_specs(self.model_cfg)
+            params = shard_params(params, self.mesh, specs)
         self.params = params
 
-        # Paged attention is single-device this round; tp-sharded paged
-        # decode lands with shard_map integration.
-        self.paged = config.attention == "paged" and self.mesh is None
+        # Paged attention is single-device + dense-model this round;
+        # tp-sharded and MoE paged decode land with shard_map integration.
+        self.paged = config.attention == "paged" and self.mesh is None and not self.is_moe
         self.allocator = None
         if self.paged:
             from inference_gateway_tpu.serving.kv_cache import (
@@ -125,7 +154,7 @@ class Engine:
             self.cache = init_paged_cache(self.model_cfg, self.page_cfg, dtype=self.dtype)
             self._flat_size = self.allocator.num_pages * config.page_size
         else:
-            cache = llama.init_cache(self.model_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
+            cache = self._model.init_cache(self.model_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
             if self.mesh is not None:
                 # Slot axis stays replicated (slots are scheduled
                 # host-side); kv-heads shard on tp.
@@ -160,17 +189,19 @@ class Engine:
     # ------------------------------------------------------------------
     @staticmethod
     def _load_hf(path: str):
-        """Load a local HF Llama checkpoint (no network)."""
+        """Load a local HF Llama/Mixtral checkpoint (no network)."""
         import torch  # CPU-only wheel is in the image
         from transformers import AutoConfig, AutoModelForCausalLM
 
-        from inference_gateway_tpu.models.hf_loader import llama_config_from_hf, llama_params_from_hf
+        from inference_gateway_tpu.models import hf_loader
 
         hf_cfg = AutoConfig.from_pretrained(path)
-        cfg = llama_config_from_hf(hf_cfg)
+        is_moe = getattr(hf_cfg, "model_type", "") == "mixtral"
+        cfg = (hf_loader.mixtral_config_from_hf if is_moe else hf_loader.llama_config_from_hf)(hf_cfg)
         with torch.no_grad():
             model = AutoModelForCausalLM.from_pretrained(path, torch_dtype=torch.float32)
-        params = llama_params_from_hf(model.state_dict(), cfg, dtype=jnp.bfloat16)
+        convert = hf_loader.mixtral_params_from_hf if is_moe else hf_loader.llama_params_from_hf
+        params = convert(model.state_dict(), cfg, dtype=jnp.bfloat16)
         del model
         return cfg, params
 
@@ -188,7 +219,7 @@ class Engine:
     # ------------------------------------------------------------------
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
-        logits, cache = llama.forward(
+        logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill", last_only=True, slot_ids=slot_ids,
         )
@@ -198,7 +229,7 @@ class Engine:
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _decode_fn(self, params, cache, tokens, positions, lengths, temps, top_ps, rng):
-        logits, cache = llama.forward(
+        logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache, mode="decode",
         )
         logits = logits[:, 0]
@@ -209,8 +240,8 @@ class Engine:
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _prefill_chunk_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
         """One chunk of a long prompt: write at positions, attend the
-        whole cache row causally (llama.forward mode=prefill_chunk)."""
-        logits, cache = llama.forward(
+        whole cache row causally (self._model.forward mode=prefill_chunk)."""
+        logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill_chunk", last_only=True, slot_ids=slot_ids,
         )
@@ -222,7 +253,7 @@ class Engine:
     def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
         """Multimodal prefill: precomputed (image-spliced) embeddings
         replace the token-embedding lookup."""
-        logits, cache = llama.forward(
+        logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill", last_only=True, slot_ids=slot_ids, embeds=embeds,
         )
@@ -237,7 +268,7 @@ class Engine:
 
         def step(carry, i):
             cache, tok, pos = carry
-            logits, cache = llama.forward(
+            logits, cache = self._model.forward(
                 params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache, mode="decode",
             )
             logits = logits[:, 0]
@@ -330,7 +361,7 @@ class Engine:
         # Prompts beyond the largest bucket go through chunked prefill
         # (dense cache path); the rest batch normally.
         biggest = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
-        if not self.paged and any(len(p) > biggest for p in prompts):
+        if not self.paged and not self.is_moe and any(len(p) > biggest for p in prompts):
             results = []
             short_idx = [i for i, p in enumerate(prompts) if len(p) <= biggest]
             for i, p in enumerate(prompts):
