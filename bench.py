@@ -24,6 +24,12 @@ import time
 
 import numpy as np
 
+_T0 = time.time()
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench {time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
 
 def _steady_state_decode_tps(engine, batch: int, prompt_len: int, steps: int) -> float:
     """Fill all slots via engine.prefill, then time engine.decode steps."""
@@ -33,11 +39,13 @@ def _steady_state_decode_tps(engine, batch: int, prompt_len: int, steps: int) ->
 
     pending = {}
     slots = list(range(batch))
+    _progress(f"prefilling {batch} slots (prompt {prompt_len})")
     for group_start in range(0, batch, engine.config.max_prefill_batch):
         group = slots[group_start:group_start + engine.config.max_prefill_batch]
         prompts = [[int(x) for x in rng.integers(1, V - 1, prompt_len)] for _ in group]
         for res in engine.prefill(prompts, group, [0.0] * len(group), [1.0] * len(group)):
             pending[res.slot] = res.first_token
+    _progress("prefill done")
 
     tokens = np.zeros((S,), np.int32)
     positions = np.zeros((S,), np.int32)
@@ -61,8 +69,9 @@ def _steady_state_decode_tps(engine, batch: int, prompt_len: int, steps: int) ->
 
     # Warmup: the first dispatches after compile are slow through the
     # remote-TPU tunnel; measure steady state only.
-    for _ in range(4):
+    for i in range(4):
         run_chunk()
+        _progress(f"warmup chunk {i + 1}/4 done")
 
     n_chunks = max(steps // chunk, 1)
     start = time.perf_counter()
@@ -82,14 +91,19 @@ def main() -> None:
         prefill_buckets=(128,), dtype="bfloat16", use_mesh=False, decode_chunk=32,
     )
 
+    _progress("building serving engine (paged, 64 slots)")
     serving = Engine(EngineConfig(**common, max_slots=64, attention="paged", page_size=64))
     mode = "paged" if serving.paged else "dense"
+    _progress("engine ready; measuring batched decode")
     batched = _steady_state_decode_tps(serving, batch=64, prompt_len=128, steps=256)
+    _progress(f"batched: {batched:.0f} tok/s")
     del serving
 
     single_cfg = dict(common, max_prefill_batch=1)
+    _progress("building single-stream baseline engine")
     single = Engine(EngineConfig(**single_cfg, max_slots=1, attention="dense"))
     baseline = _steady_state_decode_tps(single, batch=1, prompt_len=128, steps=256)
+    _progress(f"single-stream: {baseline:.0f} tok/s")
 
     import jax
 
