@@ -21,6 +21,8 @@ def main() -> None:
     p.add_argument("--max-prefill-batch", type=int, default=8)
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
+    p.add_argument("--metrics-push-url", default=None,
+                   help="gateway OTLP push endpoint (e.g. http://gateway:8080/v1/metrics)")
     args = p.parse_args()
 
     # Multi-host pods: join the jax.distributed world before touching
@@ -38,7 +40,8 @@ def main() -> None:
         dtype=args.dtype,
         use_mesh=not args.no_mesh,
     )
-    asyncio.run(serve(cfg, host=args.host, port=args.port, served_model_name=args.served_model_name))
+    asyncio.run(serve(cfg, host=args.host, port=args.port, served_model_name=args.served_model_name,
+                      metrics_push_url=args.metrics_push_url))
 
 
 if __name__ == "__main__":

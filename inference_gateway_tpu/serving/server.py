@@ -38,7 +38,8 @@ from inference_gateway_tpu.serving.tokenizer import DetokenizeState
 
 class SidecarServer:
     def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
-                 served_model_name: str | None = None, logger: Logger | None = None):
+                 served_model_name: str | None = None, logger: Logger | None = None,
+                 metrics_push_url: str | None = None, metrics_push_interval: float = 15.0):
         self.engine = engine
         self.scheduler = scheduler or Scheduler(engine)
         self._own_scheduler = scheduler is None
@@ -48,6 +49,13 @@ class SidecarServer:
         self._started = time.monotonic()
         self.router = self._build_router()
         self.http = HTTPServer(self.router, logger=self.logger)
+        # OTLP push: decode-loop metrics flow into the gateway's
+        # POST /v1/metrics (SURVEY.md §7 stage 7).
+        self.metrics_push_url = metrics_push_url
+        self.metrics_push_interval = metrics_push_interval
+        self._ttft_samples: list[float] = []
+        self._pushed_decode_tokens = 0
+        self._push_task: asyncio.Task | None = None
 
     # ------------------------------------------------------------------
     def _build_router(self) -> Router:
@@ -62,12 +70,77 @@ class SidecarServer:
     async def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:
         if self._own_scheduler:
             self.scheduler.start()
-        return await self.http.start(host, port)
+        bound = await self.http.start(host, port)
+        if self.metrics_push_url:
+            self._push_task = asyncio.create_task(self._metrics_push_loop())
+        return bound
 
     async def shutdown(self) -> None:
+        if self._push_task is not None:
+            self._push_task.cancel()
         await self.http.shutdown()
         if self._own_scheduler:
             self.scheduler.stop()
+
+    # -- OTLP metrics push ---------------------------------------------
+    def record_ttft(self, seconds: float) -> None:
+        self._ttft_samples.append(seconds)
+
+    def _otlp_payload(self) -> dict[str, Any] | None:
+        """Delta OTLP-JSON payload of TTFT histogram since last push."""
+        samples, self._ttft_samples = self._ttft_samples, []
+        if not samples:
+            return None
+        bounds = [0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4]
+        counts = [0] * (len(bounds) + 1)
+        for s in samples:
+            i = 0
+            while i < len(bounds) and s > bounds[i]:
+                i += 1
+            counts[i] += 1
+        attrs = [
+            {"key": "gen_ai.provider.name", "value": {"stringValue": "tpu"}},
+            {"key": "gen_ai.request.model", "value": {"stringValue": self.model_name}},
+        ]
+        return {
+            "resourceMetrics": [{
+                "resource": {"attributes": [
+                    {"key": "service.name", "value": {"stringValue": "tpu-sidecar"}}]},
+                "scopeMetrics": [{
+                    "metrics": [{
+                        "name": "gen_ai.server.time_to_first_token",
+                        "histogram": {
+                            "aggregationTemporality": 1,
+                            "dataPoints": [{
+                                "bucketCounts": [str(c) for c in counts],
+                                "explicitBounds": bounds,
+                                "sum": sum(samples),
+                                "count": str(len(samples)),
+                                "attributes": attrs,
+                            }],
+                        },
+                    }],
+                }],
+            }]
+        }
+
+    async def _metrics_push_loop(self) -> None:
+        from inference_gateway_tpu.netio.client import HTTPClient
+
+        client = HTTPClient()
+        while True:
+            await asyncio.sleep(self.metrics_push_interval)
+            payload = self._otlp_payload()
+            if payload is None:
+                continue
+            try:
+                await client.post(
+                    self.metrics_push_url,
+                    json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json", "X-Source": "tpu-sidecar"},
+                )
+            except Exception as e:
+                self.logger.warn("metrics push failed", "error", str(e))
 
     # -- handlers ------------------------------------------------------
     async def health(self, req: Request) -> Response:
@@ -175,8 +248,14 @@ class SidecarServer:
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
+        arrival = time.monotonic()
+        first_token_seen = False
 
         def cb(token: int, logprob: float, finished: bool, reason: str | None) -> None:
+            nonlocal first_token_seen
+            if not first_token_seen:
+                first_token_seen = True
+                self.record_ttft(time.monotonic() - arrival)
             loop.call_soon_threadsafe(q.put_nowait, (token, finished, reason))
 
         gen.callback = cb
@@ -285,13 +364,14 @@ class SidecarServer:
 
 
 async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
-                served_model_name: str | None = None) -> None:
+                served_model_name: str | None = None, metrics_push_url: str | None = None) -> None:
     """Run the sidecar until cancelled (entry point for __main__)."""
     logger = new_logger()
     engine = Engine(config)
     warm = engine.warmup()
     logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)
-    server = SidecarServer(engine, served_model_name=served_model_name, logger=logger)
+    server = SidecarServer(engine, served_model_name=served_model_name, logger=logger,
+                           metrics_push_url=metrics_push_url)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:

@@ -1,0 +1,52 @@
+"""Sidecar → gateway metrics push: TTFT histograms flow through the OTLP
+ingest endpoint into the gateway's Prometheus exposition."""
+
+import asyncio
+import json
+
+import pytest
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.server import SidecarServer
+
+
+async def test_sidecar_pushes_ttft_to_gateway(aloop):
+    gw = build_gateway(env={
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_METRICS_PUSH_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        "SERVER_PORT": "0",
+    })
+    gw_port = await gw.start("127.0.0.1", 0)
+
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=2, max_seq_len=128,
+                                 dtype="float32", max_prefill_batch=2, use_mesh=False))
+    sidecar = SidecarServer(
+        engine, served_model_name="tpu-test",
+        metrics_push_url=f"http://127.0.0.1:{gw_port}/v1/metrics",
+        metrics_push_interval=0.2,
+    )
+    port = await sidecar.start("127.0.0.1", 0)
+    try:
+        client = HTTPClient()
+        body = {"model": "tpu-test", "max_tokens": 4,
+                "messages": [{"role": "user", "content": "hi"}]}
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", json.dumps(body).encode())
+        assert resp.status == 200
+
+        # Wait for at least one push cycle.
+        for _ in range(30):
+            await asyncio.sleep(0.2)
+            text = gw.otel.expose_prometheus()
+            if "time_to_first_token" in text and 'source="tpu-sidecar"' in text:
+                break
+        text = gw.otel.expose_prometheus()
+        assert 'gen_ai_provider_name="tpu"' in text
+        assert 'gen_ai_request_model="tpu-test"' in text
+        line = next(l for l in text.splitlines() if "time_to_first_token_count" in l)
+        assert int(line.rsplit(" ", 1)[1]) >= 1
+    finally:
+        await sidecar.shutdown()
+        await gw.shutdown()
