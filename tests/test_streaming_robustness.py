@@ -1,0 +1,92 @@
+"""Streaming robustness (reference tests/streaming_test.go:21,56): a slow
+SSE stream whose total duration exceeds SERVER_WRITE_TIMEOUT must survive,
+because each chunk write gets a fresh deadline window
+(netio/server.py per-chunk drain timeout; reference shared.go:27-56)."""
+
+import asyncio
+import json
+import time
+
+from inference_gateway_tpu.api.middlewares.logger import is_sensitive_key, sanitize_query
+from inference_gateway_tpu.api.proxymod import create_smart_body_preview, truncate_words
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+
+
+async def test_slow_stream_survives_write_timeout(aloop):
+    n_chunks = 8
+    gap = 0.3  # total ~2.4s >> write timeout 1s
+
+    async def chat(req: Request) -> Response:
+        async def chunks():
+            for i in range(n_chunks):
+                await asyncio.sleep(gap)
+                yield ("data: " + json.dumps({
+                    "id": "slow", "object": "chat.completion.chunk", "created": 1, "model": "m",
+                    "choices": [{"index": 0, "delta": {"content": f"t{i}"}, "finish_reason": None}],
+                }) + "\n\n").encode()
+            yield b"data: [DONE]\n\n"
+        return StreamingResponse.sse(chunks())
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+
+    gw = build_gateway(env={
+        "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+        "SERVER_WRITE_TIMEOUT": "1s",  # < total stream duration
+        "SERVER_PORT": "0",
+    })
+    port = await gw.start("127.0.0.1", 0)
+    try:
+        client = HTTPClient()
+        body = {"model": "ollama/m", "stream": True,
+                "messages": [{"role": "user", "content": "x"}]}
+        start = time.monotonic()
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                 json.dumps(body).encode(), stream=True, timeout=30)
+        text = b""
+        async for line in resp.iter_lines():
+            text += line
+        elapsed = time.monotonic() - start
+        # All chunks arrived, over a span longer than the write timeout.
+        for i in range(n_chunks):
+            assert f"t{i}".encode() in text
+        assert b"[DONE]" in text
+        assert elapsed > 2.0
+    finally:
+        await gw.shutdown()
+        await upstream.shutdown()
+
+
+def test_logger_redaction():
+    assert is_sensitive_key("Authorization")
+    assert is_sensitive_key("x-api-key")
+    assert is_sensitive_key("OPENAI_API_KEY")
+    assert not is_sensitive_key("model")
+    q = sanitize_query({"key": ["secret"], "provider": ["openai"]})
+    assert q["key"] == "[REDACTED]"
+    assert q["provider"] == "openai"
+
+
+def test_proxymod_smart_preview():
+    assert truncate_words("a b c d", 2) == "a b... (2 more words)"
+    body = json.dumps({
+        "model": "m",
+        "messages": [
+            {"role": "user", "content": "word " * 50},
+            {"role": "user", "content": [
+                {"type": "text", "text": "x " * 30},
+                {"type": "image_url", "image_url": {"url": "data:..."}},
+            ]},
+        ],
+    }).encode()
+    preview = create_smart_body_preview(body, truncate_words_n=5, max_messages=10)
+    assert "more words" in preview["messages"][0]["content"]
+    parts = preview["messages"][1]["content"]
+    assert "more words" in parts[0]["text"]
+    assert parts[1] == {"type": "image_url", "omitted": True}
+    # Non-JSON bodies degrade to word truncation.
+    assert "more words" in create_smart_body_preview(b"raw " * 100, truncate_words_n=3)
