@@ -14,12 +14,28 @@ import jax.numpy as jnp
 GREEDY_EPS = 1e-4
 
 
+def per_row_keys(
+    rng: jax.Array,
+    seeds: jnp.ndarray,  # (B,) int32 request seeds
+    use_seed: jnp.ndarray,  # (B,) bool — row has an explicit seed
+    positions: jnp.ndarray,  # (B,) generation positions
+) -> jnp.ndarray:
+    """Per-row PRNG keys: seeded rows derive from (seed, position) so the
+    same request with the same seed reproduces its samples regardless of
+    batch composition; unseeded rows derive from the step rng + row."""
+    B = seeds.shape[0]
+    seeded = jax.vmap(lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p))(seeds, positions)
+    unseeded = jax.vmap(lambda i: jax.random.fold_in(rng, i))(jnp.arange(B))
+    return jnp.where(use_seed[:, None], seeded, unseeded)
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # (B, V) fp32
     rng: jax.Array,
     temperature: jnp.ndarray,  # (B,)
     top_p: jnp.ndarray,  # (B,)
     top_k: int = 0,  # static; 0 = disabled
+    row_keys: jnp.ndarray | None = None,  # (B, 2) per-row keys override rng
 ) -> jnp.ndarray:
     """Sample one token per row; temperature <= GREEDY_EPS means argmax."""
     logits = logits.astype(jnp.float32)
@@ -46,7 +62,10 @@ def sample_tokens(
     ].set(keep_sorted)
     filtered = jnp.where(keep, scaled, -jnp.inf)
 
-    sampled_tok = jax.random.categorical(rng, filtered, axis=-1)
+    if row_keys is None:
+        sampled_tok = jax.random.categorical(rng, filtered, axis=-1)
+    else:
+        sampled_tok = jax.vmap(lambda k, row: jax.random.categorical(k, row))(row_keys, filtered)
     return jnp.where(temperature <= GREEDY_EPS, greedy_tok, sampled_tok)
 
 

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from inference_gateway_tpu.models import llama
-from inference_gateway_tpu.ops.sampling import compute_logprobs, sample_tokens
+from inference_gateway_tpu.ops.sampling import compute_logprobs, per_row_keys, sample_tokens
 from inference_gateway_tpu.parallel.mesh import create_mesh, default_mesh_shape
 from inference_gateway_tpu.parallel.sharding import (
     check_divisibility,
@@ -218,12 +218,13 @@ class Engine:
 
     # ------------------------------------------------------------------
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
-    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
+    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng):
         logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill", last_only=True, slot_ids=slot_ids,
         )
-        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        keys = per_row_keys(rng, seeds, use_seed, lengths)
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
@@ -238,31 +239,33 @@ class Engine:
         return toks, logprobs, cache
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
-    def _prefill_chunk_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
+    def _prefill_chunk_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng):
         """One chunk of a long prompt: write at positions, attend the
         whole cache row causally (self._model.forward mode=prefill_chunk)."""
         logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill_chunk", last_only=True, slot_ids=slot_ids,
         )
-        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        keys = per_row_keys(rng, seeds, use_seed, lengths)
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
-    def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, rng):
+    def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng):
         """Multimodal prefill: precomputed (image-spliced) embeddings
         replace the token-embedding lookup."""
         logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill", last_only=True, slot_ids=slot_ids, embeds=embeds,
         )
-        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        keys = per_row_keys(rng, seeds, use_seed, lengths)
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
     @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
-    def _decode_chunk_fn(self, params, cache, tokens, positions, temps, top_ps, rng, n_steps):
+    def _decode_chunk_fn(self, params, cache, tokens, positions, temps, top_ps, seeds, use_seed, rng, n_steps):
         """n_steps fused decode steps (lax.scan); sampling feeds back
         on-device so the host syncs once per chunk."""
 
@@ -272,7 +275,9 @@ class Engine:
                 params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache, mode="decode",
             )
             logits = logits[:, 0]
-            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps, top_k=self.config.top_k)
+            keys = per_row_keys(jax.random.fold_in(rng, i), seeds, use_seed, pos + 1)
+            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps,
+                                top_k=self.config.top_k, row_keys=keys)
             nxt = nxt.astype(jnp.int32)
             logprobs = compute_logprobs(logits, nxt)
             return (cache, nxt, pos + 1), (nxt, logprobs)
@@ -284,7 +289,7 @@ class Engine:
 
     @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
     def _decode_chunk_fn_paged(self, params, cache, tokens, positions, write_idx,
-                               page_table, temps, top_ps, rng, n_steps):
+                               page_table, temps, top_ps, seeds, use_seed, rng, n_steps):
         """Paged variant: write_idx is (S, n_steps) precomputed flat cache
         positions (OOB = drop)."""
 
@@ -295,7 +300,9 @@ class Engine:
                 params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache,
                 w_idx[:, None], page_table, mode="decode", last_only=True,
             )
-            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps, top_k=self.config.top_k)
+            keys = per_row_keys(jax.random.fold_in(rng, i), seeds, use_seed, pos + 1)
+            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps,
+                                top_k=self.config.top_k, row_keys=keys)
             nxt = nxt.astype(jnp.int32)
             logprobs = compute_logprobs(logits, nxt)
             return (cache, nxt, pos + 1), (nxt, logprobs)
@@ -307,12 +314,13 @@ class Engine:
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
-                          page_table, temps, top_ps, rng):
+                          page_table, temps, top_ps, seeds, use_seed, rng):
         logits, cache = llama.forward_paged(
             params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
             page_table, mode="prefill", last_only=True,
         )
-        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        keys = per_row_keys(rng, seeds, use_seed, lengths)
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
@@ -352,7 +360,8 @@ class Engine:
         return ids, embeds
 
     def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float],
-                top_ps: list[float], embeds: list | None = None) -> list[PrefillResult]:
+                top_ps: list[float], embeds: list | None = None,
+                seeds: list | None = None) -> list[PrefillResult]:
         """Prefill a batch of prompts into their slots; returns each
         prompt's sampled first token. Pads to (max_prefill_batch, bucket).
         ``embeds`` optionally carries per-row (T_i, H) multimodal
@@ -366,12 +375,14 @@ class Engine:
             short_idx = [i for i, p in enumerate(prompts) if len(p) <= biggest]
             for i, p in enumerate(prompts):
                 if len(p) > biggest:
-                    results.append((i, self._prefill_one_chunked(p, slots[i], temps[i], top_ps[i])))
+                    results.append((i, self._prefill_one_chunked(p, slots[i], temps[i], top_ps[i],
+                        seed=None if seeds is None else seeds[i])))
             if short_idx:
                 sub = self.prefill(
                     [prompts[i] for i in short_idx], [slots[i] for i in short_idx],
                     [temps[i] for i in short_idx], [top_ps[i] for i in short_idx],
                     embeds=[(embeds or [None] * len(prompts))[i] for i in short_idx] if embeds else None,
+                    seeds=[(seeds or [None] * len(prompts))[i] for i in short_idx] if seeds else None,
                 )
                 results.extend(zip(short_idx, sub))
             return [r for _, r in sorted(results)]
@@ -384,12 +395,17 @@ class Engine:
         slot_arr = np.full((Bp,), self.config.max_slots, np.int32)  # OOB rows drop
         t_arr = np.zeros((Bp,), np.float32)
         p_arr = np.ones((Bp,), np.float32)
+        seed_arr = np.zeros((Bp,), np.int32)
+        use_seed = np.zeros((Bp,), bool)
         for i, (prompt, slot) in enumerate(zip(prompts, slots)):
             tokens[i, : len(prompt)] = prompt
             lengths[i] = len(prompt)
             slot_arr[i] = slot
             t_arr[i] = temps[i]
             p_arr[i] = top_ps[i]
+            if seeds is not None and seeds[i] is not None:
+                seed_arr[i] = int(seeds[i])
+                use_seed[i] = True
         positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (Bp, bucket))
 
         has_mm = embeds is not None and any(e is not None for e in embeds)
@@ -404,7 +420,7 @@ class Engine:
                 toks, logprobs, self.cache = self._prefill_fn_mm(
                     self.params, self.cache, full, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
-                    jnp.asarray(p_arr), self._next_rng(),
+                    jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
                 )
             elif self.paged:
                 write_idx = np.full((Bp, bucket), self._flat_size, np.int64)  # OOB = drop
@@ -415,13 +431,13 @@ class Engine:
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray(write_idx),
                     jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
-                    jnp.asarray(p_arr), self._next_rng(),
+                    jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
                 )
             else:
                 toks, logprobs, self.cache = self._prefill_fn(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
-                    jnp.asarray(p_arr), self._next_rng(),
+                    jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
                 )
             self.metrics["prefill_tokens"] += int(lengths.sum())
             self.metrics["prefill_batches"] += 1
@@ -465,7 +481,8 @@ class Engine:
             self.metrics["decode_steps"] += 1
         return np.asarray(toks), np.asarray(logprobs)
 
-    def _prefill_one_chunked(self, prompt: list[int], slot: int, temp: float, top_p: float) -> PrefillResult:
+    def _prefill_one_chunked(self, prompt: list[int], slot: int, temp: float, top_p: float,
+                             seed: int | None = None) -> PrefillResult:
         """Chunked prefill for one long prompt (chunk = largest bucket)."""
         chunk = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
         total = len(prompt)
@@ -481,14 +498,16 @@ class Engine:
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray([slot], np.int32),
                     jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
-                    self._next_rng(),
+                    jnp.asarray([seed if seed is not None else 0], np.int32),
+                    jnp.asarray([seed is not None]), self._next_rng(),
                 )
             self.metrics["prefill_tokens"] += total
             self.metrics["prefill_batches"] += 1
         return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
 
     def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray, active: np.ndarray,
-                     temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None):
+                     temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None,
+                     seeds: np.ndarray | None = None, use_seed: np.ndarray | None = None):
         """Run ``n_steps`` fused decode steps for ALL slots.
 
         tokens/positions: (S,) pending token + its write position per
@@ -497,6 +516,10 @@ class Engine:
         """
         S = self.config.max_slots
         n = n_steps or self.config.decode_chunk
+        if seeds is None:
+            seeds = np.zeros((S,), np.int32)
+        if use_seed is None:
+            use_seed = np.zeros((S,), bool)
         with self._lock:
             if self.paged:
                 write_idx = np.full((S, n), self._flat_size, np.int64)
@@ -511,12 +534,14 @@ class Engine:
                 toks, logprobs, self.cache = self._decode_chunk_fn_paged(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(write_idx), jnp.asarray(self.allocator.page_table()),
-                    jnp.asarray(temps), jnp.asarray(top_ps), self._next_rng(), n_steps=n,
+                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(seeds),
+                    jnp.asarray(use_seed), self._next_rng(), n_steps=n,
                 )
             else:
                 toks, logprobs, self.cache = self._decode_chunk_fn(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(temps), jnp.asarray(top_ps), self._next_rng(), n_steps=n,
+                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(seeds),
+                    jnp.asarray(use_seed), self._next_rng(), n_steps=n,
                 )
             n_active = int(active.sum())
             self.metrics["decode_tokens"] += n_active * n

@@ -38,6 +38,7 @@ class GenRequest:
     callback: TokenCallback = lambda *a: None
     request_id: str = ""
     embeds: object = None  # (T, H) multimodal embedding override row
+    seed: int | None = None  # reproducible sampling (OpenAI `seed`)
 
 
 @dataclass
@@ -110,10 +111,12 @@ class Scheduler:
         if not batch:
             return
         embeds = [r.embeds for r in batch]
+        seeds = [r.seed for r in batch]
         results = self.engine.prefill(
             [r.prompt_ids for r in batch], slots,
             [r.temperature for r in batch], [r.top_p for r in batch],
             embeds=embeds if any(e is not None for e in embeds) else None,
+            seeds=seeds if any(s is not None for s in seeds) else None,
         )
         for req, res in zip(batch, results):
             state = _SlotState(req, pos=len(req.prompt_ids), pending_token=res.first_token,
@@ -138,12 +141,17 @@ class Scheduler:
         active = np.zeros((S,), bool)
         temps = np.zeros((S,), np.float32)
         top_ps = np.ones((S,), np.float32)
+        seeds = np.zeros((S,), np.int32)
+        use_seed = np.zeros((S,), bool)
         for slot, st in self._slots.items():
             tokens[slot] = st.pending_token
             positions[slot] = st.pos
             active[slot] = True
             temps[slot] = st.req.temperature
             top_ps[slot] = st.req.top_p
+            if st.req.seed is not None:
+                seeds[slot] = int(st.req.seed)
+                use_seed[slot] = True
 
         # Shrink the chunk when new work is waiting so admission latency
         # stays bounded; otherwise run the full configured chunk.
@@ -151,7 +159,8 @@ class Scheduler:
         with self._wake:
             if self._waiting and self._free:
                 n = 1
-        toks, logprobs = self.engine.decode_chunk(tokens, positions, active, temps, top_ps, n_steps=n)
+        toks, logprobs = self.engine.decode_chunk(tokens, positions, active, temps, top_ps, n_steps=n,
+                                                  seeds=seeds, use_seed=use_seed)
 
         for slot in list(self._slots):
             st = self._slots[slot]

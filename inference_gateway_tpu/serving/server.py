@@ -216,12 +216,14 @@ class SidecarServer:
         max_tokens = body.get("max_completion_tokens") or body.get("max_tokens") or 256
         stop = body.get("stop")
         stop_strings: list[str] = [stop] if isinstance(stop, str) else list(stop or [])
+        seed = body.get("seed")
         req = GenRequest(
             prompt_ids=prompt_ids,
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature") or 0.0),
             top_p=float(body.get("top_p") or 1.0),
             embeds=embeds,
+            seed=int(seed) if seed is not None else None,
         )
         meta = {
             "id": "chatcmpl-" + uuid.uuid4().hex[:24],
@@ -256,9 +258,10 @@ class SidecarServer:
             if not first_token_seen:
                 first_token_seen = True
                 self.record_ttft(time.monotonic() - arrival)
-            loop.call_soon_threadsafe(q.put_nowait, (token, finished, reason))
+            loop.call_soon_threadsafe(q.put_nowait, (token, logprob, finished, reason))
 
         gen.callback = cb
+        want_logprobs = bool(body.get("logprobs"))
 
         if stream:
             return StreamingResponse.sse(self._stream_chunks(gen, meta, q, include_usage))
@@ -268,25 +271,31 @@ class SidecarServer:
         detok = DetokenizeState()
         completion_tokens = 0
         reason = "stop"
+        logprob_content: list[dict[str, Any]] = []
         while True:
-            token, finished, fin_reason = await q.get()
+            token, logprob, finished, fin_reason = await q.get()
             if not (finished and fin_reason == "stop"):
-                detok.push(self.engine.tokenizer, token)
+                delta = detok.push(self.engine.tokenizer, token)
+                if want_logprobs:
+                    logprob_content.append({"token": delta, "logprob": logprob})
             completion_tokens += 1
             if finished:
                 reason = fin_reason or "stop"
                 break
         text, reason = self._apply_stop_strings(detok.emitted, meta["stop_strings"], reason)
+        choice: dict[str, Any] = {
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": reason,
+        }
+        if want_logprobs:
+            choice["logprobs"] = {"content": logprob_content}
         return Response.json({
             "id": meta["id"],
             "object": "chat.completion",
             "created": meta["created"],
             "model": meta["model"],
-            "choices": [{
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": reason,
-            }],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": meta["prompt_tokens"],
                 "completion_tokens": completion_tokens,
@@ -323,7 +332,7 @@ class SidecarServer:
         emitted_len = 0
         stopped_early = False
         while True:
-            token, finished, fin_reason = await q.get()
+            token, _logprob, finished, fin_reason = await q.get()
             completion_tokens += 1
             if not (finished and fin_reason == "stop"):
                 delta = detok.push(self.engine.tokenizer, token)
