@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from inference_gateway_tpu.ops.attention import causal_prefill_mask, decode_mask, gqa_attend
 from inference_gateway_tpu.ops.norms import rms_norm
+from inference_gateway_tpu.ops.quant import qmatmul
 from inference_gateway_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 
 
@@ -114,9 +115,9 @@ def _layer(
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(B, T, Hq, D)
-    k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
-    v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+    q = qmatmul(h, lp["wq"]).reshape(B, T, Hq, D)
+    k = qmatmul(h, lp["wk"]).reshape(B, T, Hkv, D)
+    v = qmatmul(h, lp["wv"]).reshape(B, T, Hkv, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -133,10 +134,10 @@ def _layer(
         attn = gqa_attend(q, kc.astype(q.dtype), vc.astype(q.dtype), mask)
     else:
         attn = gqa_attend(q, k, v, mask)
-    x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
+    x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+    x = x + qmatmul(jax.nn.silu(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
     return x, new_k_cache, new_v_cache
 
 
@@ -224,8 +225,10 @@ def forward(
             # positions[:, 0] (0 for fresh prefill).
             idx = jnp.maximum(lengths - 1 - positions[:, 0], 0)
         x = x[jnp.arange(B), idx]  # (B, H)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 
@@ -279,9 +282,9 @@ def forward_paged(
     def body(x, per_layer):
         lp, kc, vc = per_layer
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(B, T, Hq, D)
-        k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
-        v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+        q = qmatmul(h, lp["wq"]).reshape(B, T, Hq, D)
+        k = qmatmul(h, lp["wk"]).reshape(B, T, Hkv, D)
+        v = qmatmul(h, lp["wv"]).reshape(B, T, Hkv, D)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
@@ -299,10 +302,10 @@ def forward_paged(
             attn = attn[:, None]  # (B, 1, Hq, D)
         else:
             attn = gqa_attend(q, k, v, mask)
-        x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
+        x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
 
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+        x = x + qmatmul(jax.nn.silu(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
         return x, (new_kc, new_vc)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
@@ -312,8 +315,10 @@ def forward_paged(
     if last_only:
         idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
         x = x[jnp.arange(B), idx]
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 

@@ -1,0 +1,84 @@
+"""Weight-only int8 quantization.
+
+Decode throughput on TPU is HBM-bandwidth-bound by the weight stream;
+storing matmul weights as int8 with per-output-channel scales halves
+that traffic (and fits Llama-3-8B in a single v5e chip's 16 GB). The
+dequantize-multiply fuses into the matmul epilogue under XLA.
+
+``QTensor`` is a registered pytree node, so quantized weights slot into
+the existing stacked-layer pytrees — ``lax.scan`` slices the (q, scale)
+children along the layer axis exactly like plain arrays, and sharding
+specs apply unchanged to the ``q`` child.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """int8 weights + per-output-channel fp scales for (..., in, out)."""
+
+    def __init__(self, q: jnp.ndarray, scale: jnp.ndarray):
+        self.q = q  # int8, (..., in, out)
+        self.scale = scale  # fp32, (..., 1, out)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_tensor(w: jnp.ndarray) -> QTensor:
+    """Per-output-channel symmetric int8 over the contraction (-2) axis."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # (..., 1, out)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q, scale)
+
+
+def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for plain arrays or QTensors (dequant fused by XLA)."""
+    if isinstance(w, QTensor):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.scale.astype(x.dtype)
+    return x @ w
+
+
+# Weight names quantized in the decoder pytrees (matmul weights only —
+# embeddings, norms, and routers stay full precision).
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
+
+def quantize_llama_params(params: dict) -> dict:
+    """Quantize the stacked layer matmuls of a llama/mixtral pytree."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in QUANTIZABLE:
+        if name in layers:
+            layers[name] = quantize_tensor(layers[name])
+    out["layers"] = layers
+    if "lm_head" in out:
+        out["lm_head"] = quantize_tensor(out["lm_head"])
+    return out
+
+
+def dequantize_error(w: jnp.ndarray) -> float:
+    """Max relative reconstruction error (diagnostics)."""
+    qt = quantize_tensor(w)
+    back = qt.q.astype(jnp.float32) * qt.scale
+    denom = jnp.maximum(jnp.abs(w.astype(jnp.float32)), 1e-8)
+    return float(jnp.max(jnp.abs(back - w.astype(jnp.float32)) / denom))

@@ -55,6 +55,7 @@ class EngineConfig:
     attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
     page_size: int = 32
     num_pages: int = 0  # 0 = full reservation
+    quantize: str | None = None  # "int8" = weight-only quantization (ops/quant.py)
     # Decode steps fused into one jitted scan per host roundtrip. Token
     # sampling feeds back on-device; the host reads a (chunk, slots)
     # token block once per chunk. Larger chunks amortize host↔device
@@ -133,6 +134,12 @@ class Engine:
         if self.mesh is not None:
             specs = self._model.param_specs(self.model_cfg) if self.is_moe else llama_param_specs(self.model_cfg)
             params = shard_params(params, self.mesh, specs)
+        # Weight-only int8: halves the per-step weight HBM stream
+        # (single-device dense models this round).
+        if config.quantize == "int8" and self.mesh is None and not self.is_moe:
+            from inference_gateway_tpu.ops.quant import quantize_llama_params
+
+            params = jax.jit(quantize_llama_params)(params)
         self.params = params
 
         # Paged attention is single-device + dense-model this round;
