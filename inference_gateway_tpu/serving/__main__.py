@@ -20,6 +20,12 @@ def main() -> None:
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--max-prefill-batch", type=int, default=8)
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    p.add_argument("--quantize", default=None, choices=["int8"],
+                   help="weight-only quantization (halves weight HBM traffic)")
+    p.add_argument("--attention", default="dense", choices=["dense", "paged"])
+    p.add_argument("--page-size", type=int, default=32)
+    p.add_argument("--decode-chunk", type=int, default=8)
+    p.add_argument("--vision-model", default=None, help="vision tower preset for multimodal")
     p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
     p.add_argument("--metrics-push-url", default=None,
                    help="gateway OTLP push endpoint (e.g. http://gateway:8080/v1/metrics)")
@@ -39,6 +45,11 @@ def main() -> None:
         max_prefill_batch=args.max_prefill_batch,
         dtype=args.dtype,
         use_mesh=not args.no_mesh,
+        quantize=args.quantize,
+        attention=args.attention,
+        page_size=args.page_size,
+        decode_chunk=args.decode_chunk,
+        vision_model=args.vision_model,
     )
     asyncio.run(serve(cfg, host=args.host, port=args.port, served_model_name=args.served_model_name,
                       metrics_push_url=args.metrics_push_url))
