@@ -1,0 +1,128 @@
+"""Flash attention for prefill (Pallas).
+
+XLA's einsum attention materializes (B, Hkv, G, T, T) fp32 scores —
+fine for short buckets, quadratic-memory for long-context prefill. This
+kernel computes exact causal GQA attention with flash-style block
+accumulation: scores never exceed (BQ·G, BK) per grid step.
+
+Grid: (B, Hkv, T/BQ). Each instance holds its (b, h) KV panel in VMEM
+(Mosaic pipelines the HBM→VMEM transfer from the BlockSpec) and folds
+BK-sized key blocks into a running (m, l, acc) accumulator; the causal
+structure skips key blocks entirely above the diagonal.
+
+Ragged rows are masked by ``lengths`` (scalar-prefetched). Outputs for
+padded query positions are undefined (callers gather valid positions).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    length_ref,  # (B, 1) SMEM scalar prefetch
+    q_ref,  # (1, 1, BQ, G, D) VMEM
+    k_ref,  # (1, 1, T, D) VMEM
+    v_ref,  # (1, 1, T, D) VMEM
+    out_ref,  # (1, 1, BQ, G, D)
+    *,
+    block_q: int,
+    block_k: int,
+    seq_len: int,
+    groups: int,
+    head_dim: int,
+    causal: bool,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    BQ, G, D = block_q, groups, head_dim
+    length = length_ref[b, 0]
+
+    q = q_ref[0, 0].astype(jnp.float32).reshape(BQ * G, D)
+    q_pos = qi * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, G), 0).reshape(BQ * G)
+
+    n_k = pl.cdiv(seq_len, block_k)
+    # Causal: key blocks beyond this query block's last row are all masked.
+    k_stop = jnp.minimum(n_k, pl.cdiv((qi + 1) * BQ, block_k)) if causal else n_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (D ** -0.5)  # (BQ*G, BK)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        valid = k_pos < length
+        if causal:
+            valid = valid & (k_pos <= q_pos[:, None])
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((BQ * G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BQ * G, 1), jnp.float32)
+    acc0 = jnp.zeros((BQ * G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, k_stop, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-20)
+    out_ref[0, 0] = out.reshape(BQ, G, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "causal", "interpret"))
+def flash_prefill_attention(
+    q: jnp.ndarray,  # (B, T, Hq, D)
+    k: jnp.ndarray,  # (B, T, Hkv, D)
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # (B,)
+    block_q: int = 128,
+    block_k: int = 128,
+    causal: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    assert T % block_q == 0 and T % block_k == 0, "T must tile into blocks"
+
+    # (B, Hkv, T, G, D) query panels; (B, Hkv, T, D) KV panels.
+    q_r = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4)
+    k_r = k.transpose(0, 2, 1, 3)
+    v_r = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=T,
+        groups=G, head_dim=D, causal=causal,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Hkv, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, G, D), lambda b, h, i, *_: (b, h, i, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i, *_: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, G, D), lambda b, h, i, *_: (b, h, i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, T, G, D), q.dtype),
+        interpret=interpret,
+    )(lengths.reshape(B, 1).astype(jnp.int32), q_r, k_r, v_r)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, T, Hq, D)
