@@ -263,7 +263,10 @@ def forward_paged(
 ) -> tuple[jnp.ndarray, Params]:
     """Like ``forward`` but against the paged KV cache
     (serving/kv_cache.py). Decode attention runs the Pallas ragged
-    paged-attention kernel (ops/paged_attention.py)."""
+    paged-attention kernel (ops/paged_attention.py). ``prefill_chunk``
+    attends causally over the slot's gathered pages — the prefix-cache
+    path: shared prefix pages are already populated, only the tail is
+    computed here."""
     from inference_gateway_tpu.ops.paged_attention import paged_attention
 
     B, T = tokens.shape
@@ -277,6 +280,12 @@ def forward_paged(
 
     if mode == "prefill":
         mask = causal_prefill_mask(positions, lengths)
+    elif mode == "prefill_chunk":
+        S_gather = page_table.shape[1] * page_size
+        key_pos = jnp.arange(S_gather)
+        chunk_mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+            key_pos[None, None, :] < lengths[:, None, None]
+        )
     decode = mode == "decode"
 
     def body(x, per_layer):
@@ -300,6 +309,12 @@ def forward_paged(
         if decode:
             attn = paged_attention(q[:, 0], new_kc, new_vc, page_table, lengths, Hkv)
             attn = attn[:, None]  # (B, 1, Hq, D)
+        elif mode == "prefill_chunk":
+            # Gather the slot's pages (prefix + just-written tail) and
+            # attend causally by absolute position.
+            kg = new_kc[page_table].reshape(B, -1, Hkv, D).astype(q.dtype)
+            vg = new_vc[page_table].reshape(B, -1, Hkv, D).astype(q.dtype)
+            attn = gqa_attend(q, kg, vg, chunk_mask)
         else:
             attn = gqa_attend(q, k, v, mask)
         x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
@@ -313,7 +328,10 @@ def forward_paged(
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if last_only:
-        idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
+        if mode == "decode":
+            idx = jnp.zeros_like(lengths)
+        else:  # prefill starts at 0; prefill_chunk at positions[:, 0]
+            idx = jnp.maximum(lengths - 1 - positions[:, 0], 0)
         x = x[jnp.arange(B), idx]
     if cfg.tie_word_embeddings:
         logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)

@@ -56,6 +56,7 @@ class EngineConfig:
     page_size: int = 32
     num_pages: int = 0  # 0 = full reservation
     quantize: str | None = None  # "int8" = weight-only quantization (ops/quant.py)
+    prefix_cache: bool = True  # share full prefix KV pages across requests (paged mode)
     # Decode steps fused into one jitted scan per host roundtrip. Token
     # sampling feeds back on-device; the host reads a (chunk, slots)
     # token block once per chunk. Larger chunks amortize host↔device
@@ -146,6 +147,7 @@ class Engine:
         # tp-sharded and MoE paged decode land with shard_map integration.
         self.paged = config.attention == "paged" and self.mesh is None and not self.is_moe
         self.allocator = None
+        self.prefix_cache = None
         if self.paged:
             from inference_gateway_tpu.serving.kv_cache import (
                 PagedCacheConfig,
@@ -160,6 +162,11 @@ class Engine:
             self.allocator = PageAllocator(self.page_cfg)
             self.cache = init_paged_cache(self.model_cfg, self.page_cfg, dtype=self.dtype)
             self._flat_size = self.allocator.num_pages * config.page_size
+            self.prefix_cache = None
+            if config.prefix_cache:
+                from inference_gateway_tpu.serving.kv_cache import PrefixCache
+
+                self.prefix_cache = PrefixCache(self.allocator)
         else:
             cache = self._model.init_cache(self.model_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
             if self.mesh is not None:
@@ -242,6 +249,21 @@ class Engine:
         )
         logits = logits[:, 0]
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    def _prefill_chunk_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
+                                page_table, temps, top_ps, seeds, use_seed, rng):
+        """Paged chunked prefill: fresh tail tokens attend the slot's
+        gathered pages (cached prefix + tail) causally — the
+        prefix-cache fast path."""
+        logits, cache = llama.forward_paged(
+            params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
+            page_table, mode="prefill_chunk", last_only=True,
+        )
+        keys = per_row_keys(rng, seeds, use_seed, lengths)
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
@@ -430,16 +452,53 @@ class Engine:
                     jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
                 )
             elif self.paged:
-                write_idx = np.full((Bp, bucket), self._flat_size, np.int64)  # OOB = drop
+                # Prefix-cache match: adopt shared pages, prefill tails only.
+                offsets = [0] * len(prompts)
+                if self.prefix_cache is not None:
+                    for i, (prompt, slot) in enumerate(zip(prompts, slots)):
+                        shared, matched = self.prefix_cache.match(prompt)
+                        if shared:
+                            self.allocator.adopt_pages(slot, shared)
+                            offsets[i] = matched
                 for i, (prompt, slot) in enumerate(zip(prompts, slots)):
-                    self.allocator.ensure_capacity(slot, len(prompt))
-                    write_idx[i, : len(prompt)] = self.allocator.flat_write_indices(slot, 0, len(prompt))
-                toks, logprobs, self.cache = self._prefill_fn_paged(
-                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(lengths), jnp.asarray(write_idx),
-                    jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
-                    jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
-                )
+                    self._ensure_with_evict(slot, len(prompt))
+                use_chunk = any(o > 0 for o in offsets)
+                if use_chunk:
+                    tail_bucket = self.bucket_for(max(len(p) - o for p, o in zip(prompts, offsets)))
+                    tokens = np.zeros((Bp, tail_bucket), np.int32)
+                    positions = np.zeros((Bp, tail_bucket), np.int32)
+                    write_idx = np.full((Bp, tail_bucket), self._flat_size, np.int64)
+                    # Batch rows are NOT slot-aligned in prefill: gather
+                    # each row's page-table row by its slot id.
+                    full_table = self.allocator.page_table()
+                    row_table = np.zeros((Bp, full_table.shape[1]), np.int32)
+                    for i, (prompt, slot) in enumerate(zip(prompts, slots)):
+                        tail = prompt[offsets[i]:]
+                        tokens[i, : len(tail)] = tail
+                        positions[i] = offsets[i] + np.arange(tail_bucket, dtype=np.int32)
+                        write_idx[i, : len(tail)] = self.allocator.flat_write_indices(
+                            slot, offsets[i], len(tail))
+                        row_table[i] = full_table[slot]
+                    toks, logprobs, self.cache = self._prefill_chunk_fn_paged(
+                        self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                        jnp.asarray(lengths), jnp.asarray(write_idx),
+                        jnp.asarray(row_table), jnp.asarray(t_arr),
+                        jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed),
+                        self._next_rng(),
+                    )
+                else:
+                    write_idx = np.full((Bp, bucket), self._flat_size, np.int64)  # OOB = drop
+                    for i, (prompt, slot) in enumerate(zip(prompts, slots)):
+                        write_idx[i, : len(prompt)] = self.allocator.flat_write_indices(slot, 0, len(prompt))
+                    toks, logprobs, self.cache = self._prefill_fn_paged(
+                        self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                        jnp.asarray(lengths), jnp.asarray(write_idx),
+                        jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
+                        jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
+                    )
+                if self.prefix_cache is not None:
+                    for prompt, slot in zip(prompts, slots):
+                        self.prefix_cache.insert(prompt, self.allocator.pages_of(slot))
             else:
                 toks, logprobs, self.cache = self._prefill_fn(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
@@ -512,6 +571,18 @@ class Engine:
             self.metrics["prefill_batches"] += 1
         return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
 
+    def _ensure_with_evict(self, slot: int, n_tokens: int) -> None:
+        from inference_gateway_tpu.serving.kv_cache import OutOfPagesError
+
+        try:
+            self.allocator.ensure_capacity(slot, n_tokens)
+        except OutOfPagesError:
+            if self.prefix_cache is None:
+                raise
+            need = (n_tokens + self.config.page_size - 1) // self.config.page_size
+            self.prefix_cache.evict_for_pressure(min_free=need)
+            self.allocator.ensure_capacity(slot, n_tokens)
+
     def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray, active: np.ndarray,
                      temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None,
                      seeds: np.ndarray | None = None, use_seed: np.ndarray | None = None):
@@ -536,7 +607,7 @@ class Engine:
                         cap = min(pos + n, self.config.max_seq_len)
                         valid = max(0, cap - pos)
                         if valid:
-                            self.allocator.ensure_capacity(slot, cap)
+                            self._ensure_with_evict(slot, cap)
                             write_idx[slot, :valid] = self.allocator.flat_write_indices(slot, pos, valid)
                 toks, logprobs, self.cache = self._decode_chunk_fn_paged(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),

@@ -43,12 +43,14 @@ class PagedCacheConfig:
 
 
 class PageAllocator:
-    """Host-side page bookkeeping; not thread-safe (engine holds the lock)."""
+    """Host-side page bookkeeping with refcounts (shared prefix pages);
+    not thread-safe (engine holds the lock)."""
 
     def __init__(self, cfg: PagedCacheConfig):
         self.cfg = cfg
         self.num_pages = cfg.resolve_num_pages()
         self._free: list[int] = list(range(self.num_pages))
+        self._refs: dict[int, int] = {}
         self._slot_pages: dict[int, list[int]] = {}
         # Dense page table handed to jit; row per slot, padded with
         # num_pages (an out-of-range page the kernels never dereference
@@ -61,6 +63,22 @@ class PageAllocator:
     def pages_of(self, slot: int) -> list[int]:
         return self._slot_pages.get(slot, [])
 
+    def incref(self, page: int) -> None:
+        self._refs[page] = self._refs.get(page, 0) + 1
+
+    def decref(self, page: int) -> None:
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            del self._refs[page]
+            self._free.append(page)
+
+    def adopt_pages(self, slot: int, pages: list[int]) -> None:
+        """Start a slot's page list from shared (already-ref'd) pages."""
+        assert slot not in self._slot_pages or not self._slot_pages[slot]
+        self._slot_pages[slot] = list(pages)
+        for i, p in enumerate(pages):
+            self._table[slot, i] = p
+
     def ensure_capacity(self, slot: int, n_tokens: int) -> None:
         """Grow the slot's page list to cover n_tokens total tokens."""
         pages = self._slot_pages.setdefault(slot, [])
@@ -71,12 +89,14 @@ class PageAllocator:
             if not self._free:
                 raise OutOfPagesError("KV page pool exhausted")
             page = self._free.pop()
+            self._refs[page] = 1
             self._table[slot, len(pages)] = page
             pages.append(page)
 
     def release(self, slot: int) -> None:
         pages = self._slot_pages.pop(slot, [])
-        self._free.extend(pages)
+        for p in pages:
+            self.decref(p)
         self._table[slot, :] = 0
 
     def page_table(self) -> np.ndarray:
@@ -92,6 +112,91 @@ class PageAllocator:
             t = start + i
             out[i] = pages[t // ps] * ps + (t % ps)
         return out
+
+
+class PrefixCache:
+    """Automatic prefix caching over full KV pages.
+
+    Requests sharing a prompt prefix (system prompts, few-shot headers)
+    reuse the prefix's KV pages instead of recomputing them: pages are
+    read-only once full, so sharing needs no copy-on-write — new tokens
+    always land in later pages. Entries are chain-hashed per page
+    (hash_i = H(hash_{i-1}, page_tokens_i)) and evicted LRU when the pool
+    runs low. TTFT for cached prefixes drops to the cost of the tail.
+    """
+
+    def __init__(self, allocator: PageAllocator, max_cached_pages: int | None = None):
+        from collections import OrderedDict
+
+        self.allocator = allocator
+        self.page_size = allocator.cfg.page_size
+        self.max_cached_pages = max_cached_pages or max(allocator.num_pages // 2, 1)
+        # chain_hash -> page index; ordered for LRU.
+        self._entries: "OrderedDict[int, int]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _chain(prev: int, tokens: tuple[int, ...]) -> int:
+        return hash((prev, tokens))
+
+    def match(self, prompt: list[int]) -> tuple[list[int], int]:
+        """Longest cached page-aligned prefix: (shared pages incref'd,
+        matched token count). Always leaves ≥1 token to prefill so the
+        request samples from a real forward pass."""
+        ps = self.page_size
+        pages: list[int] = []
+        matched = 0
+        chain = 0
+        n_full = (len(prompt) - 1) // ps  # last token never comes from cache
+        for i in range(n_full):
+            chunk = tuple(prompt[i * ps:(i + 1) * ps])
+            chain = self._chain(chain, chunk)
+            page = self._entries.get(chain)
+            if page is None:
+                break
+            self._entries.move_to_end(chain)
+            pages.append(page)
+            matched += ps
+        for p in pages:
+            self.allocator.incref(p)
+        if pages:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages, matched
+
+    def insert(self, prompt: list[int], slot_pages: list[int]) -> None:
+        """Register the request's full prefix pages for reuse."""
+        ps = self.page_size
+        chain = 0
+        n_full = min(len(prompt) // ps, len(slot_pages))
+        for i in range(n_full):
+            chunk = tuple(prompt[i * ps:(i + 1) * ps])
+            chain = self._chain(chain, chunk)
+            if chain in self._entries:
+                self._entries.move_to_end(chain)
+                continue
+            if len(self._entries) >= self.max_cached_pages:
+                self._evict_one()
+                if len(self._entries) >= self.max_cached_pages:
+                    return
+            page = slot_pages[i]
+            self.allocator.incref(page)  # cache's own hold
+            self._entries[chain] = page
+
+    def _evict_one(self) -> None:
+        if not self._entries:
+            return
+        _, page = self._entries.popitem(last=False)
+        self.allocator.decref(page)
+
+    def evict_for_pressure(self, min_free: int) -> None:
+        while self.allocator.free_page_count() < min_free and self._entries:
+            self._evict_one()
+
+    def stats(self) -> dict:
+        return {"cached_pages": len(self._entries), "hits": self.hits, "misses": self.misses}
 
 
 def init_paged_cache(model_cfg: LlamaConfig, cache_cfg: PagedCacheConfig, dtype=jnp.bfloat16):

@@ -61,6 +61,13 @@ class Scheduler:
         self._ids = itertools.count()
         self._thread: threading.Thread | None = None
         self.queue_depth = 0  # exported metric
+        # Liveness: wall-clock of the last completed engine step. The
+        # sidecar /health endpoint flags "degraded" when requests are
+        # active but no step has completed recently (wedged device).
+        self.last_step_time = time.monotonic()
+
+    def active_requests(self) -> int:
+        return len(self._slots)
 
     # -- public API ----------------------------------------------------
     def submit(self, req: GenRequest) -> str:
@@ -161,6 +168,7 @@ class Scheduler:
                 n = 1
         toks, logprobs = self.engine.decode_chunk(tokens, positions, active, temps, top_ps, n_steps=n,
                                                   seeds=seeds, use_seed=use_seed)
+        self.last_step_time = time.monotonic()
 
         for slot in list(self._slots):
             st = self._slots[slot]

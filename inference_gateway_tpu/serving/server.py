@@ -143,7 +143,23 @@ class SidecarServer:
                 self.logger.warn("metrics push failed", "error", str(e))
 
     # -- handlers ------------------------------------------------------
+    HEALTH_STALL_SECONDS = 60.0
+
     async def health(self, req: Request) -> Response:
+        """Liveness + device-stall detection: active requests with no
+        completed engine step for HEALTH_STALL_SECONDS means the
+        accelerator (or its tunnel) is wedged — report degraded with 503
+        so orchestrators can recycle the replica."""
+        stalled = (
+            self.scheduler.active_requests() > 0
+            and time.monotonic() - self.scheduler.last_step_time > self.HEALTH_STALL_SECONDS
+        )
+        if stalled:
+            return Response.json({
+                "status": "degraded",
+                "reason": "no engine step completed recently with active requests",
+                "seconds_since_last_step": round(time.monotonic() - self.scheduler.last_step_time, 1),
+            }, status=503)
         return Response.json({"status": "ok"})
 
     async def list_models(self, req: Request) -> Response:

@@ -78,8 +78,9 @@ def test_paged_engine_matches_dense():
     finally:
         sched_d.stop()
         sched_p.stop()
-    # All pages returned after requests finished (warmup + runs).
-    assert paged.allocator.free_page_count() == paged.allocator.num_pages
+    # All pages accounted for: free + prefix-cache holds == pool.
+    held = paged.prefix_cache.stats()["cached_pages"] if paged.prefix_cache else 0
+    assert paged.allocator.free_page_count() + held == paged.allocator.num_pages
 
 
 def test_paged_engine_concurrent_reuse():
@@ -107,4 +108,5 @@ def test_paged_engine_concurrent_reuse():
         assert all(r is not None and len(r) > 0 for r in results)
     finally:
         sched.stop()
-    assert engine.allocator.free_page_count() == engine.allocator.num_pages
+    held = engine.prefix_cache.stats()["cached_pages"] if engine.prefix_cache else 0
+    assert engine.allocator.free_page_count() + held == engine.allocator.num_pages
