@@ -24,7 +24,11 @@ def _to_np(t: Any) -> np.ndarray:
 
 
 def llama_config_from_hf(hf_cfg: Any) -> LlamaConfig:
+    # Qwen2 is the Llama skeleton + QKV biases (always-on in HF's Qwen2).
+    qkv_bias = bool(getattr(hf_cfg, "attention_bias", False)) or \
+        getattr(hf_cfg, "model_type", "") == "qwen2"
     return LlamaConfig(
+        qkv_bias=qkv_bias,
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,
         num_layers=hf_cfg.num_hidden_layers,
@@ -207,6 +211,10 @@ def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig, dtype=
         },
         "final_norm": jnp.asarray(get("norm.weight"), dtype),
     }
+    if cfg.qkv_bias:
+        params["layers"]["bq"] = stack("layers.{}.self_attn.q_proj.bias", transpose=False)
+        params["layers"]["bk"] = stack("layers.{}.self_attn.k_proj.bias", transpose=False)
+        params["layers"]["bv"] = stack("layers.{}.self_attn.v_proj.bias", transpose=False)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = jnp.asarray(_to_np(sd["lm_head.weight"]).T, dtype)
     return params

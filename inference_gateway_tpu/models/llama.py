@@ -43,6 +43,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
+    qkv_bias: bool = False  # Qwen2-style attention projections
     # Stored as a hashable tuple of (key, value) pairs so the config can be
     # a jit static argument; accepts a dict at construction.
     rope_scaling: Any = None
@@ -87,6 +88,10 @@ def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
         },
         "final_norm": jnp.ones((H,), dtype),
     }
+    if cfg.qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((L, Hq * D), dtype)
+        params["layers"]["bk"] = jnp.zeros((L, Hkv * D), dtype)
+        params["layers"]["bv"] = jnp.zeros((L, Hkv * D), dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm(jax.random.fold_in(rng, 99), (H, V))
     return params
@@ -115,9 +120,16 @@ def _layer(
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = qmatmul(h, lp["wq"]).reshape(B, T, Hq, D)
-    k = qmatmul(h, lp["wk"]).reshape(B, T, Hkv, D)
-    v = qmatmul(h, lp["wv"]).reshape(B, T, Hkv, D)
+    q = qmatmul(h, lp["wq"])
+    k = qmatmul(h, lp["wk"])
+    v = qmatmul(h, lp["wv"])
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, T, Hq, D)
+    k = k.reshape(B, T, Hkv, D)
+    v = v.reshape(B, T, Hkv, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -291,9 +303,16 @@ def forward_paged(
     def body(x, per_layer):
         lp, kc, vc = per_layer
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = qmatmul(h, lp["wq"]).reshape(B, T, Hq, D)
-        k = qmatmul(h, lp["wk"]).reshape(B, T, Hkv, D)
-        v = qmatmul(h, lp["wv"]).reshape(B, T, Hkv, D)
+        q = qmatmul(h, lp["wq"])
+        k = qmatmul(h, lp["wk"])
+        v = qmatmul(h, lp["wv"])
+        if cfg.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, T, Hq, D)
+        k = k.reshape(B, T, Hkv, D)
+        v = v.reshape(B, T, Hkv, D)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
@@ -368,6 +387,21 @@ PRESETS: dict[str, LlamaConfig] = {
             "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
             "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
         },
+    ),
+    "qwen2-test-tiny": LlamaConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        intermediate_size=128, max_position_embeddings=512, qkv_bias=True,
+        tie_word_embeddings=True,
+    ),
+    "qwen2.5-7b": LlamaConfig(
+        vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28, num_kv_heads=4,
+        intermediate_size=18944, rope_theta=1000000.0, max_position_embeddings=32768,
+        qkv_bias=True,
+    ),
+    "qwen2.5-0.5b": LlamaConfig(
+        vocab_size=151936, hidden_size=896, num_layers=24, num_heads=14, num_kv_heads=2,
+        intermediate_size=4864, rope_theta=1000000.0, max_position_embeddings=32768,
+        qkv_bias=True, tie_word_embeddings=True,
     ),
     "llama-3-70b": LlamaConfig(
         vocab_size=128256, hidden_size=8192, num_layers=80, num_heads=64, num_kv_heads=8,

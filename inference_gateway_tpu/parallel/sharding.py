@@ -35,6 +35,10 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
         },
         "final_norm": P(None),
     }
+    if cfg.qkv_bias:
+        specs["layers"]["bq"] = P(None, "tp")
+        specs["layers"]["bk"] = P(None, "tp")
+        specs["layers"]["bv"] = P(None, "tp")
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
