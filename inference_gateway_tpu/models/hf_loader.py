@@ -24,11 +24,18 @@ def _to_np(t: Any) -> np.ndarray:
 
 
 def llama_config_from_hf(hf_cfg: Any) -> LlamaConfig:
-    # Qwen2 is the Llama skeleton + QKV biases (always-on in HF's Qwen2).
-    qkv_bias = bool(getattr(hf_cfg, "attention_bias", False)) or \
-        getattr(hf_cfg, "model_type", "") == "qwen2"
+    # Qwen2 is the Llama skeleton + QKV biases (always-on in HF's Qwen2);
+    # Gemma adds GeGLU, (1+w) norms, and sqrt(H) embedding scaling.
+    model_type = getattr(hf_cfg, "model_type", "")
+    qkv_bias = bool(getattr(hf_cfg, "attention_bias", False)) or model_type == "qwen2"
+    is_gemma = model_type == "gemma"
+    act = getattr(hf_cfg, "hidden_act", None) or getattr(hf_cfg, "hidden_activation", None)
+    hidden_act = "gelu_tanh" if (is_gemma or act in ("gelu_pytorch_tanh", "gelu_new")) else "silu"
     return LlamaConfig(
         qkv_bias=qkv_bias,
+        hidden_act=hidden_act,
+        norm_offset=is_gemma,
+        embed_scale=is_gemma,
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,
         num_layers=hf_cfg.num_hidden_layers,

@@ -44,6 +44,9 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style attention projections
+    hidden_act: str = "silu"  # "silu" (Llama/Qwen) | "gelu_tanh" (Gemma)
+    norm_offset: bool = False  # Gemma-style RMSNorm weight = (1 + w)
+    embed_scale: bool = False  # Gemma scales embeddings by sqrt(hidden)
     # Stored as a hashable tuple of (key, value) pairs so the config can be
     # a jit static argument; accepts a dict at construction.
     rope_scaling: Any = None
@@ -62,6 +65,16 @@ class LlamaConfig:
 
 
 Params = dict[str, Any]
+
+_ACT = {
+    "silu": jax.nn.silu,
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+def _nw(w, cfg: "LlamaConfig"):
+    """Norm weight convention: Gemma stores (w - 1)."""
+    return w + 1 if cfg.norm_offset else w
 
 
 def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.bfloat16) -> Params:
@@ -119,7 +132,7 @@ def _layer(
     B, T, H = x.shape
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
 
-    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    h = rms_norm(x, _nw(lp["attn_norm"], cfg), cfg.rms_norm_eps)
     q = qmatmul(h, lp["wq"])
     k = qmatmul(h, lp["wk"])
     v = qmatmul(h, lp["wv"])
@@ -148,8 +161,9 @@ def _layer(
         attn = gqa_attend(q, k, v, mask)
     x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
 
-    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    x = x + qmatmul(jax.nn.silu(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
+    h = rms_norm(x, _nw(lp["mlp_norm"], cfg), cfg.rms_norm_eps)
+    act = _ACT[cfg.hidden_act]
+    x = x + qmatmul(act(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
     return x, new_k_cache, new_v_cache
 
 
@@ -182,6 +196,8 @@ def forward(
     """
     B, T = tokens.shape
     x = params["embed"][tokens] if embeds is None else embeds.astype(params["embed"].dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
@@ -228,7 +244,7 @@ def forward(
         x, _ = jax.lax.scan(body, x, params["layers"])
         new_cache = None
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, _nw(params["final_norm"], cfg), cfg.rms_norm_eps)
     if last_only:
         if mode == "decode":
             idx = jnp.zeros_like(lengths)
@@ -287,6 +303,8 @@ def forward_paged(
     flat = P * page_size
 
     x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
@@ -302,7 +320,7 @@ def forward_paged(
 
     def body(x, per_layer):
         lp, kc, vc = per_layer
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, _nw(lp["attn_norm"], cfg), cfg.rms_norm_eps)
         q = qmatmul(h, lp["wq"])
         k = qmatmul(h, lp["wk"])
         v = qmatmul(h, lp["wv"])
@@ -338,14 +356,15 @@ def forward_paged(
             attn = gqa_attend(q, k, v, mask)
         x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
 
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + qmatmul(jax.nn.silu(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
+        h = rms_norm(x, _nw(lp["mlp_norm"], cfg), cfg.rms_norm_eps)
+        act = _ACT[cfg.hidden_act]
+        x = x + qmatmul(act(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
         return x, (new_kc, new_vc)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     new_cache = {"k": new_k, "v": new_v}
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, _nw(params["final_norm"], cfg), cfg.rms_norm_eps)
     if last_only:
         if mode == "decode":
             idx = jnp.zeros_like(lengths)
@@ -387,6 +406,24 @@ PRESETS: dict[str, LlamaConfig] = {
             "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
             "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
         },
+    ),
+    "gemma-test-tiny": LlamaConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=1,
+        intermediate_size=128, head_dim=16, max_position_embeddings=512,
+        tie_word_embeddings=True, hidden_act="gelu_tanh", norm_offset=True,
+        embed_scale=True, rms_norm_eps=1e-6,
+    ),
+    "gemma-2b": LlamaConfig(
+        vocab_size=256000, hidden_size=2048, num_layers=18, num_heads=8, num_kv_heads=1,
+        intermediate_size=16384, head_dim=256, max_position_embeddings=8192,
+        tie_word_embeddings=True, hidden_act="gelu_tanh", norm_offset=True,
+        embed_scale=True, rms_norm_eps=1e-6,
+    ),
+    "gemma-7b": LlamaConfig(
+        vocab_size=256000, hidden_size=3072, num_layers=28, num_heads=16, num_kv_heads=16,
+        intermediate_size=24576, head_dim=256, max_position_embeddings=8192,
+        tie_word_embeddings=True, hidden_act="gelu_tanh", norm_offset=True,
+        embed_scale=True, rms_norm_eps=1e-6,
     ),
     "qwen2-test-tiny": LlamaConfig(
         vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
