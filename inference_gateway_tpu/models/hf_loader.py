@@ -36,6 +36,7 @@ def llama_config_from_hf(hf_cfg: Any) -> LlamaConfig:
         hidden_act=hidden_act,
         norm_offset=is_gemma,
         embed_scale=is_gemma,
+        sliding_window=getattr(hf_cfg, "sliding_window", None),
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,
         num_layers=hf_cfg.num_hidden_layers,

@@ -45,6 +45,7 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style attention projections
     hidden_act: str = "silu"  # "silu" (Llama/Qwen) | "gelu_tanh" (Gemma)
+    sliding_window: int | None = None  # Mistral-style windowed attention
     norm_offset: bool = False  # Gemma-style RMSNorm weight = (1 + w)
     embed_scale: bool = False  # Gemma scales embeddings by sqrt(hidden)
     # Stored as a hashable tuple of (key, value) pairs so the config can be
@@ -205,6 +206,9 @@ def forward(
         assert cache is not None
         S = cache["k"].shape[2]
         mask = decode_mask(S, lengths)
+        if cfg.sliding_window:
+            span = jnp.arange(S)
+            mask = mask & (span[None, None, :] > lengths[:, None, None] - 1 - cfg.sliding_window)
         scatter_pos = positions
     elif mode == "prefill_chunk":
         assert cache is not None
@@ -215,11 +219,16 @@ def forward(
         mask = (span[None, None, :] <= positions[:, :, None]) & (
             span[None, None, :] < lengths[:, None, None]
         )
+        if cfg.sliding_window:
+            mask = mask & (span[None, None, :] > positions[:, :, None] - cfg.sliding_window)
         valid = positions < lengths[:, None]
         scatter_pos = jnp.where(valid, positions, S)
     else:
         valid = jnp.arange(T)[None, :] < lengths[:, None]
         mask = causal_prefill_mask(positions, lengths)
+        if cfg.sliding_window:
+            key_pos = positions
+            mask = mask & (key_pos[:, None, :] > positions[:, :, None] - cfg.sliding_window)
         if cache is not None:
             S = cache["k"].shape[2]
             scatter_pos = jnp.where(valid, positions, S)  # S = out of bounds -> drop
@@ -406,6 +415,10 @@ PRESETS: dict[str, LlamaConfig] = {
             "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
             "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
         },
+    ),
+    "mistral-7b": LlamaConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,
+        intermediate_size=14336, max_position_embeddings=32768, sliding_window=4096,
     ),
     "gemma-test-tiny": LlamaConfig(
         vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=1,
