@@ -20,7 +20,6 @@ from inference_gateway_tpu.config import Config
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio.client import HTTPClient, HTTPClientError
 from inference_gateway_tpu.netio.server import (
-    Handler,
     Headers,
     Request,
     Response,

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, AsyncIterator, Awaitable, Callable
+from typing import Any, Awaitable, Callable
 
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.mcp.client import MCPClient, MCPError

@@ -17,7 +17,7 @@ reference providers/registry/registry.go:143-208).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 from typing import Any
 

@@ -14,8 +14,6 @@ to dense causal attention over the gathered sequence.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh

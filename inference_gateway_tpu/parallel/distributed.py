@@ -21,7 +21,7 @@ import os
 
 import jax
 
-from inference_gateway_tpu.parallel.mesh import AXES, MOE_AXES, create_mesh, create_moe_mesh
+from inference_gateway_tpu.parallel.mesh import create_mesh, create_moe_mesh
 
 
 def initialize_distributed(

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -30,7 +30,6 @@ from inference_gateway_tpu.ops.sampling import compute_logprobs, per_row_keys, s
 from inference_gateway_tpu.parallel.mesh import create_mesh, default_mesh_shape
 from inference_gateway_tpu.parallel.sharding import (
     check_divisibility,
-    llama_cache_specs,
     llama_param_specs,
     named,
     shard_params,
