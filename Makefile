@@ -1,0 +1,24 @@
+# Developer entry points (reference parity: Taskfile.yml).
+
+.PHONY: generate check test bench bench-gateway serve gateway lint
+
+generate:  ## regenerate docs/env examples from openapi.yaml + drift check
+	python -m inference_gateway_tpu.codegen
+
+check:     ## spec<->code drift guards only
+	python -m inference_gateway_tpu.codegen -type Check
+
+test:      ## full suite on a virtual 8-device CPU mesh
+	python -m pytest tests/ -q
+
+bench:     ## TPU serving decode throughput (driver-tracked JSON line)
+	python bench.py
+
+bench-gateway:  ## CPU gateway micro-benchmarks
+	python benchmarks/gateway_bench.py
+
+serve:     ## run the TPU sidecar (random weights unless --checkpoint/model path)
+	python -m inference_gateway_tpu.serving --model tinyllama-1.1b --port 8000
+
+gateway:   ## run the gateway
+	python -m inference_gateway_tpu.main
