@@ -206,10 +206,16 @@ def paged_attention_tpu(
     )(page_table.astype(jnp.int32), lengths.reshape(B, 1).astype(jnp.int32), q, k_pages, v_pages)
 
 
-def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int) -> jnp.ndarray:
-    """Dispatch: Pallas kernel on TPU (when the folded head axis is
-    lane-aligned), JAX reference elsewhere."""
-    platform = jax.devices()[0].platform
-    if platform in ("tpu", "axon") and k_pages.shape[-1] % 128 == 0:
+def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int,
+                    use_kernel: bool | None = None) -> jnp.ndarray:
+    """Dispatch: Pallas kernel on single-device TPU (when the folded head
+    axis is lane-aligned), XLA gather path elsewhere. The gather path is
+    head-local math, so under a mesh GSPMD partitions it across ``tp``
+    (kv-head shards) with no collectives; the kernel requires shard_map
+    and stays single-device for now."""
+    if use_kernel is None:
+        platform = jax.devices()[0].platform
+        use_kernel = platform in ("tpu", "axon") and len(jax.devices()) == 1
+    if use_kernel and k_pages.shape[-1] % 128 == 0:
         return paged_attention_tpu(q, k_pages, v_pages, page_table, lengths, num_kv_heads)
     return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads)

@@ -142,9 +142,9 @@ class Engine:
             params = jax.jit(quantize_llama_params)(params)
         self.params = params
 
-        # Paged attention is single-device + dense-model this round;
-        # tp-sharded and MoE paged decode land with shard_map integration.
-        self.paged = config.attention == "paged" and self.mesh is None and not self.is_moe
+        # Paged serving: the Pallas decode kernel runs single-device; under
+        # a mesh the GSPMD gather path shards pages on tp (kv-head axis).
+        self.paged = config.attention == "paged" and not self.is_moe
         self.allocator = None
         self.prefix_cache = None
         if self.paged:
@@ -159,9 +159,14 @@ class Engine:
                 max_slots=config.max_slots, max_seq_len=config.max_seq_len,
             )
             self.allocator = PageAllocator(self.page_cfg)
-            self.cache = init_paged_cache(self.model_cfg, self.page_cfg, dtype=self.dtype)
+            cache = init_paged_cache(self.model_cfg, self.page_cfg, dtype=self.dtype)
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                paged_specs = {"k": P(None, None, None, "tp"), "v": P(None, None, None, "tp")}
+                cache = jax.device_put(cache, named(self.mesh, paged_specs))
+            self.cache = cache
             self._flat_size = self.allocator.num_pages * config.page_size
-            self.prefix_cache = None
             if config.prefix_cache:
                 from inference_gateway_tpu.serving.kv_cache import PrefixCache
 
