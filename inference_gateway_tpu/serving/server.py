@@ -186,7 +186,13 @@ class SidecarServer:
     async def metrics(self, req: Request) -> Response:
         m = dict(self.engine.metrics)
         m["queue_depth"] = self.scheduler.queue_depth
+        m["active_requests"] = self.scheduler.active_requests()
         m["uptime_seconds"] = round(time.monotonic() - self._started, 3)
+        if self.engine.allocator is not None:
+            m["kv_pages_total"] = self.engine.allocator.num_pages
+            m["kv_pages_free"] = self.engine.allocator.free_page_count()
+        if self.engine.prefix_cache is not None:
+            m["prefix_cache"] = self.engine.prefix_cache.stats()
         return Response.json(m)
 
     # ------------------------------------------------------------------
