@@ -1,0 +1,48 @@
+"""netio unit tests: header multimap, router patterns, SSE helpers."""
+
+from inference_gateway_tpu.netio.server import Headers, Response, Router
+from inference_gateway_tpu.netio.sse import DONE_FRAME, format_event, parse_data_line, split_sse_payloads
+
+
+def test_headers_case_insensitive_multimap():
+    h = Headers()
+    h.add("X-Thing", "a")
+    h.add("x-thing", "b")
+    assert h.get("X-THING") == "a"
+    assert h.get_all("x-Thing") == ["a", "b"]
+    h.set("x-thing", "c")
+    assert h.get_all("X-Thing") == ["c"]
+    h.remove("X-THING")
+    assert "x-thing" not in h
+    assert h.get("missing", "dflt") == "dflt"
+
+
+def test_router_patterns():
+    async def h(req):
+        return Response.json({})
+
+    r = Router()
+    r.get("/v1/models", h)
+    r.add("POST", "/proxy/:provider/*path", h)
+
+    handler, params = r.resolve("GET", "/v1/models")
+    assert params == {}
+    handler, params = r.resolve("POST", "/proxy/tpu/models")
+    assert params == {"provider": "tpu", "path": "/models"}
+    handler, params = r.resolve("POST", "/proxy/openai/chat/completions")
+    assert params == {"provider": "openai", "path": "/chat/completions"}
+    # URL-encoded segment decodes.
+    handler, params = r.resolve("POST", "/proxy/ollama%5Fcloud/models")
+    assert params["provider"] == "ollama_cloud"
+    # Unknown path → not_found handler, no params.
+    handler, params = r.resolve("GET", "/nope")
+    assert params == {}
+
+
+def test_sse_helpers():
+    frame = format_event({"a": 1})
+    assert frame == b'data: {"a":1}\n\n'
+    assert parse_data_line(b"data: xyz\n") == b"xyz"
+    assert parse_data_line(b"event: foo") is None
+    body = frame + format_event("raw") + DONE_FRAME
+    assert list(split_sse_payloads(body)) == [b'{"a":1}', b"raw"]
