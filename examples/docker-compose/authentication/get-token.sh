@@ -1,0 +1,11 @@
+#!/bin/sh
+# Fetch an access token from the example Keycloak realm (password grant).
+set -e
+curl -s \
+  -d client_id=inference-gateway-client \
+  -d client_secret=inference-gateway-secret \
+  -d grant_type=password \
+  -d username=user \
+  -d password=password \
+  http://localhost:8081/realms/inference-gateway/protocol/openid-connect/token \
+  | python3 -c 'import json,sys; print(json.load(sys.stdin)["access_token"])'

@@ -1,0 +1,31 @@
+#!/bin/sh
+# Local k3d dev cluster for gateway development (reference parity:
+# hack/Taskfile.yml + hack/Cluster.yaml). No TPUs needed: the sidecar
+# falls back to the JAX CPU backend with the same serving stack.
+set -e
+
+CLUSTER=${CLUSTER:-inference-gateway-dev}
+
+case "${1:-up}" in
+  up)
+    k3d cluster create "$CLUSTER" --agents 1 -p "8080:80@loadbalancer" || true
+    docker build -t inference-gateway-tpu:latest -f Dockerfile .
+    docker build -t inference-gateway-tpu-sidecar:latest -f Dockerfile.sidecar .
+    k3d image import -c "$CLUSTER" inference-gateway-tpu:latest inference-gateway-tpu-sidecar:latest
+    kubectl apply -f examples/kubernetes/basic.yaml
+    kubectl set env deployment/tpu-sidecar JAX_PLATFORMS=cpu
+    # CPU dev: drop the TPU node selector/limits so the sidecar schedules.
+    kubectl patch deployment tpu-sidecar --type json -p '[
+      {"op": "remove", "path": "/spec/template/spec/nodeSelector"},
+      {"op": "remove", "path": "/spec/template/spec/containers/0/resources"}
+    ]'
+    echo "cluster $CLUSTER ready; gateway at http://localhost:8080"
+    ;;
+  down)
+    k3d cluster delete "$CLUSTER"
+    ;;
+  *)
+    echo "usage: $0 [up|down]" >&2
+    exit 1
+    ;;
+esac

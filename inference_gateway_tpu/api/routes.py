@@ -352,8 +352,8 @@ class RouterImpl:
         if not is_streaming or not content_type.startswith("text/event-stream"):
             if is_streaming:
                 chunks = b""
-                async for line in resp.iter_lines():
-                    chunks += line
+                async for block in resp.iter_raw():
+                    chunks += block
                 body_out = chunks
             else:
                 body_out = resp.body
@@ -362,8 +362,10 @@ class RouterImpl:
             return out
 
         async def relay():
-            async for line in resp.iter_lines():
-                yield line
+            # Block-level passthrough: SSE framing is preserved verbatim;
+            # the telemetry usage scan splits lines itself.
+            async for block in resp.iter_raw():
+                yield block
 
         return StreamingResponse.sse(relay())
 
@@ -406,13 +408,22 @@ class RouterImpl:
                 return error_json("Request body too large", 413)
         content_type = (req.headers.get("Content-Type") or "").split(";")[0].strip()
         if content_type == "application/x-protobuf":
-            # Binary OTLP is accepted but decoded by the protobuf sidecar
-            # codec; JSON is the gateway-native encoding.
-            return error_json("protobuf OTLP is not supported; send application/json", 415)
-        try:
-            payload = json.loads(body)
-        except ValueError:
-            return error_json("invalid OTLP JSON payload", 400)
+            # Binary OTLP — what OTel SDK exporters send by default
+            # (api/metrics.go:25-99 accepts both encodings).
+            from inference_gateway_tpu.otel.otlp_proto import (
+                ProtoDecodeError,
+                decode_export_metrics_request,
+            )
+
+            try:
+                payload = decode_export_metrics_request(body)
+            except ProtoDecodeError as e:
+                return error_json(f"invalid OTLP protobuf payload: {e}", 400)
+        else:
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                return error_json("invalid OTLP JSON payload", 400)
 
         source = req.headers.get("X-Source") or ""
         result = self.otel.ingest_metrics(payload, source)
@@ -477,15 +488,15 @@ class RouterImpl:
 
         if is_streaming and resp.status == 200:
             async def relay():
-                async for line in resp.iter_lines():
-                    yield line
+                async for block in resp.iter_raw():
+                    yield block
 
             return StreamingResponse.sse(relay())
 
         if is_streaming:
             body_out = b""
-            async for line in resp.iter_lines():
-                body_out += line
+            async for block in resp.iter_raw():
+                body_out += block
         else:
             body_out = resp.body
         if self.cfg.environment == "development":

@@ -78,9 +78,57 @@ def generate_env_example(spec: dict) -> str:
     return "\n".join(lines)
 
 
+def generate_constants_py(spec: dict) -> str:
+    """providers/constants_gen.py — the spec-derived provider table.
+
+    Parity with reference internal/codegen/codegen.go:222-659: constants
+    and registry tables are GENERATED from openapi.yaml, so adding a
+    provider is a spec-only change (`add to openapi.yaml + task generate
+    is sufficient`). constants.py and registry.py derive their tables
+    from this module; nothing provider-specific is hand-edited."""
+    lines = [
+        '"""GENERATED from openapi.yaml x-provider-configs — do not edit.',
+        "",
+        "Regenerate: ``python -m inference_gateway_tpu.codegen -type Code``.",
+        "Drift-gated by ``-type Check`` (reference codegen.go:222-659 +",
+        "CI dirty check).",
+        '"""',
+        "",
+        "PROVIDER_TABLE = {",
+    ]
+    for pid, cfg in spec["x-provider-configs"].items():
+        extra = {k: list(v) for k, v in (cfg.get("extra_headers") or {}).items()}
+        lines.append(f"    {pid!r}: {{")
+        lines.append(f"        \"name\": {cfg['name']!r},")
+        lines.append(f"        \"url\": {cfg['url']!r},")
+        lines.append(f"        \"auth_type\": {cfg['auth_type']!r},")
+        lines.append(f"        \"supports_vision\": {bool(cfg.get('supports_vision', False))!r},")
+        lines.append(f"        \"extra_headers\": {extra!r},")
+        lines.append(
+            f"        \"endpoints\": ({cfg['endpoints']['models']!r}, {cfg['endpoints']['chat']!r}),"
+        )
+        lines.append("    },")
+    lines.append("}")
+    lines.append("")
+    lines.append("# Provider ID constants.")
+    for pid in spec["x-provider-configs"]:
+        lines.append(f"{pid.upper()}_ID = {pid!r}")
+    lines.append("")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # Drift guards
 # ---------------------------------------------------------------------------
+def check_generated_code(spec: dict) -> list[str]:
+    """Delete-and-regenerate must reproduce generated modules byte-identically."""
+    problems = []
+    gen_path = REPO_ROOT / "inference_gateway_tpu" / "providers" / "constants_gen.py"
+    want = generate_constants_py(spec)
+    current = gen_path.read_text() if gen_path.exists() else ""
+    if current != want:
+        problems.append("providers/constants_gen.py drift — run codegen -type Code")
+    return problems
 def check_provider_registry(spec: dict) -> list[str]:
     """Registry/constants must match x-provider-configs exactly."""
     from inference_gateway_tpu.providers import constants
@@ -215,10 +263,14 @@ def check_config_defaults(spec: dict) -> list[str]:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="spec-driven generation + drift guards")
     parser.add_argument("-type", dest="gen_type", default="All",
-                        choices=["MD", "Env", "Check", "All"])
+                        choices=["MD", "Env", "Code", "Check", "All"])
     args = parser.parse_args(argv)
     spec = load_spec()
 
+    if args.gen_type in ("Code", "All"):
+        target = REPO_ROOT / "inference_gateway_tpu" / "providers" / "constants_gen.py"
+        target.write_text(generate_constants_py(spec))
+        print(f"wrote {target.relative_to(REPO_ROOT)}")
     if args.gen_type in ("MD", "All"):
         (REPO_ROOT / "Configurations.md").write_text(generate_configurations_md(spec))
         print("wrote Configurations.md")
@@ -228,7 +280,13 @@ def main(argv: list[str] | None = None) -> int:
         target.write_text(generate_env_example(spec))
         print(f"wrote {target.relative_to(REPO_ROOT)}")
     if args.gen_type in ("Check", "All"):
-        problems = check_provider_registry(spec) + check_config_defaults(spec)
+        problems = (check_generated_code(spec) + check_provider_registry(spec)
+                    + check_config_defaults(spec))
+        # Community tables are part of the same `task generate` contract.
+        from inference_gateway_tpu.codegen import pricinggen
+
+        if pricinggen.run("check") != 0:
+            problems.append("community tables drift — run codegen.pricinggen --write")
         if problems:
             print("DRIFT DETECTED:")
             for p in problems:

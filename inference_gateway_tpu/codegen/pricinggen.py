@@ -1,0 +1,132 @@
+"""Community metadata generator (pricinggen).
+
+Parity with reference internal/pricinggen/pricinggen.go:83-300: reads a
+vendored models.dev snapshot (per-MTok USD rates + token limits, the
+upstream dataset's own shape) and generates the two community tables the
+gateway serves from ``GET /v1/models?include=pricing,context_window``:
+
+- ``providers/data/community_pricing.json`` — per-token decimal-string
+  rates (per-MTok → per-token is an exact decimal shift, never float
+  division; reference pricinggen.go:280).
+- ``providers/data/community_context_windows.json`` — context/output
+  token limits.
+
+The tables are committed; ``--check`` regenerates and fails on drift
+(CI guard, same contract as the repo's other codegen checks). Refreshing
+the data = replacing the snapshot (zero-egress containers vendor it;
+online checkouts can sync it from the models.dev repo) and rerunning
+``--write``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from decimal import Decimal
+from pathlib import Path
+
+DATA_DIR = Path(__file__).resolve().parent.parent / "providers" / "data"
+SNAPSHOT = DATA_DIR / "models_dev_snapshot.json"
+PRICING_OUT = DATA_DIR / "community_pricing.json"
+CONTEXT_OUT = DATA_DIR / "community_context_windows.json"
+
+
+def per_mtok_to_per_token(rate) -> str | None:
+    """USD-per-million-tokens → per-token decimal string, exactly.
+
+    Zero/negative/absent mean "not published" → None (callers decide
+    whether zero is a published free tier; see generate_pricing)."""
+    if rate is None:
+        return None
+    d = Decimal(str(rate))
+    if d <= 0:
+        return None
+    out = format((d / Decimal(1_000_000)).normalize(), "f")
+    return out
+
+
+def load_snapshot(path: Path = SNAPSHOT) -> dict:
+    with open(path) as f:
+        return json.load(f)["models"]
+
+
+def generate_pricing(models: dict) -> dict:
+    """Pricing table keyed "<provider>/<model>".
+
+    Rate shape matches providers/pricing.py's enrichment dicts
+    ("prompt"/"completion" per-token strings). An explicit zero
+    input/output rate is a published free tier ("0"); zero cache rates
+    mean not-applicable and are omitted. Subscription-gated models carry
+    zero rates + subscription=true (reference pricinggen.go:231-247)."""
+    table = {}
+    for key, model in models.items():
+        cost = model.get("cost")
+        if cost is None:
+            continue
+        if model.get("subscription"):
+            table[key] = {"prompt": "0", "completion": "0",
+                          "source": "community", "subscription": True}
+            continue
+        prompt = "0" if cost.get("input") == 0 else per_mtok_to_per_token(cost.get("input"))
+        completion = "0" if cost.get("output") == 0 else per_mtok_to_per_token(cost.get("output"))
+        if prompt is None or completion is None:
+            continue
+        entry = {"prompt": prompt, "completion": completion, "source": "community"}
+        cr = per_mtok_to_per_token(cost.get("cache_read"))
+        cw = per_mtok_to_per_token(cost.get("cache_write"))
+        if cr:
+            entry["cache_read"] = cr
+        if cw:
+            entry["cache_write"] = cw
+        table[key] = entry
+    return table
+
+
+def generate_context_windows(models: dict) -> dict:
+    """Context-window table keyed "<provider>/<model>". Models without a
+    published context limit get no entry (reference pricinggen.go:107)."""
+    table = {}
+    for key, model in models.items():
+        limit = model.get("limit") or {}
+        context = limit.get("context", 0)
+        if context <= 0:
+            continue
+        entry = {"context": int(context)}
+        if limit.get("output"):
+            entry["output"] = int(limit["output"])
+        table[key] = entry
+    return table
+
+
+def _render(table: dict) -> str:
+    return json.dumps(dict(sorted(table.items())), indent=2) + "\n"
+
+
+def run(mode: str = "check") -> int:
+    models = load_snapshot()
+    outputs = {
+        PRICING_OUT: _render(generate_pricing(models)),
+        CONTEXT_OUT: _render(generate_context_windows(models)),
+    }
+    if not generate_pricing(models):
+        print("pricinggen: empty table — snapshot is not a models.dev dataset", file=sys.stderr)
+        return 1
+    rc = 0
+    for path, content in outputs.items():
+        if mode == "write":
+            path.write_text(content)
+            print(f"wrote {path.name}: {content.count(chr(10)) - 2} lines")
+        else:
+            current = path.read_text() if path.exists() else ""
+            if current != content:
+                print(f"DRIFT: {path.name} does not match the snapshot — "
+                      f"run `python -m inference_gateway_tpu.codegen.pricinggen --write`",
+                      file=sys.stderr)
+                rc = 1
+    if mode == "check" and rc == 0:
+        print("pricinggen: tables in sync")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(run("write" if "--write" in sys.argv else "check"))

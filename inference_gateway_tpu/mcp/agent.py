@@ -105,7 +105,7 @@ class Agent:
         try:
             for _ in range(MAX_AGENT_ITERATIONS):
                 body["messages"] = messages
-                stream = await provider.stream_chat_completions(body, ctx)
+                stream = await provider.stream_chat_completions(body, ctx, line_framing=True)
                 collected = bytearray()
                 saw_tool_finish = False
                 async for line in stream:

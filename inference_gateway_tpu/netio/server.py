@@ -349,6 +349,7 @@ class HTTPServer:
 
         if is_stream:
             try:
+                n = 0
                 async for chunk in resp.chunks:  # type: ignore[union-attr]
                     if not chunk:
                         continue
@@ -357,6 +358,13 @@ class HTTPServer:
                     # write_timeout window instead of one deadline for the
                     # whole response (shared.go:27-56).
                     await asyncio.wait_for(writer.drain(), timeout=self.write_timeout)
+                    # drain() below the high-water mark returns on the
+                    # fast path without yielding, so a burst-producing
+                    # stream would monopolize the loop and serialize
+                    # concurrent streams' TTFB — yield periodically.
+                    n += 1
+                    if n % 8 == 0:
+                        await asyncio.sleep(0)
             finally:
                 try:
                     writer.write(b"0\r\n\r\n")

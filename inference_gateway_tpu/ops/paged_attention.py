@@ -44,6 +44,7 @@ def paged_attention_jax(
     page_table: jnp.ndarray,  # (B, max_pages) int32
     lengths: jnp.ndarray,  # (B,) int32 — valid tokens (0 = inactive slot)
     num_kv_heads: int,
+    window: int | None = None,  # sliding window: attend last `window` tokens only
 ) -> jnp.ndarray:
     B, Hq, D = q.shape
     _, page_size, HkvD = k_pages.shape
@@ -59,6 +60,8 @@ def paged_attention_jax(
     scores = jnp.einsum("bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32)
     scores = scores * (D ** -0.5)
     valid = jnp.arange(S)[None, :] < lengths[:, None]  # (B, S)
+    if window is not None:
+        valid = valid & (jnp.arange(S)[None, :] >= lengths[:, None] - window)
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32)
@@ -87,6 +90,7 @@ def _paged_attn_kernel(
     num_kv_heads: int,
     groups: int,
     head_dim: int,
+    window: int | None = None,
 ):
     b = pl.program_id(0)
     length = length_ref[b, 0]
@@ -95,15 +99,25 @@ def _paged_attn_kernel(
     Hkv, G, D = num_kv_heads, groups, head_dim
     Hq = Hkv * G
 
+    # Sliding window: skip whole pages before the window start — decode
+    # bandwidth becomes O(window), not O(length) (Mistral semantics,
+    # dense counterpart models/llama.py forward decode mask).
+    if window is None:
+        w_start = jnp.int32(0)
+        p_start = jnp.int32(0)
+    else:
+        w_start = jnp.maximum(length - window, 0)
+        p_start = w_start // page_size
+
     def page_dma(slot, page_pos):
         page_idx = page_table_ref[b, page_pos]
         k_dma = pltpu.make_async_copy(k_pages_hbm.at[page_idx], k_buf.at[slot], sems.at[slot, 0])
         v_dma = pltpu.make_async_copy(v_pages_hbm.at[page_idx], v_buf.at[slot], sems.at[slot, 1])
         return k_dma, v_dma
 
-    @pl.when(n_pages > 0)
+    @pl.when(p_start < n_pages)
     def _():
-        for dma in page_dma(0, 0):
+        for dma in page_dma(jax.lax.rem(p_start, 2), p_start):
             dma.start()
 
     q = q_ref[0].astype(jnp.float32)  # (Hq, D)
@@ -126,6 +140,8 @@ def _paged_attn_kernel(
 
         token_pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
         valid = token_pos < length  # (1, page_size)
+        if window is not None:
+            valid = valid & (token_pos >= w_start)
 
         # Per-kv-head slices of the folded axis; static unroll over Hkv.
         score_rows = []
@@ -156,13 +172,13 @@ def _paged_attn_kernel(
     m0 = jnp.full((Hq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((Hq, 1), jnp.float32)
     acc0 = jnp.zeros((Hq, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(p_start, n_pages, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-20)
     out_ref[0] = out.astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("num_kv_heads", "interpret"))
+@functools.partial(jax.jit, static_argnames=("num_kv_heads", "interpret", "window"))
 def paged_attention_tpu(
     q: jnp.ndarray,  # (B, Hq, D)
     k_pages: jnp.ndarray,  # (P, page_size, Hkv*D)
@@ -171,6 +187,7 @@ def paged_attention_tpu(
     lengths: jnp.ndarray,  # (B,)
     num_kv_heads: int,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jnp.ndarray:
     B, Hq, D = q.shape
     P, page_size, HkvD = k_pages.shape
@@ -182,6 +199,7 @@ def paged_attention_tpu(
         num_kv_heads=num_kv_heads,
         groups=G,
         head_dim=D,
+        window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -206,16 +224,74 @@ def paged_attention_tpu(
     )(page_table.astype(jnp.int32), lengths.reshape(B, 1).astype(jnp.int32), q, k_pages, v_pages)
 
 
+def paged_attention_sharded(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int,
+                            mesh, window: int | None = None,
+                            interpret: bool | None = None) -> jnp.ndarray:
+    """Pallas kernel under a tp mesh via shard_map (round-1 verdict next
+    #5). Attention is kv-head-local: each tp shard holds Hq/tp query
+    heads and the matching Hkv/tp slice of the folded page axis, so the
+    kernel runs per-shard with NO collectives — identical comms profile
+    to the GSPMD gather path, but with the kernel's O(live tokens) DMA.
+    Page table and lengths are replicated host metadata."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    hkv_local = num_kv_heads // tp
+    if interpret is None:
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+
+    def local(q_l, k_l, v_l, pt_l, len_l):
+        return paged_attention_tpu(q_l, k_l, v_l, pt_l, len_l, hkv_local,
+                                   interpret=interpret, window=window)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, "tp", None), P(None, None, "tp"), P(None, None, "tp"),
+                  P(None, None), P(None)),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q, k_pages, v_pages, page_table, lengths)
+
+
 def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int,
-                    use_kernel: bool | None = None) -> jnp.ndarray:
+                    use_kernel: bool | None = None, window: int | None = None,
+                    mesh=None) -> jnp.ndarray:
     """Dispatch: Pallas kernel on single-device TPU (when the folded head
-    axis is lane-aligned), XLA gather path elsewhere. The gather path is
-    head-local math, so under a mesh GSPMD partitions it across ``tp``
-    (kv-head shards) with no collectives; the kernel requires shard_map
-    and stays single-device for now."""
-    if use_kernel is None:
-        platform = jax.devices()[0].platform
-        use_kernel = platform in ("tpu", "axon") and len(jax.devices()) == 1
-    if use_kernel and k_pages.shape[-1] % 128 == 0:
-        return paged_attention_tpu(q, k_pages, v_pages, page_table, lengths, num_kv_heads)
-    return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads)
+    axis is lane-aligned) or shard_mapped over ``tp`` under a mesh; XLA
+    gather path elsewhere. The gather path is head-local math, so under a
+    mesh GSPMD partitions it across ``tp`` (kv-head shards) with no
+    collectives. ``IG_TPU_PAGED_KERNEL=1/0`` forces the kernel choice
+    (tests exercise the shard_map path on a CPU mesh in interpret mode)."""
+    import os
+
+    force = os.environ.get("IG_TPU_PAGED_KERNEL")
+    platform = jax.devices()[0].platform
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        tp = mesh.shape["tp"]
+        shardable = (
+            num_kv_heads % tp == 0
+            and q.shape[1] % tp == 0
+            and (k_pages.shape[-1] // tp) % 128 == 0
+        )
+        if force is not None:
+            use_kernel = force == "1" and num_kv_heads % tp == 0 and q.shape[1] % tp == 0
+        elif use_kernel is None:
+            use_kernel = platform in ("tpu", "axon") and shardable
+        if use_kernel:
+            return paged_attention_sharded(q, k_pages, v_pages, page_table, lengths,
+                                           num_kv_heads, mesh, window=window)
+        return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads,
+                                   window=window)
+    if force is not None:
+        use_kernel = force == "1"
+        interpret = platform not in ("tpu", "axon")
+    else:
+        interpret = False
+        if use_kernel is None:
+            use_kernel = platform in ("tpu", "axon") and len(jax.devices()) == 1
+    if use_kernel and (force == "1" or k_pages.shape[-1] % 128 == 0):
+        return paged_attention_tpu(q, k_pages, v_pages, page_table, lengths, num_kv_heads,
+                                   window=window, interpret=interpret)
+    return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads,
+                               window=window)

@@ -58,6 +58,20 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+def qeinsum(eq: str, x: jnp.ndarray, w, out_dtype=None) -> jnp.ndarray:
+    """einsum(eq, x, w) for plain arrays or QTensors, fp32 accumulation.
+
+    The scale broadcast relies on per-output-channel scales keeping rank
+    ((..., 1, out) vs weight (..., in, out)), which every einsum used by
+    the MoE expert blocks preserves (contraction on the -2 axis)."""
+    if isinstance(w, QTensor):
+        y = jnp.einsum(eq, x, w.q.astype(x.dtype), preferred_element_type=jnp.float32)
+        y = y * w.scale
+    else:
+        y = jnp.einsum(eq, x, w, preferred_element_type=jnp.float32)
+    return y.astype(out_dtype) if out_dtype is not None else y
+
+
 # Weight names quantized in the decoder pytrees (matmul weights only —
 # embeddings, norms, and routers stay full precision).
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")

@@ -1,0 +1,213 @@
+"""Minimal OTLP/metrics protobuf decoder.
+
+The reference push endpoint accepts BOTH OTLP encodings — protobuf is
+what real OTel SDK exporters send by default (reference
+api/metrics.go:25-99). This module is a zero-dependency protobuf
+wire-format reader covering exactly the ExportMetricsServiceRequest
+subset ``otel.OpenTelemetry.ingest_metrics`` consumes, decoding to the
+same camelCase dict shape as the JSON encoding so one ingest path serves
+both. Unknown fields/messages are skipped (forward-compatible, as proto
+requires); malformed wire data raises ``ProtoDecodeError`` → 400.
+
+Field numbers follow opentelemetry-proto metrics/v1/metrics.proto.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Iterator
+
+
+class ProtoDecodeError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Wire-format primitives
+# ---------------------------------------------------------------------------
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if i >= len(buf) or shift > 63:
+            raise ProtoDecodeError("truncated varint")
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result & (1 << 64) - 1, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, raw_value) triples.
+
+    wt0 → int; wt1 → 8 raw bytes; wt5 → 4 raw bytes; wt2 → bytes view.
+    """
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        field, wt = tag >> 3, tag & 0x7
+        if wt == 0:
+            val, i = _read_varint(buf, i)
+        elif wt == 1:
+            if i + 8 > n:
+                raise ProtoDecodeError("truncated fixed64")
+            val, i = buf[i:i + 8], i + 8
+        elif wt == 5:
+            if i + 4 > n:
+                raise ProtoDecodeError("truncated fixed32")
+            val, i = buf[i:i + 4], i + 4
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            if i + ln > n:
+                raise ProtoDecodeError("truncated length-delimited field")
+            val, i = buf[i:i + ln], i + ln
+        else:
+            raise ProtoDecodeError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _double(raw: bytes) -> float:
+    return struct.unpack("<d", raw)[0]
+
+
+def _fixed64(raw: bytes) -> int:
+    return struct.unpack("<Q", raw)[0]
+
+
+def _signed(v: int) -> int:
+    """Two's-complement int64 from a varint payload."""
+    return v - (1 << 64) if v & (1 << 63) else v
+
+
+def _packed(val: Any, wt: int, unpack) -> list:
+    """Packed (wt2) or unpacked (wt1) repeated fixed64/double values."""
+    if wt == 2:
+        if len(val) % 8:
+            raise ProtoDecodeError("packed fixed64 length not multiple of 8")
+        return [unpack(val[j:j + 8]) for j in range(0, len(val), 8)]
+    return [unpack(val)]
+
+
+# ---------------------------------------------------------------------------
+# OTLP message decoders (metrics/v1), camelCase dicts = OTLP JSON shape
+# ---------------------------------------------------------------------------
+def _any_value(buf: bytes) -> dict[str, Any]:
+    for field, wt, val in _fields(buf):
+        if field == 1 and wt == 2:
+            return {"stringValue": val.decode("utf-8", "replace")}
+        if field == 2 and wt == 0:
+            return {"boolValue": bool(val)}
+        if field == 3 and wt == 0:
+            return {"intValue": _signed(val)}
+        if field == 4 and wt == 1:
+            return {"doubleValue": _double(val)}
+    return {}
+
+
+def _key_value(buf: bytes) -> dict[str, Any]:
+    out: dict[str, Any] = {"key": "", "value": {}}
+    for field, wt, val in _fields(buf):
+        if field == 1 and wt == 2:
+            out["key"] = val.decode("utf-8", "replace")
+        elif field == 2 and wt == 2:
+            out["value"] = _any_value(val)
+    return out
+
+
+def _number_data_point(buf: bytes) -> dict[str, Any]:
+    dp: dict[str, Any] = {"attributes": []}
+    for field, wt, val in _fields(buf):
+        if field == 7 and wt == 2:
+            dp["attributes"].append(_key_value(val))
+        elif field == 4 and wt == 1:
+            dp["asDouble"] = _double(val)
+        elif field == 6 and wt == 1:
+            dp["asInt"] = struct.unpack("<q", val)[0]
+        elif field == 3 and wt == 1:
+            dp["timeUnixNano"] = str(_fixed64(val))
+    return dp
+
+
+def _histogram_data_point(buf: bytes) -> dict[str, Any]:
+    dp: dict[str, Any] = {"attributes": [], "bucketCounts": [], "explicitBounds": []}
+    for field, wt, val in _fields(buf):
+        if field == 9 and wt == 2:
+            dp["attributes"].append(_key_value(val))
+        elif field == 4 and wt == 1:
+            dp["count"] = _fixed64(val)
+        elif field == 5 and wt == 1:
+            dp["sum"] = _double(val)
+        elif field == 6 and wt in (1, 2):
+            dp["bucketCounts"].extend(_packed(val, wt, _fixed64))
+        elif field == 7 and wt in (1, 2):
+            dp["explicitBounds"].extend(_packed(val, wt, _double))
+        elif field == 3 and wt == 1:
+            dp["timeUnixNano"] = str(_fixed64(val))
+    return dp
+
+
+def _points_body(buf: bytes, point_decoder) -> dict[str, Any]:
+    """Sum/Gauge/Histogram body: dataPoints=1, aggregationTemporality=2."""
+    body: dict[str, Any] = {"dataPoints": []}
+    for field, wt, val in _fields(buf):
+        if field == 1 and wt == 2:
+            body["dataPoints"].append(point_decoder(val))
+        elif field == 2 and wt == 0:
+            body["aggregationTemporality"] = val
+        elif field == 3 and wt == 0:
+            body["isMonotonic"] = bool(val)
+    return body
+
+
+def _metric(buf: bytes) -> dict[str, Any]:
+    m: dict[str, Any] = {"name": ""}
+    for field, wt, val in _fields(buf):
+        if field == 1 and wt == 2:
+            m["name"] = val.decode("utf-8", "replace")
+        elif field == 3 and wt == 2:
+            m["unit"] = val.decode("utf-8", "replace")
+        elif field == 5 and wt == 2:
+            m["gauge"] = _points_body(val, _number_data_point)
+        elif field == 7 and wt == 2:
+            m["sum"] = _points_body(val, _number_data_point)
+        elif field == 9 and wt == 2:
+            m["histogram"] = _points_body(val, _histogram_data_point)
+    return m
+
+
+def _scope_metrics(buf: bytes) -> dict[str, Any]:
+    sm: dict[str, Any] = {"metrics": []}
+    for field, wt, val in _fields(buf):
+        if field == 2 and wt == 2:
+            sm["metrics"].append(_metric(val))
+    return sm
+
+
+def _resource(buf: bytes) -> dict[str, Any]:
+    res: dict[str, Any] = {"attributes": []}
+    for field, wt, val in _fields(buf):
+        if field == 1 and wt == 2:
+            res["attributes"].append(_key_value(val))
+    return res
+
+
+def _resource_metrics(buf: bytes) -> dict[str, Any]:
+    rm: dict[str, Any] = {"scopeMetrics": []}
+    for field, wt, val in _fields(buf):
+        if field == 1 and wt == 2:
+            rm["resource"] = _resource(val)
+        elif field == 2 and wt == 2:
+            rm["scopeMetrics"].append(_scope_metrics(val))
+    return rm
+
+
+def decode_export_metrics_request(body: bytes) -> dict[str, Any]:
+    """ExportMetricsServiceRequest bytes → OTLP-JSON-shaped dict."""
+    payload: dict[str, Any] = {"resourceMetrics": []}
+    for field, wt, val in _fields(bytes(body)):
+        if field == 1 and wt == 2:
+            payload["resourceMetrics"].append(_resource_metrics(val))
+    return payload

@@ -44,6 +44,29 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
     return specs
 
 
+def quantized_specs(specs: dict) -> dict:
+    """Spec tree for an int8-quantized pytree (ops/quant.py): each
+    quantizable weight's P becomes a QTensor node of (q_spec, scale_spec)
+    — the scale keeps the weight's layout except the contraction (-2)
+    axis, which is size 1 and must stay unsharded."""
+    from inference_gateway_tpu.ops.quant import QUANTIZABLE, QTensor
+
+    def qspec(p: P) -> QTensor:
+        parts = tuple(p)
+        scale = parts[:-2] + (None,) + parts[-1:]
+        return QTensor(p, P(*scale))
+
+    out = dict(specs)
+    layers = dict(specs["layers"])
+    for name in QUANTIZABLE:
+        if name in layers:
+            layers[name] = qspec(layers[name])
+    out["layers"] = layers
+    if "lm_head" in out:
+        out["lm_head"] = qspec(out["lm_head"])
+    return out
+
+
 def llama_cache_specs() -> dict:
     """KV cache (L, B, S, Hkv, D): batch on dp, kv heads on tp."""
     return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}

@@ -4,91 +4,26 @@ Capability parity with reference providers/constants/constants.go:9-105,
 plus the new first-class ``tpu`` provider whose upstream is this repo's own
 JAX/XLA serving sidecar (serving/server.py) instead of a CUDA-backed
 runtime.
+
+Round-2: the per-provider tables are DERIVED from the spec-generated
+``constants_gen.PROVIDER_TABLE`` (reference codegen.go:222-659) — adding
+a provider is an openapi.yaml edit + ``codegen -type Code``, never a
+hand edit here or in registry.py.
 """
 
 from __future__ import annotations
+
+# Re-export the generated provider table and the `<ID>_ID` constants.
+from inference_gateway_tpu.providers.constants_gen import *  # noqa: F401,F403
+from inference_gateway_tpu.providers.constants_gen import PROVIDER_TABLE
 
 AUTH_TYPE_BEARER = "bearer"
 AUTH_TYPE_XHEADER = "xheader"
 AUTH_TYPE_QUERY = "query"
 AUTH_TYPE_NONE = "none"
 
-# Provider IDs. The reference's 15 providers (constants.go:70-86) plus tpu.
-ANTHROPIC_ID = "anthropic"
-CLOUDFLARE_ID = "cloudflare"
-COHERE_ID = "cohere"
-DEEPSEEK_ID = "deepseek"
-GOOGLE_ID = "google"
-GROQ_ID = "groq"
-LLAMACPP_ID = "llamacpp"
-MINIMAX_ID = "minimax"
-MISTRAL_ID = "mistral"
-MOONSHOT_ID = "moonshot"
-NVIDIA_ID = "nvidia"
-OLLAMA_ID = "ollama"
-OLLAMA_CLOUD_ID = "ollama_cloud"
-OPENAI_ID = "openai"
-ZAI_ID = "zai"
-TPU_ID = "tpu"
-
-# Default base URLs (constants.go:17-33). The tpu provider points at the
-# local serving sidecar by default.
-DEFAULT_BASE_URLS = {
-    ANTHROPIC_ID: "https://api.anthropic.com/v1",
-    CLOUDFLARE_ID: "https://api.cloudflare.com/client/v4/accounts/{ACCOUNT_ID}/ai",
-    COHERE_ID: "https://api.cohere.ai",
-    DEEPSEEK_ID: "https://api.deepseek.com",
-    GOOGLE_ID: "https://generativelanguage.googleapis.com/v1beta/openai",
-    GROQ_ID: "https://api.groq.com/openai/v1",
-    LLAMACPP_ID: "http://llamacpp:8080/v1",
-    MINIMAX_ID: "https://api.minimax.io/v1",
-    MISTRAL_ID: "https://api.mistral.ai/v1",
-    MOONSHOT_ID: "https://api.moonshot.ai/v1",
-    NVIDIA_ID: "https://integrate.api.nvidia.com/v1",
-    OLLAMA_ID: "http://ollama:8080/v1",
-    OLLAMA_CLOUD_ID: "https://ollama.com/v1",
-    OPENAI_ID: "https://api.openai.com/v1",
-    ZAI_ID: "https://api.z.ai/api/paas/v4",
-    TPU_ID: "http://localhost:8000/v1",
-}
-
-# Per-provider (models, chat) endpoints (constants.go:36-67).
-ENDPOINTS = {
-    ANTHROPIC_ID: ("/models", "/chat/completions"),
-    CLOUDFLARE_ID: ("/finetunes/public?limit=1000", "/v1/chat/completions"),
-    COHERE_ID: ("/v1/models", "/compatibility/v1/chat/completions"),
-    DEEPSEEK_ID: ("/models", "/chat/completions"),
-    GOOGLE_ID: ("/models", "/chat/completions"),
-    GROQ_ID: ("/models", "/chat/completions"),
-    LLAMACPP_ID: ("/models", "/chat/completions"),
-    MINIMAX_ID: ("/models", "/chat/completions"),
-    MISTRAL_ID: ("/models", "/chat/completions"),
-    MOONSHOT_ID: ("/models", "/chat/completions"),
-    NVIDIA_ID: ("/models", "/chat/completions"),
-    OLLAMA_ID: ("/models", "/chat/completions"),
-    OLLAMA_CLOUD_ID: ("/models", "/chat/completions"),
-    OPENAI_ID: ("/models", "/chat/completions"),
-    ZAI_ID: ("/models", "/chat/completions"),
-    TPU_ID: ("/models", "/chat/completions"),
-}
-
-DISPLAY_NAMES = {
-    ANTHROPIC_ID: "Anthropic",
-    CLOUDFLARE_ID: "Cloudflare",
-    COHERE_ID: "Cohere",
-    DEEPSEEK_ID: "Deepseek",
-    GOOGLE_ID: "Google",
-    GROQ_ID: "Groq",
-    LLAMACPP_ID: "Llamacpp",
-    MINIMAX_ID: "Minimax",
-    MISTRAL_ID: "Mistral",
-    MOONSHOT_ID: "Moonshot",
-    NVIDIA_ID: "Nvidia",
-    OLLAMA_ID: "Ollama",
-    OLLAMA_CLOUD_ID: "OllamaCloud",
-    OPENAI_ID: "Openai",
-    ZAI_ID: "Zai",
-    TPU_ID: "Tpu",
-}
-
-ALL_PROVIDER_IDS = tuple(DISPLAY_NAMES)
+# Derived tables (constants.go:17-105), one source of truth.
+ALL_PROVIDER_IDS = tuple(PROVIDER_TABLE)
+DEFAULT_BASE_URLS = {pid: t["url"] for pid, t in PROVIDER_TABLE.items()}
+ENDPOINTS = {pid: t["endpoints"] for pid, t in PROVIDER_TABLE.items()}
+DISPLAY_NAMES = {pid: t["name"] for pid, t in PROVIDER_TABLE.items()}

@@ -1,0 +1,155 @@
+"""GENERATED from openapi.yaml x-provider-configs — do not edit.
+
+Regenerate: ``python -m inference_gateway_tpu.codegen -type Code``.
+Drift-gated by ``-type Check`` (reference codegen.go:222-659 +
+CI dirty check).
+"""
+
+PROVIDER_TABLE = {
+    'anthropic': {
+        "name": 'Anthropic',
+        "url": 'https://api.anthropic.com/v1',
+        "auth_type": 'xheader',
+        "supports_vision": True,
+        "extra_headers": {'anthropic-version': ['2023-06-01']},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'cloudflare': {
+        "name": 'Cloudflare',
+        "url": 'https://api.cloudflare.com/client/v4/accounts/{ACCOUNT_ID}/ai',
+        "auth_type": 'bearer',
+        "supports_vision": False,
+        "extra_headers": {},
+        "endpoints": ('/finetunes/public?limit=1000', '/v1/chat/completions'),
+    },
+    'cohere': {
+        "name": 'Cohere',
+        "url": 'https://api.cohere.ai',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/v1/models', '/compatibility/v1/chat/completions'),
+    },
+    'deepseek': {
+        "name": 'Deepseek',
+        "url": 'https://api.deepseek.com',
+        "auth_type": 'bearer',
+        "supports_vision": False,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'google': {
+        "name": 'Google',
+        "url": 'https://generativelanguage.googleapis.com/v1beta/openai',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'groq': {
+        "name": 'Groq',
+        "url": 'https://api.groq.com/openai/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'llamacpp': {
+        "name": 'Llamacpp',
+        "url": 'http://llamacpp:8080/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'minimax': {
+        "name": 'Minimax',
+        "url": 'https://api.minimax.io/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'mistral': {
+        "name": 'Mistral',
+        "url": 'https://api.mistral.ai/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'moonshot': {
+        "name": 'Moonshot',
+        "url": 'https://api.moonshot.ai/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'nvidia': {
+        "name": 'Nvidia',
+        "url": 'https://integrate.api.nvidia.com/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'ollama': {
+        "name": 'Ollama',
+        "url": 'http://ollama:8080/v1',
+        "auth_type": 'none',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'ollama_cloud': {
+        "name": 'OllamaCloud',
+        "url": 'https://ollama.com/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'openai': {
+        "name": 'Openai',
+        "url": 'https://api.openai.com/v1',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'zai': {
+        "name": 'Zai',
+        "url": 'https://api.z.ai/api/paas/v4',
+        "auth_type": 'bearer',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+    'tpu': {
+        "name": 'Tpu',
+        "url": 'http://localhost:8000/v1',
+        "auth_type": 'none',
+        "supports_vision": True,
+        "extra_headers": {},
+        "endpoints": ('/models', '/chat/completions'),
+    },
+}
+
+# Provider ID constants.
+ANTHROPIC_ID = 'anthropic'
+CLOUDFLARE_ID = 'cloudflare'
+COHERE_ID = 'cohere'
+DEEPSEEK_ID = 'deepseek'
+GOOGLE_ID = 'google'
+GROQ_ID = 'groq'
+LLAMACPP_ID = 'llamacpp'
+MINIMAX_ID = 'minimax'
+MISTRAL_ID = 'mistral'
+MOONSHOT_ID = 'moonshot'
+NVIDIA_ID = 'nvidia'
+OLLAMA_ID = 'ollama'
+OLLAMA_CLOUD_ID = 'ollama_cloud'
+OPENAI_ID = 'openai'
+ZAI_ID = 'zai'
+TPU_ID = 'tpu'

@@ -12,13 +12,38 @@ for any of the published size keys (context_window.go:13).
 
 from __future__ import annotations
 
+import json
+from functools import lru_cache
+from pathlib import Path
 from typing import Any
 
 # Keys providers publish model context sizes under (context_window.go:13).
 PROVIDER_KEYS = ("context_window", "context_length", "max_context_length", "max_model_len")
 
-# Community tier: curated from public model documentation (stand-in for
-# the reference's models.dev-generated table, community_context_windows.json).
+_DATA = Path(__file__).resolve().parent / "data"
+
+
+@lru_cache(maxsize=1)
+def community_context_table() -> dict[str, dict[str, int]]:
+    """models.dev-generated table keyed "<provider>/<model>"
+    (codegen/pricinggen.py; reference community_context_windows.json)."""
+    try:
+        with open(_DATA / "community_context_windows.json") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+@lru_cache(maxsize=1)
+def _context_by_bare_name() -> dict[str, int]:
+    out: dict[str, int] = {}
+    for key, entry in community_context_table().items():
+        out.setdefault(key.split("/", 1)[-1].lower(), entry["context"])
+    return out
+
+
+# Extra curated entries for models the snapshot doesn't carry (local tpu
+# presets and legacy aliases).
 COMMUNITY_CONTEXT_WINDOWS: dict[str, int] = {
     "gpt-4o": 128000,
     "gpt-4o-mini": 128000,
@@ -104,12 +129,18 @@ def apply_provider_context_windows(raw: dict[str, Any] | None, models: list[dict
 
 
 def apply_community_context_windows(models: list[dict[str, Any]]) -> None:
-    """Community fallback tier (community_context_window.go:41). Mutates
-    in place; never overrides an already-present value."""
+    """Community fallback tier (community_context_window.go:41). Lookup
+    precedence: full "<provider>/<model>" key in the models.dev table,
+    then bare model name there, then the curated extras. Mutates in
+    place; never overrides an already-present value."""
+    table = community_context_table()
+    by_bare = _context_by_bare_name()
     for m in models:
         if m.get("context_window"):
             continue
-        name = _strip_provider(m.get("id", "")).lower()
-        size = COMMUNITY_CONTEXT_WINDOWS.get(name)
+        full = m.get("id", "").lower()
+        name = _strip_provider(full)
+        entry = table.get(full)
+        size = entry["context"] if entry else (by_bare.get(name) or COMMUNITY_CONTEXT_WINDOWS.get(name))
         if size:
             m["context_window"] = size

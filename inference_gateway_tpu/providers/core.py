@@ -131,9 +131,15 @@ class Provider:
         return resp.json()
 
     async def stream_chat_completions(
-        self, req: dict[str, Any], ctx: dict[str, Any] | None = None
+        self, req: dict[str, Any], ctx: dict[str, Any] | None = None,
+        line_framing: bool = False,
     ) -> AsyncIterator[bytes]:
-        """SSE line stream from the upstream, via a bounded relay queue."""
+        """SSE stream from the upstream, via a bounded relay queue.
+
+        Default framing is raw blocks (one upstream read = one queue item
+        = one downstream write — the relay fast path; SSE bytes pass
+        through verbatim). ``line_framing=True`` yields per line for
+        consumers that parse the stream (the MCP agent loop)."""
         url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.chat}"
         stream_req = self._prepare_streaming_request(req)
         body = json.dumps(stream_req).encode()
@@ -148,7 +154,8 @@ class Provider:
 
         async def reader():
             try:
-                async for line in resp.iter_lines():
+                it = resp.iter_lines() if line_framing else resp.iter_raw()
+                async for line in it:
                     await queue.put(line)
             except Exception as e:
                 self.logger.error("error reading stream", e, "provider", self.name)

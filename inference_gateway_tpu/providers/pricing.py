@@ -9,10 +9,40 @@ dollars per token, serialized as decimal strings to avoid float drift
 
 from __future__ import annotations
 
+import json
+from functools import lru_cache
+from pathlib import Path
 from typing import Any
 
-# Community tier (USD per token, decimal strings), curated from public
-# price sheets — stand-in for the reference's models.dev-generated table.
+_DATA = Path(__file__).resolve().parent / "data"
+
+
+@lru_cache(maxsize=1)
+def community_pricing_table() -> dict[str, dict[str, Any]]:
+    """models.dev-generated community table keyed "<provider>/<model>"
+    (codegen/pricinggen.py; reference community_pricing.json, 279+
+    models across 13 providers)."""
+    try:
+        with open(_DATA / "community_pricing.json") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+@lru_cache(maxsize=1)
+def _pricing_by_bare_name() -> dict[str, dict[str, Any]]:
+    """Secondary index by model name alone (providers that list models
+    without their gateway prefix). First writer wins on collisions —
+    table iteration is sorted, so the mapping is deterministic."""
+    out: dict[str, dict[str, Any]] = {}
+    for key, entry in community_pricing_table().items():
+        bare = key.split("/", 1)[-1].lower()
+        out.setdefault(bare, entry)
+    return out
+
+
+# Extra curated entries for models the snapshot doesn't carry (local tpu
+# presets and legacy aliases).
 COMMUNITY_PRICING: dict[str, dict[str, str]] = {
     "gpt-4o": {"prompt": "0.0000025", "completion": "0.00001"},
     "gpt-4o-mini": {"prompt": "0.00000015", "completion": "0.0000006"},
@@ -89,11 +119,16 @@ def apply_provider_pricing(raw: dict[str, Any] | None, models: list[dict[str, An
 
 
 def apply_community_pricing(models: list[dict[str, Any]]) -> None:
-    """Community fallback tier (community_pricing.go). Mutates in place."""
+    """Community fallback tier (community_pricing.go). Lookup precedence:
+    full "<provider>/<model>" key in the models.dev table, then bare
+    model name there, then the curated extras. Mutates in place."""
+    table = community_pricing_table()
+    by_bare = _pricing_by_bare_name()
     for m in models:
         if m.get("pricing"):
             continue
-        name = _strip_provider(m.get("id", "")).lower()
-        p = COMMUNITY_PRICING.get(name)
+        full = m.get("id", "").lower()
+        name = _strip_provider(full)
+        p = table.get(full) or by_bare.get(name) or COMMUNITY_PRICING.get(name)
         if p:
             m["pricing"] = dict(p)

@@ -45,46 +45,23 @@ class ProviderConfig:
         )
 
 
-def _cfg(pid: str, auth_type: str, vision: bool, extra: dict[str, list[str]] | None = None) -> ProviderConfig:
-    models, chat = constants.ENDPOINTS[pid]
-    return ProviderConfig(
-        id=pid,
-        name=constants.DISPLAY_NAMES[pid],
-        url=constants.DEFAULT_BASE_URLS[pid],
-        auth_type=auth_type,
-        supports_vision=vision,
-        extra_headers=extra or {},
-        endpoints=Endpoints(models, chat),
-    )
-
-
-# Static registry (reference registry.go:73-242). Auth types and vision
-# flags match the reference table; `tpu` is new.
+# Static registry (reference registry.go:73-242), built from the
+# spec-generated provider table (constants_gen.py) — adding a provider is
+# an openapi.yaml edit + `codegen -type Code`, never an edit here. The
+# `tpu` entry is new vs the reference: a local-runtime provider whose
+# upstream is the in-repo JAX serving sidecar, with a runtime metadata
+# endpoint like llama.cpp's /props (SURVEY.md §7).
 REGISTRY: dict[str, ProviderConfig] = {
-    constants.ANTHROPIC_ID: _cfg(
-        constants.ANTHROPIC_ID,
-        constants.AUTH_TYPE_XHEADER,
-        True,
-        {"anthropic-version": ["2023-06-01"]},
-    ),
-    constants.CLOUDFLARE_ID: _cfg(constants.CLOUDFLARE_ID, constants.AUTH_TYPE_BEARER, False),
-    constants.COHERE_ID: _cfg(constants.COHERE_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.DEEPSEEK_ID: _cfg(constants.DEEPSEEK_ID, constants.AUTH_TYPE_BEARER, False),
-    constants.GOOGLE_ID: _cfg(constants.GOOGLE_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.GROQ_ID: _cfg(constants.GROQ_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.LLAMACPP_ID: _cfg(constants.LLAMACPP_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.MINIMAX_ID: _cfg(constants.MINIMAX_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.MISTRAL_ID: _cfg(constants.MISTRAL_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.MOONSHOT_ID: _cfg(constants.MOONSHOT_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.NVIDIA_ID: _cfg(constants.NVIDIA_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.OLLAMA_ID: _cfg(constants.OLLAMA_ID, constants.AUTH_TYPE_NONE, True),
-    constants.OLLAMA_CLOUD_ID: _cfg(constants.OLLAMA_CLOUD_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.OPENAI_ID: _cfg(constants.OPENAI_ID, constants.AUTH_TYPE_BEARER, True),
-    constants.ZAI_ID: _cfg(constants.ZAI_ID, constants.AUTH_TYPE_BEARER, True),
-    # New: the TPU serving sidecar. Local runtime, no auth, vision-capable
-    # (the sidecar gates per-model), runtime metadata endpoint like
-    # llama.cpp's /props (SURVEY.md §7).
-    constants.TPU_ID: _cfg(constants.TPU_ID, constants.AUTH_TYPE_NONE, True),
+    pid: ProviderConfig(
+        id=pid,
+        name=t["name"],
+        url=t["url"],
+        auth_type=t["auth_type"],
+        supports_vision=t["supports_vision"],
+        extra_headers={k: list(v) for k, v in t["extra_headers"].items()},
+        endpoints=Endpoints(*t["endpoints"]),
+    )
+    for pid, t in constants.PROVIDER_TABLE.items()
 }
 
 

@@ -29,7 +29,15 @@ def main() -> None:
     p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
     p.add_argument("--metrics-push-url", default=None,
                    help="gateway OTLP push endpoint (e.g. http://gateway:8080/v1/metrics)")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force the jax platform (cpu = dev serving without an "
+                        "accelerator, even when a TPU plugin is pre-registered)")
     args = p.parse_args()
+
+    if args.platform:
+        from inference_gateway_tpu.utils.platform import force_platform
+
+        force_platform(args.platform)
 
     # Multi-host pods: join the jax.distributed world before touching
     # devices (no-op single-host).

@@ -120,9 +120,13 @@ class PrefixCache:
     Requests sharing a prompt prefix (system prompts, few-shot headers)
     reuse the prefix's KV pages instead of recomputing them: pages are
     read-only once full, so sharing needs no copy-on-write — new tokens
-    always land in later pages. Entries are chain-hashed per page
-    (hash_i = H(hash_{i-1}, page_tokens_i)) and evicted LRU when the pool
-    runs low. TTFT for cached prefixes drops to the cost of the tail.
+    always land in later pages. Entries are chain-digested per page with
+    blake2b (digest_i = H(digest_{i-1} || page_tokens_i)) AND store the
+    page's tokens, which are compared exactly on match — a digest
+    collision can therefore never attach another request's KV pages to a
+    new prompt (the weakness that moved vLLM's prefix cache to SHA-256).
+    Evicted LRU when the pool runs low. TTFT for cached prefixes drops to
+    the cost of the tail.
     """
 
     def __init__(self, allocator: PageAllocator, max_cached_pages: int | None = None):
@@ -131,14 +135,18 @@ class PrefixCache:
         self.allocator = allocator
         self.page_size = allocator.cfg.page_size
         self.max_cached_pages = max_cached_pages or max(allocator.num_pages // 2, 1)
-        # chain_hash -> page index; ordered for LRU.
-        self._entries: "OrderedDict[int, int]" = OrderedDict()
+        # chain_digest -> (page index, page tokens); ordered for LRU.
+        self._entries: "OrderedDict[bytes, tuple[int, tuple[int, ...]]]" = OrderedDict()
         self.hits = 0
         self.misses = 0
 
     @staticmethod
-    def _chain(prev: int, tokens: tuple[int, ...]) -> int:
-        return hash((prev, tokens))
+    def _chain(prev: bytes, tokens: tuple[int, ...]) -> bytes:
+        import hashlib
+
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(np.asarray(tokens, np.int64).tobytes())
+        return h.digest()
 
     def match(self, prompt: list[int]) -> tuple[list[int], int]:
         """Longest cached page-aligned prefix: (shared pages incref'd,
@@ -147,16 +155,16 @@ class PrefixCache:
         ps = self.page_size
         pages: list[int] = []
         matched = 0
-        chain = 0
+        chain = b""
         n_full = (len(prompt) - 1) // ps  # last token never comes from cache
         for i in range(n_full):
             chunk = tuple(prompt[i * ps:(i + 1) * ps])
             chain = self._chain(chain, chunk)
-            page = self._entries.get(chain)
-            if page is None:
+            entry = self._entries.get(chain)
+            if entry is None or entry[1] != chunk:  # exact-token guard
                 break
             self._entries.move_to_end(chain)
-            pages.append(page)
+            pages.append(entry[0])
             matched += ps
         for p in pages:
             self.allocator.incref(p)
@@ -169,7 +177,7 @@ class PrefixCache:
     def insert(self, prompt: list[int], slot_pages: list[int]) -> None:
         """Register the request's full prefix pages for reuse."""
         ps = self.page_size
-        chain = 0
+        chain = b""
         n_full = min(len(prompt) // ps, len(slot_pages))
         for i in range(n_full):
             chunk = tuple(prompt[i * ps:(i + 1) * ps])
@@ -183,12 +191,12 @@ class PrefixCache:
                     return
             page = slot_pages[i]
             self.allocator.incref(page)  # cache's own hold
-            self._entries[chain] = page
+            self._entries[chain] = (page, chunk)
 
     def _evict_one(self) -> None:
         if not self._entries:
             return
-        _, page = self._entries.popitem(last=False)
+        _, (page, _tokens) = self._entries.popitem(last=False)
         self.allocator.decref(page)
 
     def evict_for_pressure(self, min_free: int) -> None:

@@ -101,9 +101,37 @@ class Scheduler:
                     self._wake.wait(timeout=0.2)
                 if self._stop:
                     break
-            self._admit()
+            # A single bad request (prompt over the largest bucket in a
+            # mode with no chunked fallback, KV page pool exhausted, ...)
+            # must never kill the scheduler thread — that would wedge
+            # every queued and active request (advisor round-1 medium).
+            try:
+                self._admit()
+            except Exception:
+                pass  # _admit failed the batch itself; loop on
             if self._slots:
-                self._decode_step()
+                try:
+                    self._decode_step()
+                except Exception as e:
+                    self._fail_after_decode_error(e)
+
+    def _fail_request(self, req: GenRequest) -> None:
+        try:
+            req.callback(0, 0.0, True, "error")
+        except Exception:
+            pass
+
+    def _fail_after_decode_error(self, e: Exception) -> None:
+        """Fail the slot tagged on the exception (engine tags
+        OutOfPagesError with .slot), or — if unattributable — every
+        active slot, so clients see finish_reason "error" instead of a
+        hung stream."""
+        slot = getattr(e, "slot", None)
+        victims = [slot] if slot is not None and slot in self._slots else list(self._slots)
+        for s in victims:
+            st = self._slots.pop(s)
+            self._fail_request(st.req)
+            self._release(s, "error")
 
     def _admit(self) -> None:
         """Move waiting requests into free slots and prefill them."""
@@ -119,12 +147,20 @@ class Scheduler:
             return
         embeds = [r.embeds for r in batch]
         seeds = [r.seed for r in batch]
-        results = self.engine.prefill(
-            [r.prompt_ids for r in batch], slots,
-            [r.temperature for r in batch], [r.top_p for r in batch],
-            embeds=embeds if any(e is not None for e in embeds) else None,
-            seeds=seeds if any(s is not None for s in seeds) else None,
-        )
+        try:
+            results = self.engine.prefill(
+                [r.prompt_ids for r in batch], slots,
+                [r.temperature for r in batch], [r.top_p for r in batch],
+                embeds=embeds if any(e is not None for e in embeds) else None,
+                seeds=seeds if any(s is not None for s in seeds) else None,
+            )
+        except Exception:
+            # Fail the whole admission batch (finish_reason "error"),
+            # return its slots/pages, keep the scheduler alive.
+            for req, slot in zip(batch, slots):
+                self._fail_request(req)
+                self._release(slot, "error")
+            return
         for req, res in zip(batch, results):
             state = _SlotState(req, pos=len(req.prompt_ids), pending_token=res.first_token,
                                pending_logprob=res.logprob)

@@ -1,0 +1,34 @@
+"""Platform binding escape hatch.
+
+Containers that pre-register an accelerator PJRT plugin at interpreter
+startup (sitecustomize) select the platform programmatically — the
+``JAX_PLATFORMS`` env var set later is ignored, and if the accelerator
+tunnel is wedged the first device op hangs forever. ``force_platform``
+re-binds jax through the config API and re-initializes backends so the
+choice actually takes effect (the round-1 dryrun failure mode; the same
+cure now serves the sidecar CLI's ``--platform`` flag and tests).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_platform(platform: str, n_devices: int | None = None) -> None:
+    """Bind jax to ``platform`` (e.g. "cpu"), even if another platform
+    was already selected or initialized. ``n_devices`` > 1 with "cpu"
+    creates virtual host devices (mesh tests / dryruns)."""
+    import jax
+
+    if n_devices and platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = platform
+    jax.config.update("jax_platforms", platform)
+    import jax._src.xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        xb._clear_backends()

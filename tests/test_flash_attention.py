@@ -54,3 +54,77 @@ def test_flash_uneven_block_shapes():
     ref = _ref(q, k, v, lengths)
     out = flash_prefill_attention(q, k, v, lengths, block_q=16, block_k=24, interpret=True)
     np.testing.assert_allclose(np.asarray(out[0, :29]), np.asarray(ref[0, :29]), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_chunked_offsets_matches_ref():
+    """Chunked-prefill shape: Tq queries starting at per-row absolute
+    offsets attend a longer KV span causally (the serving tail-prefill)."""
+    rng = np.random.default_rng(3)
+    B, Tq, S, Hq, Hkv, D = 2, 16, 64, 4, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, Tq, Hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)).astype(np.float32))
+    offsets = jnp.asarray([10, 32], jnp.int32)
+    lengths = offsets + Tq  # cache rows valid through the tail
+
+    q_abs = offsets[:, None] + jnp.arange(Tq)[None, :]  # (B, Tq)
+    key_pos = jnp.arange(S)
+    mask = (key_pos[None, None, :] <= q_abs[:, :, None]) & (
+        key_pos[None, None, :] < lengths[:, None, None]
+    )
+    ref = gqa_attend(q, k, v, mask)
+    out = flash_prefill_attention(q, k, v, lengths, q_offsets=offsets,
+                                  block_q=8, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_sliding_window_matches_ref():
+    rng = np.random.default_rng(4)
+    B, T, Hq, Hkv, D, W = 2, 64, 4, 2, 32, 12
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, Hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, Hkv, D)).astype(np.float32))
+    lengths = jnp.asarray([T, 41])
+
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    mask = causal_prefill_mask(positions, lengths)
+    mask = mask & (positions[:, None, :] > positions[:, :, None] - W)
+    ref = gqa_attend(q, k, v, mask)
+    out = flash_prefill_attention(q, k, v, lengths, block_q=16, block_k=16,
+                                  interpret=True, window=W)
+    out, ref = np.asarray(out), np.asarray(ref)
+    np.testing.assert_allclose(out[0], ref[0], rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(out[1, :41], ref[1, :41], rtol=2e-5, atol=2e-5)
+
+
+def test_forward_flash_dispatch_equivalence(monkeypatch):
+    """forward()/forward_paged() produce identical logits with the flash
+    path forced on (IG_TPU_FLASH=1, interpreter mode on CPU) vs the
+    einsum path — proving the serving dispatch is numerically neutral."""
+    import jax
+
+    from inference_gateway_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                            num_kv_heads=2, intermediate_size=96, max_position_embeddings=512,
+                            sliding_window=40)
+    params = llama.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    B, T = 2, 128
+    tokens = jnp.asarray(rng.integers(0, 128, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lengths = jnp.asarray([T, 100], jnp.int32)
+
+    def run():
+        out, _ = llama.forward(params, cfg, tokens, positions, lengths, mode="prefill")
+        return np.asarray(out)
+
+    monkeypatch.setenv("IG_TPU_FLASH", "0")
+    llama.forward.clear_cache()
+    ref = run()
+    monkeypatch.setenv("IG_TPU_FLASH", "1")
+    llama.forward.clear_cache()
+    got = run()
+    llama.forward.clear_cache()
+    np.testing.assert_allclose(got[0], ref[0], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(got[1, :100], ref[1, :100], rtol=2e-4, atol=2e-4)

@@ -182,13 +182,14 @@ async def test_metrics_push_endpoint_and_prometheus(telemetry_gateway):
     )
     assert resp.status == 200
 
-    # Bad JSON -> 400; protobuf -> 415.
+    # Bad JSON -> 400; malformed protobuf -> 400 (both encodings accepted,
+    # api/metrics.go:25-99; e2e protobuf ingest in test_otlp_proto.py).
     resp = await client.post(f"http://127.0.0.1:{port}/v1/metrics", b"nope",
                              headers={"Content-Type": "application/json"})
     assert resp.status == 400
-    resp = await client.post(f"http://127.0.0.1:{port}/v1/metrics", b"\x00\x01",
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/metrics", b"\x0a\x02\x01",
                              headers={"Content-Type": "application/x-protobuf"})
-    assert resp.status == 415
+    assert resp.status == 400
 
     # Dedicated prometheus listener (main.go:97-115).
     resp = await client.get(f"http://127.0.0.1:{gw.metrics_port}/metrics")

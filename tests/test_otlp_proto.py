@@ -1,0 +1,168 @@
+"""Protobuf OTLP ingest: wire-format decoder units + e2e push of a
+gzip'd ExportMetricsServiceRequest (reference api/metrics.go:25-99
+accepts protobuf — the OTel SDK default — alongside JSON).
+
+The tests build wire bytes with a minimal local encoder, so no protobuf
+runtime is needed.
+"""
+
+import gzip
+import struct
+
+import pytest
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.otel.otlp_proto import (
+    ProtoDecodeError,
+    decode_export_metrics_request,
+)
+
+
+# -- tiny wire encoder -------------------------------------------------------
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def _tag(field: int, wt: int) -> bytes:
+    return _varint(field << 3 | wt)
+
+
+def _ld(field: int, payload: bytes) -> bytes:  # length-delimited
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _dbl(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _f64(field: int, v: int) -> bytes:
+    return _tag(field, 1) + struct.pack("<Q", v)
+
+
+def _vint(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def _attr(key: str, value: str) -> bytes:
+    return _ld(1, key.encode()) + _ld(2, _ld(1, value.encode()))
+
+
+def _sum_request(value: int = 3, service: str = "pusher", temporality: int = 1) -> bytes:
+    dp = _ld(7, _attr("gen_ai.provider.name", "openai")) + _tag(6, 1) + struct.pack("<q", value)
+    sum_body = _ld(1, dp) + _vint(2, temporality) + _vint(3, 1)
+    metric = _ld(1, b"inference_gateway.tool_calls") + _ld(7, sum_body)
+    scope = _ld(2, metric)
+    resource = _ld(1, _attr("service.name", service))
+    rm = _ld(1, resource) + _ld(2, scope)
+    return _ld(1, rm)
+
+
+def _histogram_request(counts, bounds, service: str = "pusher") -> bytes:
+    dp = _ld(9, _attr("gen_ai.provider.name", "openai"))
+    dp += _f64(4, sum(counts))  # count
+    dp += _dbl(5, 42.5)  # sum
+    dp += _ld(6, b"".join(struct.pack("<Q", c) for c in counts))  # packed
+    dp += _ld(7, b"".join(struct.pack("<d", b) for b in bounds))  # packed
+    hist = _ld(1, dp) + _vint(2, 1)  # delta
+    metric = _ld(1, b"gen_ai.server.request.duration") + _ld(9, hist)
+    rm = _ld(1, _ld(1, _attr("service.name", service))) + _ld(2, _ld(2, metric))
+    return _ld(1, rm)
+
+
+# -- decoder units -----------------------------------------------------------
+def test_decode_sum_request():
+    payload = decode_export_metrics_request(_sum_request(value=7))
+    rm = payload["resourceMetrics"][0]
+    assert rm["resource"]["attributes"][0] == {
+        "key": "service.name", "value": {"stringValue": "pusher"},
+    }
+    m = rm["scopeMetrics"][0]["metrics"][0]
+    assert m["name"] == "inference_gateway.tool_calls"
+    assert m["sum"]["aggregationTemporality"] == 1
+    dp = m["sum"]["dataPoints"][0]
+    assert dp["asInt"] == 7
+    assert dp["attributes"][0]["key"] == "gen_ai.provider.name"
+
+
+def test_decode_histogram_packed():
+    payload = decode_export_metrics_request(_histogram_request([1, 2, 0], [0.5, 1.0]))
+    m = payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]
+    dp = m["histogram"]["dataPoints"][0]
+    assert dp["bucketCounts"] == [1, 2, 0]
+    assert dp["explicitBounds"] == [0.5, 1.0]
+    assert dp["count"] == 3 and dp["sum"] == 42.5
+
+
+def test_decode_skips_unknown_fields():
+    # Append an unknown length-delimited field at every level; decode
+    # must ignore it (proto forward compatibility).
+    extra = _ld(15, b"future stuff")
+    payload = decode_export_metrics_request(_sum_request() + extra)
+    assert payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]["sum"]["dataPoints"]
+
+
+def test_decode_malformed_raises():
+    with pytest.raises(ProtoDecodeError):
+        decode_export_metrics_request(b"\x0a\xff\x01")  # truncated
+    with pytest.raises(ProtoDecodeError):
+        decode_export_metrics_request(b"\x0b")  # wire type 3 (group)
+
+
+def test_ingest_from_protobuf_matches_json_path():
+    otel = OpenTelemetry()
+    result = otel.ingest_metrics(decode_export_metrics_request(_sum_request(value=4)), "src")
+    assert result["accepted"] == 1 and result["rejected"] == 0
+    text = otel.expose_prometheus()
+    assert "inference_gateway_tool_calls" in text
+    assert 'source="pusher"' in text
+
+    result = otel.ingest_metrics(
+        decode_export_metrics_request(_histogram_request([2, 1, 0], [0.1, 1.0])), "src")
+    assert result["accepted"] == 1
+    assert "gen_ai_server_request_duration" in otel.expose_prometheus()
+
+
+# -- e2e: gzip'd protobuf through the gateway --------------------------------
+@pytest.fixture(scope="module")
+def proto_gateway(aloop):
+    env = {
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_METRICS_PUSH_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        "SERVER_PORT": "0",
+    }
+    gw = build_gateway(env=env)
+    port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, port
+    aloop.run(gw.shutdown())
+
+
+async def test_push_gzip_protobuf_lands_in_prometheus(proto_gateway):
+    gw, port = proto_gateway
+    client = HTTPClient()
+    body = gzip.compress(_sum_request(value=9, service="proto-pusher"))
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/metrics", body,
+        headers={"Content-Type": "application/x-protobuf", "Content-Encoding": "gzip"},
+    )
+    assert resp.status == 200
+    assert resp.json() == {}
+
+    resp = await client.get(f"http://127.0.0.1:{gw.metrics_port}/metrics")
+    assert 'source="proto-pusher"' in resp.body.decode()
+
+    # Cumulative temporality → partialSuccess, matching the JSON path.
+    resp = await client.post(
+        f"http://127.0.0.1:{port}/v1/metrics", _sum_request(temporality=2),
+        headers={"Content-Type": "application/x-protobuf"},
+    )
+    assert resp.status == 200
+    assert resp.json()["partialSuccess"]["rejectedDataPoints"] == 1

@@ -110,3 +110,58 @@ def test_paged_engine_concurrent_reuse():
         sched.stop()
     held = engine.prefix_cache.stats()["cached_pages"] if engine.prefix_cache else 0
     assert engine.allocator.free_page_count() + held == engine.allocator.num_pages
+
+
+def test_kernel_window_matches_reference():
+    """Windowed decode: kernel (interpret) == gather reference, and only
+    the last `window` tokens influence the output."""
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, D, ps, P, mp = 2, 8, 4, 64, 16, 32, 8
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)).astype(np.float32))
+    pt = jnp.asarray(rng.permutation(P)[: B * mp].reshape(B, mp).astype(np.int32))
+    lengths = jnp.asarray([70, 9], dtype=jnp.int32)
+    W = 24
+
+    ref = paged_attention_jax(q, k, v, pt, lengths, Hkv, window=W)
+    out = paged_attention_tpu(q, k, v, pt, lengths, Hkv, interpret=True, window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # Corrupting KV before the window must not change the result: row 0's
+    # window covers tokens [70-24, 70) = pages >= 2; poison pages 0-1.
+    k_bad = k.at[pt[0, 0]].set(1e3).at[pt[0, 1]].set(1e3)
+    v_bad = v.at[pt[0, 0]].set(1e3).at[pt[0, 1]].set(1e3)
+    out_bad = paged_attention_tpu(q, k_bad, v_bad, pt, lengths, Hkv, interpret=True, window=W)
+    np.testing.assert_allclose(np.asarray(out_bad[0]), np.asarray(ref[0]), rtol=1e-5, atol=1e-5)
+
+
+def test_paged_sliding_window_matches_dense():
+    """Mistral-style config served paged must emit the dense engine's
+    tokens once context exceeds the window (round-1 verdict weak #4)."""
+    from inference_gateway_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                      num_kv_heads=2, intermediate_size=128, max_position_embeddings=512,
+                      sliding_window=8)
+    common = dict(model="test-tiny", max_slots=4, max_seq_len=128, dtype="float32",
+                  max_prefill_batch=2, use_mesh=False)
+    dense = Engine(EngineConfig(**common, attention="dense"), model_cfg=cfg)
+    paged = Engine(EngineConfig(**common, attention="paged", page_size=16), model_cfg=cfg,
+                   params=jax.tree.map(lambda x: x, dense.params))
+
+    sched_d = Scheduler(dense)
+    sched_p = Scheduler(paged)
+    sched_d.start()
+    sched_p.start()
+    try:
+        rng = np.random.default_rng(11)
+        # Prompts longer than the window, decodes crossing page boundaries.
+        for n in (6, 20, 40):
+            prompt = [int(x) for x in rng.integers(1, 250, size=n)]
+            want, _ = generate_sync(sched_d, prompt, max_tokens=30, temperature=0.0)
+            got, _ = generate_sync(sched_p, prompt, max_tokens=30, temperature=0.0)
+            assert got == want, f"prompt len {n}: paged+window diverged from dense"
+    finally:
+        sched_d.stop()
+        sched_p.stop()

@@ -1,0 +1,86 @@
+"""models.dev community table generator (codegen/pricinggen.py) —
+parity with reference internal/pricinggen/pricinggen.go:83-300."""
+
+import json
+
+from inference_gateway_tpu.codegen.pricinggen import (
+    CONTEXT_OUT,
+    PRICING_OUT,
+    generate_context_windows,
+    generate_pricing,
+    load_snapshot,
+    per_mtok_to_per_token,
+    run,
+)
+from inference_gateway_tpu.providers.context_window import (
+    apply_community_context_windows,
+    community_context_table,
+)
+from inference_gateway_tpu.providers.pricing import (
+    apply_community_pricing,
+    community_pricing_table,
+)
+
+
+def test_per_mtok_conversion_exact():
+    # Exact decimal shift, no float division (pricinggen.go:280).
+    assert per_mtok_to_per_token(5) == "0.000005"
+    assert per_mtok_to_per_token(0.28) == "0.00000028"
+    assert per_mtok_to_per_token(1250) == "0.00125"
+    assert per_mtok_to_per_token(0.0028) == "0.0000000028"
+    assert per_mtok_to_per_token(0) is None
+    assert per_mtok_to_per_token(None) is None
+    assert per_mtok_to_per_token(-1) is None
+
+
+def test_generator_semantics():
+    models = {
+        "prov/paid": {"cost": {"input": 2.5, "output": 10, "cache_read": 0.25}},
+        "prov/free": {"cost": {"input": 0, "output": 0}},
+        "prov/sub": {"subscription": True, "cost": {"input": 0, "output": 0}},
+        "prov/no-cost": {"limit": {"context": 32768, "output": 4096}},
+        "prov/partial": {"cost": {"input": 1}},  # no output rate → skipped
+    }
+    pricing = generate_pricing(models)
+    assert pricing["prov/paid"] == {
+        "prompt": "0.0000025", "completion": "0.00001",
+        "source": "community", "cache_read": "0.00000025",
+    }
+    assert pricing["prov/free"] == {"prompt": "0", "completion": "0", "source": "community"}
+    assert pricing["prov/sub"]["subscription"] is True
+    assert "prov/no-cost" not in pricing and "prov/partial" not in pricing
+
+    ctx = generate_context_windows(models)
+    assert ctx == {"prov/no-cost": {"context": 32768, "output": 4096}}
+
+
+def test_committed_tables_in_sync():
+    """Drift guard: the committed tables regenerate byte-identically from
+    the vendored snapshot (the reference's `task generate` contract)."""
+    assert run("check") == 0
+    # and they are big enough to be the real dataset, not a stub
+    assert len(json.loads(PRICING_OUT.read_text())) > 200
+    assert len(json.loads(CONTEXT_OUT.read_text())) > 200
+
+
+def test_snapshot_scale_and_enrichment():
+    models = load_snapshot()
+    assert len(models) >= 300
+    providers = {k.split("/")[0] for k in models}
+    assert {"anthropic", "openai", "google", "mistral", "deepseek", "groq"} <= providers
+
+    # Enrichment hits via the generated table — full key and bare name.
+    out = [
+        {"id": "anthropic/claude-opus-4-5"},
+        {"id": "deepseek/deepseek-chat"},
+        {"id": "someprov/claude-opus-4-5"},  # bare-name fallback
+    ]
+    apply_community_pricing(out)
+    apply_community_context_windows(out)
+    for m in out:
+        assert m["pricing"]["source"] == "community", m
+        assert m["context_window"] > 0, m
+    assert out[0]["pricing"]["prompt"] == "0.000005"
+    assert out[0]["context_window"] == 200000
+    assert len(community_pricing_table()) > 200
+    assert len(community_context_table()) > 200

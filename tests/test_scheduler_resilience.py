@@ -1,0 +1,136 @@
+"""Round-2 resilience fixes (advisor findings).
+
+- Scheduler thread survives per-request failures (OutOfPagesError, prompts
+  above the largest bucket in modes with no chunked fallback): the bad
+  request fails with finish_reason "error", subsequent requests complete.
+- PrefixCache match requires exact token equality, not digest equality.
+- Paged decode_chunk near max_seq_len never walks the page table out of
+  bounds (in-scan position clamp).
+"""
+
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.kv_cache import PageAllocator, PagedCacheConfig, PrefixCache
+from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler, generate_sync
+
+
+def _collect(scheduler, prompt, max_tokens=8, timeout=60.0):
+    """Submit one request, return (tokens, final_reason)."""
+    q: queue.Queue = queue.Queue()
+    scheduler.submit(GenRequest(
+        prompt_ids=prompt, max_tokens=max_tokens,
+        callback=lambda tok, lp, fin, reason: q.put((tok, fin, reason)),
+    ))
+    toks = []
+    deadline = time.monotonic() + timeout
+    while True:
+        tok, fin, reason = q.get(timeout=max(deadline - time.monotonic(), 0.1))
+        toks.append(tok)
+        if fin:
+            return toks, reason
+
+
+@pytest.fixture(scope="module")
+def paged_small():
+    # 4 pages of 16 tokens; two slots; NO prefix cache, so page exhaustion
+    # is reachable (two concurrent 33+-token requests want 6 pages).
+    cfg = EngineConfig(model="test-tiny", max_slots=2, max_seq_len=64, dtype="float32",
+                       max_prefill_batch=2, use_mesh=False, attention="paged",
+                       page_size=16, num_pages=4, prefix_cache=False, decode_chunk=4,
+                       prefill_buckets=(16, 32, 64))
+    eng = Engine(cfg)
+    s = Scheduler(eng)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_oversized_prompt_fails_request_not_scheduler(paged_small):
+    # Paged mode has no chunked-prefill fallback: a prompt above the
+    # largest bucket must fail with "error", and the scheduler must keep
+    # serving afterwards. (The submit() clamp keeps prompts under the
+    # context window, so use a prompt between the largest bucket and the
+    # window.)
+    s = paged_small
+    assert s.engine.config.max_seq_len == 64
+    # submit() clamps prompts under the context window (63 < bucket 64),
+    # so shrink the largest bucket below the window to reach bucket_for's
+    # ValueError in paged mode.
+    s.engine.config.prefill_buckets = (16, 32)
+    try:
+        toks, reason = _collect(s, [1] * 40, max_tokens=4)
+        assert reason == "error"
+        # scheduler still alive: a small request completes normally
+        toks, reason = _collect(s, [1, 2, 3], max_tokens=4)
+        assert reason in ("stop", "length")
+        assert len(toks) >= 1
+    finally:
+        s.engine.config.prefill_buckets = (16, 32, 64)
+
+
+def test_page_exhaustion_fails_request_keeps_loop(paged_small):
+    s = paged_small
+    # One request fits (48 tokens -> 3 pages of 4 total). Two don't: the
+    # second exhausts the pool either at admission or when decode crosses
+    # a page boundary; it must error out without killing the thread.
+    r1 = _collect(s, [2] * 40, max_tokens=20)
+    assert r1[1] in ("stop", "length")  # sanity: single request fine
+
+    results: "queue.Queue[tuple]" = queue.Queue()
+
+    def cb_factory(tag):
+        def cb(tok, lp, fin, reason):
+            if fin:
+                results.put((tag, reason))
+        return cb
+
+    s.submit(GenRequest(prompt_ids=[3] * 40, max_tokens=24, callback=cb_factory("a")))
+    s.submit(GenRequest(prompt_ids=[4] * 40, max_tokens=24, callback=cb_factory("b")))
+    got = {}
+    for _ in range(2):
+        tag, reason = results.get(timeout=60)
+        got[tag] = reason
+    # At least one should have errored (pool of 4 pages cannot hold two
+    # 40+-token requests: 3 pages each), and none may hang.
+    assert set(got) == {"a", "b"}
+    assert "error" in got.values()
+    # Loop still alive afterwards.
+    toks, reason = _collect(s, [5, 6, 7], max_tokens=4)
+    assert reason in ("stop", "length")
+
+
+def test_decode_to_max_seq_len_no_oob(paged_small):
+    s = paged_small
+    # Drive one request all the way to the end of its cache row: the
+    # fused scan rides past max_seq_len and must clamp instead of
+    # indexing page_table[slot, max_pages_per_slot].
+    toks, reason = _collect(s, [7] * 30, max_tokens=512, timeout=120)
+    assert reason == "length"
+    table = s.engine.allocator.page_table()
+    assert table.shape[1] == 4  # 64 / 16
+    # all previously-written table entries were in range
+    assert (table >= 0).all() and (table < s.engine.allocator.num_pages).all()
+
+
+def test_prefix_cache_rejects_digest_match_with_different_tokens():
+    alloc = PageAllocator(PagedCacheConfig(page_size=4, num_pages=8, max_slots=2, max_seq_len=32))
+    pc = PrefixCache(alloc)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    alloc.ensure_capacity(0, len(prompt))
+    pc.insert(prompt, alloc.pages_of(0))
+    # Normal hit.
+    pages, matched = pc.match(list(prompt))
+    assert matched == 8 and len(pages) == 2
+    for p in pages:
+        alloc.decref(p)
+    # Simulate a digest collision: corrupt the stored token chunk of the
+    # first entry. The exact-token guard must refuse the match.
+    digest, (page, _chunk) = next(iter(pc._entries.items()))
+    pc._entries[digest] = (page, (9, 9, 9, 9))
+    pages, matched = pc.match(list(prompt))
+    assert matched == 0 and pages == []
