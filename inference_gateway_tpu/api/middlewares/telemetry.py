@@ -98,25 +98,30 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                 finally:
                     usage = None
                     tool_names: list[str] = []
-                    for raw in ring:
-                        for line in raw.split(b"\n"):
-                            line = line.strip()
-                            if not line.startswith(b"data:"):
-                                continue
-                            data = line[5:].strip()
-                            if not data or data == b"[DONE]":
-                                continue
-                            try:
-                                payload = json.loads(data)
-                            except ValueError:
-                                continue
-                            usage = parse_usage(payload) or usage
-                            for choice in payload.get("choices") or []:
-                                delta = choice.get("delta") or {}
-                                for tc in delta.get("tool_calls") or []:
-                                    name = (tc.get("function") or {}).get("name")
-                                    if name:
-                                        tool_names.append(name)
+                    # The relay yields raw transport blocks, not SSE
+                    # lines — a `data:` line (e.g. the final usage chunk)
+                    # can straddle two blocks. Join the retained window
+                    # before splitting so the scan is block-boundary-safe
+                    # (advisor round-2). A line whose head fell off the
+                    # ring no longer starts with `data:` and is skipped.
+                    for line in b"".join(ring).split(b"\n"):
+                        line = line.strip()
+                        if not line.startswith(b"data:"):
+                            continue
+                        data = line[5:].strip()
+                        if not data or data == b"[DONE]":
+                            continue
+                        try:
+                            payload = json.loads(data)
+                        except ValueError:
+                            continue
+                        usage = parse_usage(payload) or usage
+                        for choice in payload.get("choices") or []:
+                            delta = choice.get("delta") or {}
+                            for tc in delta.get("tool_calls") or []:
+                                name = (tc.get("function") or {}).get("name")
+                                if name:
+                                    tool_names.append(name)
                     record("", usage, tool_names)
 
             resp.chunks = observed()

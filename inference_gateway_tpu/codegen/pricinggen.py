@@ -20,8 +20,11 @@ online checkouts can sync it from the models.dev repo) and rerunning
 
 from __future__ import annotations
 
+import datetime
 import json
 import sys
+import tarfile
+import tomllib
 from decimal import Decimal
 from pathlib import Path
 
@@ -29,6 +32,107 @@ DATA_DIR = Path(__file__).resolve().parent.parent / "providers" / "data"
 SNAPSHOT = DATA_DIR / "models_dev_snapshot.json"
 PRICING_OUT = DATA_DIR / "community_pricing.json"
 CONTEXT_OUT = DATA_DIR / "community_context_windows.json"
+
+# models.dev provider directory → gateway provider ID. Local providers
+# (ollama, llamacpp) intentionally absent: their pricing stays null by
+# design (reference pricinggen.go:29-44).
+PROVIDER_DIRS = {
+    "anthropic": "anthropic",
+    "cloudflare-workers-ai": "cloudflare",
+    "cohere": "cohere",
+    "deepseek": "deepseek",
+    "google": "google",
+    "groq": "groq",
+    "minimax": "minimax",
+    "mistral": "mistral",
+    "moonshotai": "moonshot",
+    "nvidia": "nvidia",
+    "ollama-cloud": "ollama_cloud",
+    "openai": "openai",
+    "zai": "zai",
+}
+
+# Curated "<provider>/<model>" keys with no per-token price, gated behind
+# a paid subscription; models.dev carries no subscription marker so the
+# set lives here (reference pricinggen.go:46-53).
+SUBSCRIPTION_MODELS = {
+    "ollama_cloud/deepseek-v4-pro",
+    "ollama_cloud/deepseek-v4-flash",
+}
+
+
+def _table_key(name: str) -> str | None:
+    """Map a tarball entry like
+    "sst-models.dev-abc/providers/moonshotai/models/kimi-k2.toml" to a
+    gateway key like "moonshot/kimi-k2"; nested model paths keep their
+    slashes (reference pricinggen.go:185-204)."""
+    _, sep, rest = name.partition("providers/")
+    if not sep:
+        return None
+    provider_dir, sep, model_path = rest.partition("/models/")
+    if not sep or not model_path.endswith(".toml"):
+        return None
+    model = model_path[: -len(".toml")]
+    provider = PROVIDER_DIRS.get(provider_dir)
+    if provider is None or not model:
+        return None
+    return f"{provider}/{model}"
+
+
+def sync_from_tarball(tarball_path: str, snapshot_path: Path = SNAPSHOT) -> int:
+    """Rebuild the vendored snapshot from a genuine models.dev repository
+    tarball (as served by `gh api repos/sst/models.dev/tarball`), walking
+    every supported provider's model TOML files — the Python equivalent
+    of reference internal/pricinggen/pricinggen.go:128-170.
+
+    Returns the number of models captured. The snapshot keeps the
+    upstream schema (per-MTok cost{}, limit{}) so generate_pricing /
+    generate_context_windows stay the single conversion point.
+    """
+    models: dict[str, dict] = {}
+    with tarfile.open(tarball_path, "r:*") as tf:
+        for member in tf:
+            if not member.isfile():
+                continue
+            key = _table_key(member.name)
+            if key is None:
+                continue
+            f = tf.extractfile(member)
+            if f is None:
+                continue
+            data = tomllib.loads(f.read().decode("utf-8"))
+            entry: dict = {}
+            cost = data.get("cost")
+            if isinstance(cost, dict):
+                entry["cost"] = {
+                    k: cost.get(k, 0)
+                    for k in ("input", "output", "cache_read", "cache_write")
+                    if k in cost
+                }
+            limit = data.get("limit")
+            if isinstance(limit, dict):
+                entry["limit"] = {
+                    k: int(limit[k]) for k in ("context", "output") if limit.get(k)
+                }
+            if key in SUBSCRIPTION_MODELS:
+                entry["subscription"] = True
+            models[key] = entry
+    if not models:
+        raise SystemExit(f"no supported provider models found in {tarball_path}")
+    snapshot = {
+        "_meta": {
+            "source": "models.dev community dataset (github.com/sst/models.dev)",
+            "format": "per-MTok USD rates under cost{}, token limits under limit{} (models.dev schema)",
+            "synced_at": datetime.datetime.now(datetime.timezone.utc)
+            .replace(microsecond=0)
+            .isoformat()
+            .replace("+00:00", "Z"),
+        },
+        "models": dict(sorted(models.items())),
+    }
+    snapshot_path.write_text(json.dumps(snapshot, indent=2) + "\n")
+    print(f"synced {len(models)} models from {tarball_path}")
+    return len(models)
 
 
 def per_mtok_to_per_token(rate) -> str | None:
@@ -129,4 +233,8 @@ def run(mode: str = "check") -> int:
 
 
 if __name__ == "__main__":
+    if "--sync-from-tarball" in sys.argv:
+        tarball = sys.argv[sys.argv.index("--sync-from-tarball") + 1]
+        sync_from_tarball(tarball)
+        sys.exit(0)
     sys.exit(run("write" if "--write" in sys.argv else "check"))

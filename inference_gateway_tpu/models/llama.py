@@ -168,7 +168,7 @@ def _layer(
     return x, new_k_cache, new_v_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "mode", "last_only"))
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only", "ring_mesh"))
 def forward(
     params: Params,
     cfg: LlamaConfig,
@@ -180,6 +180,7 @@ def forward(
     last_only: bool = False,
     slot_ids: jnp.ndarray | None = None,  # (B,) cache rows for this batch
     embeds: jnp.ndarray | None = None,  # (B, T, H) overrides embed[tokens] (multimodal)
+    ring_mesh=None,  # mesh with sp>1: fresh prefill attends via ring attention
 ) -> tuple[jnp.ndarray, Params | None]:
     """Run the decoder. Returns (logits, updated_cache).
 
@@ -187,6 +188,14 @@ def forward(
              cache (if given) is written at ``positions``. ``slot_ids``
              maps batch rows onto cache rows so a small prefill batch can
              write into a large slot cache (continuous batching).
+             REQUIRES positions[b] == arange(T) on the single-TPU-chip
+             flash path: the Pallas kernel derives absolute query/key
+             positions from the row index with offset 0, while the
+             einsum path masks by the actual ``positions`` array. The
+             engine always passes contiguous-from-zero positions for
+             fresh prefill; callers with left-padded or shifted rows
+             must use mode="prefill_chunk" (which carries per-row
+             ``q_offsets``) or disable flash via IG_TPU_FLASH=0.
     decode:  T must be 1 and the batch must cover every cache row;
              attends to the whole cache masked to ``lengths``.
     prefill_chunk: chunked prefill — this call's tokens are written at
@@ -250,7 +259,22 @@ def forward(
     else:
         flash_ok = False
 
-    if mode == "prefill" and flash_ok:
+    if mode == "prefill" and ring_mesh is not None:
+        # Sequence-parallel exact prefill: q/k/v are seq-sharded over the
+        # mesh's sp axis and KV blocks rotate the ring (ops/
+        # ring_attention.py). Long-context path — prompts beyond the
+        # largest bucket prefill in ONE pass with O(T/sp) memory per
+        # device instead of a serial chunk loop (SURVEY.md §2.4 SP row,
+        # §5 long-context). Requires positions[b] == arange(T) (fresh
+        # prefill) and no sliding window (the engine gates on both).
+        from inference_gateway_tpu.ops.ring_attention import make_ring_attention
+
+        assert cfg.sliding_window is None, "ring prefill does not window"
+        ring = make_ring_attention(ring_mesh, axis="sp", causal=True)
+
+        def attn_impl(q, k, v):
+            return ring(q, k, v, lengths)
+    elif mode == "prefill" and flash_ok:
         def attn_impl(q, k, v):
             return flash_prefill_attention(q, k, v, lengths, window=cfg.sliding_window)
     elif mode == "prefill_chunk" and flash_ok:
@@ -316,7 +340,7 @@ def _dense_ffn(x: jnp.ndarray, lp: Params, cfg: LlamaConfig) -> jnp.ndarray:
     return qmatmul(act(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
 
 
-@partial(jax.jit, static_argnames=("cfg", "mode", "last_only", "mesh"))
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only", "mesh", "ring_mesh"))
 def forward_paged(
     params: Params,
     cfg: LlamaConfig,
@@ -329,6 +353,7 @@ def forward_paged(
     mode: str = "prefill",
     last_only: bool = True,
     mesh=None,  # tp mesh: decode runs the shard_mapped Pallas kernel
+    ring_mesh=None,  # mesh with sp>1: fresh prefill attends via ring attention
 ) -> tuple[jnp.ndarray, Params]:
     """Like ``forward`` but against the paged KV cache
     (serving/kv_cache.py). Decode attention runs the Pallas ragged
@@ -337,7 +362,8 @@ def forward_paged(
     path: shared prefix pages are already populated, only the tail is
     computed here."""
     return forward_paged_impl(params, cfg, tokens, positions, lengths, cache,
-                              write_idx, page_table, mode, last_only, mesh, _dense_ffn)
+                              write_idx, page_table, mode, last_only, mesh, _dense_ffn,
+                              ring_mesh=ring_mesh)
 
 
 def forward_paged_impl(
@@ -353,6 +379,7 @@ def forward_paged_impl(
     last_only: bool,
     mesh,
     ffn,  # (x, lp, cfg) -> residual FFN contribution; MoE plugs in here
+    ring_mesh=None,
 ) -> tuple[jnp.ndarray, Params]:
     """Shared paged-decoder skeleton: attention + cache paging are
     family-independent; the FFN block (dense gated MLP vs MoE) is the
@@ -390,8 +417,22 @@ def forward_paged_impl(
             )
     decode = mode == "decode"
 
-    def body(x, per_layer):
-        lp, kc, vc = per_layer
+    # The layer loop CARRIES the cache as one flat buffer instead of
+    # streaming per-layer planes through scan xs/ys. Stacked ys rebuild
+    # the whole (L, P, page_size, HkvD) array every call — inside the
+    # fused decode scan that was a full-cache read+write per token
+    # (~3.6 ms/step at TinyLlama pool sizes on v5e, measured round 3).
+    # As a carry, the scatter lowers to an in-place update of just the
+    # written rows, and attention reads pages straight out of the big
+    # buffer via layer-offset page indices — no per-layer slice is ever
+    # materialized. Layout: flat row (li * P + p) holds layer li's copy
+    # of logical page p; reshapes to/from the at-rest (L, P, ...) shape
+    # are metadata-only.
+    total = L * flat
+
+    def body(carry, per_layer):
+        x, ck, cv = carry  # ck/cv: (L*P*page_size, HkvD) flat carry
+        lp, li = per_layer
         h = rms_norm(x, _nw(lp["attn_norm"], cfg), cfg.rms_norm_eps)
         q = qmatmul(h, lp["wq"])
         k = qmatmul(h, lp["wk"])
@@ -406,39 +447,53 @@ def forward_paged_impl(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        kc2 = kc.reshape(flat, HkvD)
-        vc2 = vc.reshape(flat, HkvD)
-        k_flat = k.reshape(B, T, HkvD).astype(kc.dtype)
-        v_flat = v.reshape(B, T, HkvD).astype(vc.dtype)
-        kc2 = kc2.at[write_idx].set(k_flat, mode="drop")
-        vc2 = vc2.at[write_idx].set(v_flat, mode="drop")
-        new_kc = kc2.reshape(P, page_size, HkvD)
-        new_vc = vc2.reshape(P, page_size, HkvD)
+        k_flat = k.reshape(B, T, HkvD).astype(ck.dtype)
+        v_flat = v.reshape(B, T, HkvD).astype(cv.dtype)
+        # Per-layer offset; rows that were OOB within the layer (== flat,
+        # the drop convention) must stay OOB for the WHOLE buffer, not
+        # land in layer li+1's first page.
+        w_idx = jnp.where(write_idx >= flat, total, write_idx + li * flat)
+        ck = ck.at[w_idx].set(k_flat, mode="drop")
+        cv = cv.at[w_idx].set(v_flat, mode="drop")
+        pages_k = ck.reshape(L * P, page_size, HkvD)
+        pages_v = cv.reshape(L * P, page_size, HkvD)
+        layer_table = page_table + li * P  # (B, max_pages) into the big pool
 
         if decode:
-            attn = paged_attention(q[:, 0], new_kc, new_vc, page_table, lengths, Hkv,
+            attn = paged_attention(q[:, 0], pages_k, pages_v, layer_table, lengths, Hkv,
                                    window=cfg.sliding_window, mesh=mesh)
             attn = attn[:, None]  # (B, 1, Hq, D)
         elif mode == "prefill_chunk":
             # Gather the slot's pages (prefix + just-written tail) and
             # attend causally by absolute position.
-            kg = new_kc[page_table].reshape(B, -1, Hkv, D).astype(q.dtype)
-            vg = new_vc[page_table].reshape(B, -1, Hkv, D).astype(q.dtype)
+            kg = pages_k[layer_table].reshape(B, -1, Hkv, D).astype(q.dtype)
+            vg = pages_v[layer_table].reshape(B, -1, Hkv, D).astype(q.dtype)
             if use_flash_prefill(T, kg.shape[1], D):
                 attn = flash_prefill_attention(q, kg, vg, lengths, q_offsets=positions[:, 0],
                                                window=cfg.sliding_window)
             else:
                 attn = gqa_attend(q, kg, vg, chunk_mask)
+        elif ring_mesh is not None:
+            # Fresh long-prompt prefill over the sp ring; pages were
+            # just written above, attention runs on this call's k/v.
+            from inference_gateway_tpu.ops.ring_attention import make_ring_attention
+
+            attn = make_ring_attention(ring_mesh, axis="sp", causal=True)(q, k, v, lengths)
         elif use_flash_prefill(T, T, D):
             attn = flash_prefill_attention(q, k, v, lengths, window=cfg.sliding_window)
         else:
             attn = gqa_attend(q, k, v, mask)
         x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
         x = x + ffn(x, lp, cfg)
-        return x, (new_kc, new_vc)
+        return (x, ck, cv), None
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    new_cache = {"k": new_k, "v": new_v}
+    ck0 = cache["k"].reshape(total, HkvD)
+    cv0 = cache["v"].reshape(total, HkvD)
+    (x, ck, cv), _ = jax.lax.scan(
+        body, (x, ck0, cv0), (params["layers"], jnp.arange(L))
+    )
+    new_cache = {"k": ck.reshape(L, P, page_size, HkvD),
+                 "v": cv.reshape(L, P, page_size, HkvD)}
 
     x = rms_norm(x, _nw(params["final_norm"], cfg), cfg.rms_norm_eps)
     if last_only:

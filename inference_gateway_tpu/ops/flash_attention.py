@@ -59,8 +59,12 @@ def _flash_kernel(
     offset = offset_ref[b, 0]
 
     q = q_ref[0, 0].astype(jnp.float32).reshape(BQ * G, D)
-    # Absolute query positions: chunked prefill starts rows at `offset`.
-    q_pos = offset + qi * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, G), 0).reshape(BQ * G)
+    # Absolute query positions as a (BQ*G, 1) column: row r is query
+    # r // G. Built directly in 2D — a (BQ, G) iota reshaped to 1D is a
+    # sublane→lane relayout Mosaic refuses to lower ("unsupported shape
+    # cast", observed on real v5e), while a 2D sublane iota + shift is
+    # native.
+    q_pos = offset + qi * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ * G, 1), 0) // G
 
     n_k = pl.cdiv(kv_len, block_k)
     if causal:
@@ -88,9 +92,9 @@ def _flash_kernel(
         k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         valid = k_pos < length
         if causal:
-            valid = valid & (k_pos <= q_pos[:, None])
+            valid = valid & (k_pos <= q_pos)
         if window is not None:
-            valid = valid & (k_pos > q_pos[:, None] - window)
+            valid = valid & (k_pos > q_pos - window)
         scores = jnp.where(valid, scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
@@ -187,14 +191,23 @@ def _flash_prefill_attention(
     return out.transpose(0, 2, 1, 3, 4).reshape(B, Tq, Hq, D)
 
 
+# IG_TPU_FLASH=1/0 forces the flash dispatch. Captured ONCE at import:
+# jitted forwards evaluate the dispatch at trace time and cache the
+# result, so a mid-session env flip would silently not apply to
+# already-compiled shapes (advisor round-2). Import-time capture makes
+# the contract explicit; tests monkeypatch this attribute (and clear the
+# jit cache) instead of mutating the environment.
+import os as _os
+
+FORCE_FLASH: str | None = _os.environ.get("IG_TPU_FLASH")
+
+
 def use_flash_prefill(Tq: int, Tk: int, D: int) -> bool:
     """Trace-time dispatch: run the Pallas kernel on a single real TPU
     chip when shapes tile (mirrors ops/paged_attention.paged_attention's
     platform dispatch). The einsum path stays the mesh/CPU/small-bucket
     route — GSPMD partitions it with no collectives."""
-    import os
-
-    force = os.environ.get("IG_TPU_FLASH")
+    force = FORCE_FLASH
     if force is not None:
         return force == "1"
     platform = jax.devices()[0].platform

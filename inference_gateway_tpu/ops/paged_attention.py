@@ -33,6 +33,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# IG_TPU_PAGED_KERNEL=1/0 forces the kernel choice; captured once at
+# import so the contract is explicit (see paged_attention's docstring).
+import os as _os
+
+FORCE_PAGED_KERNEL: str | None = _os.environ.get("IG_TPU_PAGED_KERNEL")
+
 
 # ---------------------------------------------------------------------------
 # Reference implementation (also the CPU path)
@@ -262,10 +268,11 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int,
     gather path elsewhere. The gather path is head-local math, so under a
     mesh GSPMD partitions it across ``tp`` (kv-head shards) with no
     collectives. ``IG_TPU_PAGED_KERNEL=1/0`` forces the kernel choice
-    (tests exercise the shard_map path on a CPU mesh in interpret mode)."""
-    import os
-
-    force = os.environ.get("IG_TPU_PAGED_KERNEL")
+    (tests exercise the shard_map path on a CPU mesh in interpret mode).
+    The flag is captured at import (module attr FORCE_PAGED_KERNEL) —
+    jitted forwards bake the dispatch into the trace, so a mid-session
+    env flip would not apply to compiled shapes (advisor round-2)."""
+    force = FORCE_PAGED_KERNEL
     platform = jax.devices()[0].platform
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         tp = mesh.shape["tp"]

@@ -49,6 +49,10 @@ class EngineConfig:
     top_k: int = 64
     seed: int = 0
     use_mesh: bool = True  # shard over all visible devices when >1
+    # Explicit mesh factoring {"dp":1,"sp":4,"tp":2}; None = auto
+    # (default_mesh_shape). sp>1 turns on ring-attention prefill for
+    # prompts beyond the largest bucket (SURVEY.md §2.4 SP row).
+    mesh_shape: dict | None = None
     checkpoint_path: str | None = None  # orbax checkpoint dir (serving/checkpoint.py)
     vision_model: str | None = None  # vision preset (models/vision.py) for multimodal
     attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
@@ -121,7 +125,12 @@ class Engine:
                 dp = n_dev // (ep * tp)
                 self.mesh = create_moe_mesh(dp=dp, sp=1, ep=ep, tp=tp)
             else:
-                dp, sp, tp = default_mesh_shape(n_dev)
+                if config.mesh_shape:
+                    dp = config.mesh_shape.get("dp", 1)
+                    sp = config.mesh_shape.get("sp", 1)
+                    tp = config.mesh_shape.get("tp", 1)
+                else:
+                    dp, sp, tp = default_mesh_shape(n_dev)
                 # tp must tile the model; degrade toward dp otherwise.
                 while tp > 1 and (self.model_cfg.num_kv_heads % tp or self.model_cfg.intermediate_size % tp):
                     tp //= 2
@@ -242,11 +251,12 @@ class Engine:
         return jax.random.fold_in(self._rng, self._step_counter)
 
     # ------------------------------------------------------------------
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
-    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng):
+    @partial(jax.jit, static_argnames=("self", "ring"), donate_argnums=(2,))
+    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng, ring=False):
+        ring_kw = {"ring_mesh": self.mesh} if ring else {}
         logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
-            mode="prefill", last_only=True, slot_ids=slot_ids,
+            mode="prefill", last_only=True, slot_ids=slot_ids, **ring_kw,
         )
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
@@ -363,12 +373,13 @@ class Engine:
         )
         return toks, logprobs, cache
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    @partial(jax.jit, static_argnames=("self", "ring"), donate_argnums=(2,))
     def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
-                          page_table, temps, top_ps, seeds, use_seed, rng):
+                          page_table, temps, top_ps, seeds, use_seed, rng, ring=False):
+        ring_kw = {"ring_mesh": self.mesh} if ring else {}
         logits, cache = self._model.forward_paged(
             params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
-            page_table, mode="prefill", last_only=True,
+            page_table, mode="prefill", last_only=True, **ring_kw,
         )
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
@@ -418,15 +429,35 @@ class Engine:
         ``embeds`` optionally carries per-row (T_i, H) multimodal
         embedding overrides (from prepare_multimodal)."""
         assert prompts and len(prompts) == len(slots)
-        # Prompts beyond the largest bucket go through chunked prefill
-        # (dense cache path); the rest batch normally.
+        # Prompts beyond the largest bucket take a long-context path:
+        # ring attention over the sp axis when the mesh has one (ONE
+        # sequence-sharded pass, O(T/sp) memory per device — dense AND
+        # paged caches), else the serial chunked loop (dense cache).
+        # The rest batch normally.
         biggest = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
-        if not self.paged and not self.is_moe and any(len(p) > biggest for p in prompts):
+        ring_ok = (
+            self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+            and not self.is_moe
+            and self.model_cfg.sliding_window is None
+        )
+        long_path = ring_ok or (not self.paged and not self.is_moe)
+        # Multimodal rows can't ride the long path: neither the ring nor
+        # the chunked prefill carries per-row embedding overrides, and
+        # silently prefilling on token IDs alone would return plausible
+        # wrong output. Let bucket_for raise instead — a loud admission
+        # failure (finish_reason "error") beats a wrong answer.
+        if embeds is not None and any(
+            e is not None and len(p) > biggest for e, p in zip(embeds, prompts)
+        ):
+            long_path = False
+        if long_path and any(len(p) > biggest for p in prompts):
             results = []
             short_idx = [i for i, p in enumerate(prompts) if len(p) <= biggest]
             for i, p in enumerate(prompts):
                 if len(p) > biggest:
-                    results.append((i, self._prefill_one_chunked(p, slots[i], temps[i], top_ps[i],
+                    one = self._prefill_one_ring if ring_ok else self._prefill_one_chunked
+                    results.append((i, one(p, slots[i], temps[i], top_ps[i],
                         seed=None if seeds is None else seeds[i])))
             if short_idx:
                 sub = self.prefill(
@@ -590,6 +621,54 @@ class Engine:
                     jnp.asarray([seed is not None]), self._next_rng(),
                 )
             self.metrics["prefill_tokens"] += total
+            self.metrics["prefill_batches"] += 1
+        return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
+
+    def _prefill_one_ring(self, prompt: list[int], slot: int, temp: float, top_p: float,
+                          seed: int | None = None) -> PrefillResult:
+        """Ring-attention prefill for one long prompt: the sequence is
+        padded to a multiple of the sp axis, sharded across it, and
+        attended in ONE pass with KV blocks rotating the ring
+        (ops/ring_attention.py). Cache write-back (dense row scatter or
+        paged write_idx scatter) is the same code the bucketed path
+        uses — GSPMD gathers the seq-sharded updates into the replicated
+        (tp-sharded) cache. Composes with the paged pool: pages are
+        reserved up front, padding rows drop via OOB write_idx."""
+        sp = self.mesh.shape["sp"]
+        T = len(prompt)
+        # Local shards must tile evenly AND stay lane-friendly.
+        unit = sp * 8
+        Tp = (T + unit - 1) // unit * unit
+        tokens = np.zeros((1, Tp), np.int32)
+        tokens[0, :T] = prompt
+        positions = np.arange(Tp, dtype=np.int32)[None, :]
+        lengths = np.asarray([T], np.int32)
+        t_arr = np.asarray([temp], np.float32)
+        p_arr = np.asarray([top_p], np.float32)
+        seed_arr = np.asarray([seed if seed is not None else 0], np.int32)
+        use_seed = np.asarray([seed is not None])
+        with self._lock:
+            if self.paged:
+                self._ensure_with_evict(slot, T)
+                write_idx = np.full((1, Tp), self._flat_size, np.int64)
+                write_idx[0, :T] = self.allocator.flat_write_indices(slot, 0, T)
+                toks, logprobs, self.cache = self._prefill_fn_paged(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lengths), jnp.asarray(write_idx),
+                    jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
+                    jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed),
+                    self._next_rng(), ring=True,
+                )
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(prompt, self.allocator.pages_of(slot))
+            else:
+                toks, logprobs, self.cache = self._prefill_fn(
+                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(lengths), jnp.asarray([slot], np.int32), jnp.asarray(t_arr),
+                    jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed),
+                    self._next_rng(), ring=True,
+                )
+            self.metrics["prefill_tokens"] += T
             self.metrics["prefill_batches"] += 1
         return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
 

@@ -51,8 +51,11 @@ class _SlotState:
 
 
 class Scheduler:
-    def __init__(self, engine: Engine):
+    def __init__(self, engine: Engine, logger=None):
+        from inference_gateway_tpu.logger import NoopLogger
+
         self.engine = engine
+        self.logger = logger or NoopLogger()
         self._waiting: deque[GenRequest] = deque()
         self._slots: dict[int, _SlotState] = {}
         self._free = list(range(engine.config.max_slots))
@@ -107,8 +110,12 @@ class Scheduler:
             # every queued and active request (advisor round-1 medium).
             try:
                 self._admit()
-            except Exception:
-                pass  # _admit failed the batch itself; loop on
+            except Exception as e:
+                # _admit's internal paths fail the affected requests
+                # themselves; reaching here means bookkeeping OUTSIDE
+                # those guards broke. Never silent (round-2 verdict
+                # weak #4): a recurring admission bug must be visible.
+                self.logger.error("scheduler admission error", e)
             if self._slots:
                 try:
                     self._decode_step()
@@ -121,17 +128,35 @@ class Scheduler:
         except Exception:
             pass
 
-    def _fail_after_decode_error(self, e: Exception) -> None:
-        """Fail the slot tagged on the exception (engine tags
-        OutOfPagesError with .slot), or — if unattributable — every
-        active slot, so clients see finish_reason "error" instead of a
-        hung stream."""
-        slot = getattr(e, "slot", None)
-        victims = [slot] if slot is not None and slot in self._slots else list(self._slots)
-        for s in victims:
-            st = self._slots.pop(s)
+    def _fail_slot(self, slot: int, reason: str = "error") -> None:
+        """Fail + release ONE slot, guarding each step: cleanup of one
+        victim must never abort cleanup of the rest or kill the
+        scheduler thread (advisor round-2: _release raising mid
+        failure-path was exactly the crash this code defends against)."""
+        st = self._slots.pop(slot, None)
+        if st is not None:
             self._fail_request(st.req)
-            self._release(s, "error")
+        try:
+            self._release(slot, reason)
+        except Exception as e:
+            self.logger.error("slot release failed", e, "slot", slot)
+
+    def _fail_after_decode_error(self, e: Exception) -> None:
+        """Fail the slot tagged on the exception (the engine tags every
+        host-side per-slot failure with .slot — OutOfPagesError and page
+        bookkeeping), or — if unattributable (a batched device error) —
+        every active slot, so clients see finish_reason "error" instead
+        of a hung stream."""
+        slot = getattr(e, "slot", None)
+        if slot is not None and slot in self._slots:
+            victims = [slot]
+            self.logger.warn("decode error attributed to slot", "slot", slot, "err", repr(e))
+        else:
+            victims = list(self._slots)
+            self.logger.error("unattributable decode error; failing batch", e,
+                              "victims", len(victims))
+        for s in victims:
+            self._fail_slot(s)
 
     def _admit(self) -> None:
         """Move waiting requests into free slots and prefill them."""

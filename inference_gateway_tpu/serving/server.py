@@ -41,10 +41,13 @@ class SidecarServer:
                  served_model_name: str | None = None, logger: Logger | None = None,
                  metrics_push_url: str | None = None, metrics_push_interval: float = 15.0):
         self.engine = engine
-        self.scheduler = scheduler or Scheduler(engine)
+        self.logger = logger or new_logger()
+        # The scheduler's failure paths log through this logger —
+        # without it a recurring _admit/_release bug would be invisible
+        # in the deployed sidecar (round-3 review finding).
+        self.scheduler = scheduler or Scheduler(engine, logger=self.logger)
         self._own_scheduler = scheduler is None
         self.model_name = served_model_name or engine.config.model
-        self.logger = logger or new_logger()
         self.created = int(time.time())
         self._started = time.monotonic()
         self.router = self._build_router()

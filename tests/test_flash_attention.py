@@ -119,10 +119,12 @@ def test_forward_flash_dispatch_equivalence(monkeypatch):
         out, _ = llama.forward(params, cfg, tokens, positions, lengths, mode="prefill")
         return np.asarray(out)
 
-    monkeypatch.setenv("IG_TPU_FLASH", "0")
+    from inference_gateway_tpu.ops import flash_attention as fa_mod
+
+    monkeypatch.setattr(fa_mod, "FORCE_FLASH", "0")
     llama.forward.clear_cache()
     ref = run()
-    monkeypatch.setenv("IG_TPU_FLASH", "1")
+    monkeypatch.setattr(fa_mod, "FORCE_FLASH", "1")
     llama.forward.clear_cache()
     got = run()
     llama.forward.clear_cache()

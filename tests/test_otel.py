@@ -228,3 +228,55 @@ async def test_telemetry_middleware_records_usage(telemetry_gateway, aloop):
     assert 'gen_ai_provider_name="ollama"' in text
     assert 'gen_ai_request_model="ollama/fake"' in text
     await upstream.shutdown()
+
+
+async def test_streaming_usage_scan_survives_block_split_lines():
+    """A `data:` usage line split across raw transport blocks must still
+    be parsed — the relay yields blocks, not lines (advisor round-2:
+    telemetry scans joined window, not per-block)."""
+    from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware
+    from inference_gateway_tpu.netio.server import Request, StreamingResponse
+
+    class FakeOtel:
+        def __init__(self):
+            self.usage = None
+            self.tools = []
+
+        def record_request_duration(self, *a):
+            pass
+
+        def record_token_usage(self, source, team, provider, model, p, c):
+            self.usage = (p, c)
+
+        def record_tool_call(self, source, team, provider, model, kind, name):
+            self.tools.append(name)
+
+    usage_chunk = (
+        b'data: {"choices":[],"usage":{"prompt_tokens":11,"completion_tokens":5}}\n\n'
+        b"data: [DONE]\n\n"
+    )
+    # Split the final usage frame mid-JSON across two blocks.
+    blocks = [
+        b'data: {"choices":[{"delta":{"content":"hi"}}]}\n\n',
+        usage_chunk[:30],
+        usage_chunk[30:],
+    ]
+
+    async def stream():
+        for b in blocks:
+            yield b
+
+    async def handler(req):
+        return StreamingResponse.sse(stream())
+
+    otel = FakeOtel()
+    mw = telemetry_middleware(otel)
+    from inference_gateway_tpu.netio.server import Headers
+    req = Request(method="POST", path="/v1/chat/completions", query={},
+                  headers=Headers(), body=b'{"model":"ollama/fake"}')
+    resp = await mw(req, handler)
+    got = b""
+    async for chunk in resp.chunks:
+        got += chunk
+    assert got == b"".join(blocks)  # client bytes untouched
+    assert otel.usage == (11, 5)

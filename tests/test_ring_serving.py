@@ -1,0 +1,130 @@
+"""Ring attention IN THE SERVING PATH (round-2 verdict next #3).
+
+Round 2 left ops/ring_attention.py exact-but-serving-dead; these tests
+prove the engine now serves prompts beyond the largest bucket through
+sequence-parallel ring prefill — model-level logits parity, engine-level
+token parity vs the single-device engine, and the paged-pool
+composition — on the virtual 8-device CPU mesh (conftest).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from inference_gateway_tpu.models import llama
+from inference_gateway_tpu.parallel.mesh import create_mesh
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+
+
+def test_forward_ring_matches_dense_prefill_logits():
+    """llama.forward(ring_mesh=...) == llama.forward() on the same fresh
+    prefill inputs: the ring is numerically the same attention."""
+    cfg = llama.PRESETS["test-tiny"]
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    mesh = create_mesh(dp=1, sp=4, tp=2)
+    rng = np.random.default_rng(1)
+    B, T = 2, 64
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size - 1, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lengths = jnp.asarray([T, 40], jnp.int32)
+
+    ref, _ = llama.forward(params, cfg, tokens, positions, lengths, mode="prefill")
+    with jax.sharding.set_mesh(mesh):
+        got, _ = llama.forward(params, cfg, tokens, positions, lengths,
+                               mode="prefill", ring_mesh=mesh)
+    ref, got = np.asarray(ref), np.asarray(got)
+    np.testing.assert_allclose(got[0], ref[0], rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got[1, :40], ref[1, :40], rtol=2e-5, atol=2e-5)
+
+
+def _greedy_tokens(engine, prompt, n=6):
+    res = engine.prefill([prompt], [0], [0.0], [1.0])[0]
+    out = [res.first_token]
+    S = engine.config.max_slots
+    tokens = np.zeros((S,), np.int32)
+    positions = np.zeros((S,), np.int32)
+    active = np.zeros((S,), bool)
+    tokens[0] = res.first_token
+    positions[0] = len(prompt)
+    active[0] = True
+    temps = np.zeros((S,), np.float32)
+    tps = np.ones((S,), np.float32)
+    chunk = engine.config.decode_chunk
+    done = 0
+    while done < n:
+        toks, _ = engine.decode_chunk(tokens, positions, active, temps, tps)
+        for j in range(toks.shape[0]):
+            out.append(int(toks[j, 0]))
+            done += 1
+            if done >= n:
+                break
+        positions[0] += chunk
+        tokens[0] = toks[-1, 0]
+    engine.release_slot(0)
+    return out[: n + 1]
+
+
+def test_engine_serves_over_bucket_prompt_via_ring_dense():
+    """A prompt longer than the largest bucket prefills through the sp
+    ring on the mesh engine and matches the single-device dense engine
+    (which buckets it normally) token for token."""
+    rng = np.random.default_rng(2)
+    prompt = [int(x) for x in rng.integers(1, 250, 100)]  # > bucket 64
+
+    common = dict(model="test-tiny", max_slots=2, max_seq_len=256, dtype="float32",
+                  max_prefill_batch=1, decode_chunk=2)
+    single = Engine(EngineConfig(**common, use_mesh=False,
+                                 prefill_buckets=(64, 128)))  # 100 fits bucket 128
+    meshed = Engine(EngineConfig(**common, use_mesh=True,
+                                 mesh_shape={"dp": 1, "sp": 4, "tp": 2},
+                                 prefill_buckets=(16, 32, 64)))  # 100 > 64 -> ring
+    assert meshed.mesh is not None and meshed.mesh.shape["sp"] == 4
+
+    want = _greedy_tokens(single, prompt)
+    got = _greedy_tokens(meshed, prompt)
+    assert got == want, f"ring-serving divergence: {got} vs {want}"
+
+
+def test_engine_serves_over_bucket_prompt_via_ring_paged():
+    """Same, composing with the paged pool: pages are reserved up front,
+    ring writes flow through write_idx, decode reads them back."""
+    rng = np.random.default_rng(3)
+    prompt = [int(x) for x in rng.integers(1, 250, 100)]
+
+    common = dict(model="test-tiny", max_slots=2, max_seq_len=256, dtype="float32",
+                  max_prefill_batch=1, decode_chunk=2)
+    single = Engine(EngineConfig(**common, use_mesh=False,
+                                 prefill_buckets=(64, 128)))
+    meshed = Engine(EngineConfig(**common, use_mesh=True,
+                                 mesh_shape={"dp": 1, "sp": 4, "tp": 2},
+                                 prefill_buckets=(16, 32, 64),
+                                 attention="paged", page_size=16))
+    assert meshed.paged
+
+    want = _greedy_tokens(single, prompt)
+    got = _greedy_tokens(meshed, prompt)
+    assert got == want, f"ring+paged divergence: {got} vs {want}"
+
+
+def test_ring_respects_prompt_length_masking():
+    """Padding rows (prompt padded to a multiple of sp*8) must not leak
+    into attention: two prompts identical except trailing garbage beyond
+    the length produce identical first tokens."""
+    cfg = llama.PRESETS["test-tiny"]
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    mesh = create_mesh(dp=1, sp=4, tp=2)
+    rng = np.random.default_rng(4)
+    T = 96
+    base = jnp.asarray(rng.integers(1, 250, (1, T)), jnp.int32)
+    dirty = base.at[0, 80:].set(7)  # garbage beyond length 80
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    lengths = jnp.asarray([80], jnp.int32)
+    with jax.sharding.set_mesh(mesh):
+        a, _ = llama.forward(params, cfg, base, positions, lengths,
+                             mode="prefill", ring_mesh=mesh, last_only=True)
+        b, _ = llama.forward(params, cfg, dirty, positions, lengths,
+                             mode="prefill", ring_mesh=mesh, last_only=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)

@@ -134,3 +134,149 @@ def test_prefix_cache_rejects_digest_match_with_different_tokens():
     pc._entries[digest] = (page, (9, 9, 9, 9))
     pages, matched = pc.match(list(prompt))
     assert matched == 0 and pages == []
+
+
+# ---------------------------------------------------------------------------
+# Round-3 (verdict next #7): per-slot failure attribution under random
+# engine-injected faults — non-culprit requests must all complete.
+# ---------------------------------------------------------------------------
+class _SlotFault(Exception):
+    """Engine-raised error carrying the offending slot (like
+    OutOfPagesError after engine tagging)."""
+
+    def __init__(self, slot):
+        super().__init__(f"injected fault for slot {slot}")
+        self.slot = slot
+
+
+def test_random_slot_faults_fail_only_culprits():
+    cfg = EngineConfig(model="test-tiny", max_slots=8, max_seq_len=64, dtype="float32",
+                       max_prefill_batch=4, use_mesh=False, attention="dense",
+                       decode_chunk=2, prefill_buckets=(16, 32, 64))
+    eng = Engine(cfg)
+
+    rng = np.random.default_rng(7)
+    orig_decode_chunk = eng.decode_chunk
+    state = {"calls": 0}
+
+    def flaky_decode_chunk(tokens, positions, active, temps, top_ps, **kw):
+        state["calls"] += 1
+        # Every few chunks, blame a random active slot (attributable).
+        if state["calls"] % 5 == 3:
+            live = np.flatnonzero(active)
+            if live.size:
+                raise _SlotFault(int(rng.choice(live)))
+        return orig_decode_chunk(tokens, positions, active, temps, top_ps, **kw)
+
+    eng.decode_chunk = flaky_decode_chunk
+    s = Scheduler(eng)
+    s.start()
+    try:
+        results: "queue.Queue[tuple]" = queue.Queue()
+        N = 200
+
+        def cb_factory(tag):
+            def cb(tok, lp, fin, reason):
+                if fin:
+                    results.put((tag, reason))
+            return cb
+
+        for i in range(N):
+            s.submit(GenRequest(prompt_ids=[1 + (i % 5), 2, 3], max_tokens=6,
+                                callback=cb_factory(i)))
+        got = {}
+        for _ in range(N):
+            tag, reason = results.get(timeout=120)
+            got[tag] = reason
+        # Every request finished (none hung), and the scheduler survived.
+        assert len(got) == N
+        errored = sum(1 for r in got.values() if r == "error")
+        completed = sum(1 for r in got.values() if r in ("stop", "length"))
+        assert errored + completed == N
+        # Faults were attributable -> exactly one victim per fault; with a
+        # fault every 5th chunk most requests must still complete.
+        assert completed > N * 0.5, (errored, completed)
+        assert errored > 0  # faults did fire
+        # Loop still alive afterwards with the fault injector removed.
+        eng.decode_chunk = orig_decode_chunk
+        toks, reason = _collect(s, [9, 8, 7], max_tokens=4)
+        assert reason in ("stop", "length")
+        # No slot leak: all slots back in the free pool once drained.
+        deadline = time.monotonic() + 10
+        while s.active_requests() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sorted(s._free) == list(range(cfg.max_slots))
+    finally:
+        s.stop()
+
+
+def test_unattributable_fault_fails_batch_but_not_thread():
+    cfg = EngineConfig(model="test-tiny", max_slots=4, max_seq_len=64, dtype="float32",
+                       max_prefill_batch=2, use_mesh=False, attention="dense",
+                       decode_chunk=2, prefill_buckets=(16, 32, 64))
+    eng = Engine(cfg)
+    orig = eng.decode_chunk
+    state = {"armed": True}
+
+    def flaky(tokens, positions, active, temps, top_ps, **kw):
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("transient XLA error")  # no .slot attribute
+        return orig(tokens, positions, active, temps, top_ps, **kw)
+
+    eng.decode_chunk = flaky
+    s = Scheduler(eng)
+    s.start()
+    try:
+        results: "queue.Queue[str]" = queue.Queue()
+        for i in range(4):
+            s.submit(GenRequest(prompt_ids=[1, 2, 3], max_tokens=6,
+                                callback=lambda tok, lp, fin, reason: results.put(reason) if fin else None))
+        reasons = [results.get(timeout=60) for _ in range(4)]
+        # The unattributable error failed the in-flight batch...
+        assert "error" in reasons
+        # ...but the thread survived and serves new requests.
+        toks, reason = _collect(s, [4, 5], max_tokens=4)
+        assert reason in ("stop", "length")
+    finally:
+        s.stop()
+
+
+def test_release_failure_does_not_kill_cleanup_of_other_victims():
+    """advisor round-2: _release raising mid failure-path must not abort
+    the remaining victims' callbacks or kill the scheduler thread."""
+    cfg = EngineConfig(model="test-tiny", max_slots=4, max_seq_len=64, dtype="float32",
+                       max_prefill_batch=4, use_mesh=False, attention="dense",
+                       decode_chunk=2, prefill_buckets=(16, 32, 64))
+    eng = Engine(cfg)
+    orig_release = eng.release_slot
+    broken = {"armed": True}
+
+    def flaky_release(slot):
+        if broken["armed"]:
+            broken["armed"] = False
+            raise RuntimeError("release bookkeeping bug")
+        return orig_release(slot)
+
+    orig_decode = eng.decode_chunk
+
+    def fail_once(tokens, positions, active, temps, top_ps, **kw):
+        eng.decode_chunk = orig_decode
+        raise RuntimeError("unattributable")
+
+    eng.decode_chunk = fail_once
+    eng.release_slot = flaky_release
+    s = Scheduler(eng)
+    s.start()
+    try:
+        results: "queue.Queue[str]" = queue.Queue()
+        for i in range(4):
+            s.submit(GenRequest(prompt_ids=[1, 2, 3], max_tokens=4,
+                                callback=lambda tok, lp, fin, reason: results.put(reason) if fin else None))
+        reasons = [results.get(timeout=60) for _ in range(4)]
+        assert len(reasons) == 4  # every client got a terminal callback
+        eng.release_slot = orig_release
+        toks, reason = _collect(s, [4, 5], max_tokens=4)
+        assert reason in ("stop", "length")
+    finally:
+        s.stop()
