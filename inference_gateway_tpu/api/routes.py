@@ -194,6 +194,16 @@ class RouterImpl:
                 return error_json("Failed to decode request", 400)
         if not isinstance(body, dict):
             return error_json("Failed to decode request", 400)
+        # Schema validation against the generated typed surface — the
+        # reference rejects at bind time with typed errors
+        # (routes.go:599-613 binding oapi-codegen structs); malformed
+        # shapes get a 400 naming the offending fields instead of
+        # failing ad hoc deep in handler logic.
+        from inference_gateway_tpu.api.validation import validate_chat_request
+
+        problems = validate_chat_request(body)
+        if problems:
+            return error_json("Invalid request: " + "; ".join(problems), 400)
 
         original_model = body.get("model") or ""
         model = original_model
@@ -287,6 +297,12 @@ class RouterImpl:
             parsed = json.loads(req.body)
         except ValueError:
             return messages_error(400, "invalid_request_error", "Failed to decode request")
+        from inference_gateway_tpu.api.validation import validate_messages_request
+
+        problems = validate_messages_request(parsed)
+        if problems:
+            return messages_error(400, "invalid_request_error",
+                                  "Invalid request: " + "; ".join(problems))
 
         original_model = parsed.get("model") or ""
         model = original_model

@@ -1,0 +1,1193 @@
+"""GENERATED from openapi.yaml components.schemas — do not edit.
+
+Regenerate: ``python -m inference_gateway_tpu.codegen -type Types``.
+Drift-gated by ``-type Check``. The reference generates its typed
+surface the same way (oapi-codegen -> providers/types/
+common_types.go); here payloads stay dicts and these TypedDicts +
+SCHEMAS give the typing/validation surface.
+"""
+
+from typing import Any, NotRequired, TypedDict
+
+# String enums (annotation aliases; the validator enforces values).
+Provider = str
+ProviderAuthType = str
+MessageRole = str
+FinishReason = str
+ResponseRole = str
+ResponseStatus = str
+
+# Object shapes.
+
+Endpoints = TypedDict('Endpoints', {
+    'models': 'NotRequired[str]',
+    'chat': 'NotRequired[str]',
+}, total=True)
+
+SSEvent = TypedDict('SSEvent', {
+    'event': 'NotRequired[str]',
+    'data': 'NotRequired[str]',
+    'retry': 'NotRequired[int]',
+}, total=True)
+
+Error = TypedDict('Error', {
+    'error': 'str',
+}, total=True)
+
+Pricing = TypedDict('Pricing', {
+    'prompt': 'NotRequired[str]',
+    'completion': 'NotRequired[str]',
+    'cache_read': 'NotRequired[str]',
+    'cache_write': 'NotRequired[str]',
+    'source': 'NotRequired[str]',
+    'subscription': 'NotRequired[bool]',
+}, total=True)
+
+Model = TypedDict('Model', {
+    'id': 'str',
+    'object': 'str',
+    'created': 'NotRequired[int]',
+    'owned_by': 'NotRequired[str]',
+    'served_by': 'NotRequired[Provider]',
+    'context_window': 'NotRequired[ContextWindow]',
+    'pricing': 'NotRequired[Pricing]',
+}, total=True)
+
+ListModelsResponse = TypedDict('ListModelsResponse', {
+    'provider': 'NotRequired[Provider]',
+    'object': 'str',
+    'data': 'list[Model]',
+}, total=True)
+
+ImageURL = TypedDict('ImageURL', {
+    'url': 'str',
+    'detail': 'NotRequired[str]',
+}, total=True)
+
+TextContentPart = TypedDict('TextContentPart', {
+    'type': 'str',
+    'text': 'str',
+}, total=True)
+
+ImageContentPart = TypedDict('ImageContentPart', {
+    'type': 'str',
+    'image_url': 'ImageURL',
+}, total=True)
+
+Message = TypedDict('Message', {
+    'role': 'MessageRole',
+    'content': 'NotRequired[MessageContent]',
+    'reasoning': 'NotRequired[str]',
+    'reasoning_content': 'NotRequired[str]',
+    'tool_calls': 'NotRequired[list[ChatCompletionMessageToolCall]]',
+    'tool_call_id': 'NotRequired[str]',
+}, total=True)
+
+ChatCompletionMessageToolCallFunction = TypedDict('ChatCompletionMessageToolCallFunction', {
+    'name': 'str',
+    'arguments': 'str',
+}, total=True)
+
+ChatCompletionMessageToolCall = TypedDict('ChatCompletionMessageToolCall', {
+    'id': 'str',
+    'type': 'str',
+    'function': 'ChatCompletionMessageToolCallFunction',
+}, total=True)
+
+FunctionObject = TypedDict('FunctionObject', {
+    'name': 'str',
+    'description': 'NotRequired[str]',
+    'parameters': 'NotRequired[FunctionParameters]',
+    'strict': 'NotRequired[bool]',
+}, total=True)
+
+ChatCompletionTool = TypedDict('ChatCompletionTool', {
+    'type': 'str',
+    'function': 'FunctionObject',
+}, total=True)
+
+ChatCompletionNamedToolChoice = TypedDict('ChatCompletionNamedToolChoice', {
+    'type': 'str',
+    'function': 'dict[str, Any]',
+}, total=True)
+
+ChatCompletionStreamOptions = TypedDict('ChatCompletionStreamOptions', {
+    'include_usage': 'NotRequired[bool]',
+}, total=True)
+
+ResponseFormatText = TypedDict('ResponseFormatText', {
+    'type': 'str',
+}, total=True)
+
+ResponseFormatJsonObject = TypedDict('ResponseFormatJsonObject', {
+    'type': 'str',
+}, total=True)
+
+ResponseFormatJsonSchema = TypedDict('ResponseFormatJsonSchema', {
+    'type': 'str',
+    'json_schema': 'dict[str, Any]',
+}, total=True)
+
+CreateChatCompletionRequest = TypedDict('CreateChatCompletionRequest', {
+    'model': 'str',
+    'messages': 'list[Message]',
+    'max_tokens': 'NotRequired[int]',
+    'max_completion_tokens': 'NotRequired[int]',
+    'temperature': 'NotRequired[float]',
+    'top_p': 'NotRequired[float]',
+    'frequency_penalty': 'NotRequired[float]',
+    'presence_penalty': 'NotRequired[float]',
+    'n': 'NotRequired[int]',
+    'stop': 'NotRequired[str | list[str]]',
+    'seed': 'NotRequired[int]',
+    'logprobs': 'NotRequired[bool]',
+    'top_logprobs': 'NotRequired[int]',
+    'response_format': 'NotRequired[ResponseFormatText | ResponseFormatJsonSchema | ResponseFormatJsonObject]',
+    'logit_bias': 'NotRequired[dict[str, Any]]',
+    'user': 'NotRequired[str]',
+    'stream': 'NotRequired[bool]',
+    'stream_options': 'NotRequired[ChatCompletionStreamOptions]',
+    'tools': 'NotRequired[list[ChatCompletionTool]]',
+    'tool_choice': 'NotRequired[ChatCompletionToolChoiceOption]',
+    'parallel_tool_calls': 'NotRequired[bool]',
+    'reasoning_format': 'NotRequired[str]',
+    'reasoning_effort': 'NotRequired[str]',
+}, total=True)
+
+CompletionUsage = TypedDict('CompletionUsage', {
+    'prompt_tokens': 'int',
+    'completion_tokens': 'int',
+    'total_tokens': 'int',
+    'completion_tokens_details': 'NotRequired[dict[str, Any]]',
+    'prompt_tokens_details': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+ChatCompletionTokenLogprob = TypedDict('ChatCompletionTokenLogprob', {
+    'token': 'str',
+    'logprob': 'float',
+    'bytes': 'NotRequired[list[int]]',
+    'top_logprobs': 'NotRequired[list[dict[str, Any]]]',
+}, total=True)
+
+ChatCompletionChoice = TypedDict('ChatCompletionChoice', {
+    'index': 'int',
+    'message': 'Message',
+    'finish_reason': 'FinishReason',
+    'logprobs': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+CreateChatCompletionResponse = TypedDict('CreateChatCompletionResponse', {
+    'id': 'str',
+    'object': 'str',
+    'created': 'int',
+    'model': 'str',
+    'system_fingerprint': 'NotRequired[str]',
+    'choices': 'list[ChatCompletionChoice]',
+    'usage': 'NotRequired[CompletionUsage]',
+}, total=True)
+
+ChatCompletionMessageToolCallChunk = TypedDict('ChatCompletionMessageToolCallChunk', {
+    'index': 'int',
+    'id': 'NotRequired[str]',
+    'type': 'NotRequired[str]',
+    'function': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+ChatCompletionStreamResponseDelta = TypedDict('ChatCompletionStreamResponseDelta', {
+    'role': 'NotRequired[MessageRole]',
+    'content': 'NotRequired[str]',
+    'reasoning': 'NotRequired[str]',
+    'reasoning_content': 'NotRequired[str]',
+    'refusal': 'NotRequired[str]',
+    'tool_calls': 'NotRequired[list[ChatCompletionMessageToolCallChunk]]',
+}, total=True)
+
+ChatCompletionStreamChoice = TypedDict('ChatCompletionStreamChoice', {
+    'index': 'int',
+    'delta': 'ChatCompletionStreamResponseDelta',
+    'finish_reason': 'NotRequired[FinishReason | None]',
+    'logprobs': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+CreateChatCompletionStreamResponse = TypedDict('CreateChatCompletionStreamResponse', {
+    'id': 'str',
+    'object': 'str',
+    'created': 'int',
+    'model': 'str',
+    'system_fingerprint': 'NotRequired[str]',
+    'choices': 'list[ChatCompletionStreamChoice]',
+    'usage': 'NotRequired[CompletionUsage | None]',
+}, total=True)
+
+ResponseInputText = TypedDict('ResponseInputText', {
+    'type': 'str',
+    'text': 'str',
+}, total=True)
+
+ResponseInputImage = TypedDict('ResponseInputImage', {
+    'type': 'str',
+    'image_url': 'NotRequired[str]',
+    'detail': 'NotRequired[str]',
+}, total=True)
+
+ResponseInputItem = TypedDict('ResponseInputItem', {
+    'type': 'NotRequired[str]',
+    'role': 'ResponseRole',
+    'content': 'str | list[ResponseInputContentPart]',
+}, total=True)
+
+ResponseTool = TypedDict('ResponseTool', {
+    'type': 'str',
+    'name': 'NotRequired[str]',
+    'description': 'NotRequired[str]',
+    'parameters': 'NotRequired[dict[str, Any]]',
+    'strict': 'NotRequired[bool]',
+}, total=True)
+
+ResponseReasoning = TypedDict('ResponseReasoning', {
+    'effort': 'NotRequired[str]',
+    'summary': 'NotRequired[str]',
+}, total=True)
+
+ResponseTextConfig = TypedDict('ResponseTextConfig', {
+    'format': 'NotRequired[ResponseFormatText | ResponseFormatJsonSchema | ResponseFormatJsonObject]',
+}, total=True)
+
+CreateResponseRequest = TypedDict('CreateResponseRequest', {
+    'model': 'str',
+    'input': 'ResponseInput',
+    'instructions': 'NotRequired[str]',
+    'max_output_tokens': 'NotRequired[int]',
+    'temperature': 'NotRequired[float]',
+    'top_p': 'NotRequired[float]',
+    'stream': 'NotRequired[bool]',
+    'store': 'NotRequired[bool]',
+    'previous_response_id': 'NotRequired[str]',
+    'tools': 'NotRequired[list[ResponseTool]]',
+    'tool_choice': 'NotRequired[ResponseToolChoice]',
+    'parallel_tool_calls': 'NotRequired[bool]',
+    'reasoning': 'NotRequired[ResponseReasoning]',
+    'text': 'NotRequired[ResponseTextConfig]',
+    'metadata': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+ResponseError = TypedDict('ResponseError', {
+    'code': 'str',
+    'message': 'str',
+}, total=True)
+
+ResponseIncompleteDetails = TypedDict('ResponseIncompleteDetails', {
+    'reason': 'NotRequired[str]',
+}, total=True)
+
+ResponseOutputText = TypedDict('ResponseOutputText', {
+    'type': 'str',
+    'text': 'str',
+    'annotations': 'NotRequired[list[dict[str, Any]]]',
+}, total=True)
+
+ResponseOutputRefusal = TypedDict('ResponseOutputRefusal', {
+    'type': 'str',
+    'refusal': 'str',
+}, total=True)
+
+ResponseOutputMessage = TypedDict('ResponseOutputMessage', {
+    'id': 'str',
+    'type': 'str',
+    'role': 'str',
+    'status': 'ResponseStatus',
+    'content': 'list[ResponseOutputContent]',
+}, total=True)
+
+ResponseFunctionToolCall = TypedDict('ResponseFunctionToolCall', {
+    'id': 'NotRequired[str]',
+    'type': 'str',
+    'call_id': 'str',
+    'name': 'str',
+    'arguments': 'str',
+    'status': 'NotRequired[ResponseStatus]',
+}, total=True)
+
+ResponseReasoningSummaryPart = TypedDict('ResponseReasoningSummaryPart', {
+    'type': 'str',
+    'text': 'str',
+}, total=True)
+
+ResponseReasoningItem = TypedDict('ResponseReasoningItem', {
+    'id': 'str',
+    'type': 'str',
+    'summary': 'list[ResponseReasoningSummaryPart]',
+    'status': 'NotRequired[ResponseStatus]',
+}, total=True)
+
+ResponseUsage = TypedDict('ResponseUsage', {
+    'input_tokens': 'int',
+    'output_tokens': 'int',
+    'total_tokens': 'int',
+    'input_tokens_details': 'NotRequired[dict[str, Any]]',
+    'output_tokens_details': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+Response = TypedDict('Response', {
+    'id': 'str',
+    'object': 'str',
+    'created_at': 'int',
+    'model': 'str',
+    'status': 'ResponseStatus',
+    'error': 'NotRequired[ResponseError | None]',
+    'incomplete_details': 'NotRequired[ResponseIncompleteDetails | None]',
+    'instructions': 'NotRequired[str]',
+    'max_output_tokens': 'NotRequired[int]',
+    'output': 'list[ResponseOutputItem]',
+    'previous_response_id': 'NotRequired[str]',
+    'temperature': 'NotRequired[float]',
+    'top_p': 'NotRequired[float]',
+    'usage': 'NotRequired[ResponseUsage]',
+    'metadata': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+ResponseStreamEvent = TypedDict('ResponseStreamEvent', {
+    'type': 'str',
+    'response': 'NotRequired[Response]',
+    'output_index': 'NotRequired[int]',
+    'content_index': 'NotRequired[int]',
+    'item_id': 'NotRequired[str]',
+    'item': 'NotRequired[ResponseOutputItem]',
+    'delta': 'NotRequired[str]',
+    'text': 'NotRequired[str]',
+    'error': 'NotRequired[ResponseError]',
+}, total=True)
+
+CacheControl = TypedDict('CacheControl', {
+    'type': 'str',
+    'ttl': 'NotRequired[str]',
+}, total=True)
+
+MessagesTextBlock = TypedDict('MessagesTextBlock', {
+    'type': 'str',
+    'text': 'str',
+    'cache_control': 'NotRequired[CacheControl]',
+}, total=True)
+
+MessagesImageSource = TypedDict('MessagesImageSource', {
+    'type': 'str',
+    'media_type': 'NotRequired[str]',
+    'data': 'NotRequired[str]',
+    'url': 'NotRequired[str]',
+}, total=True)
+
+MessagesImageBlock = TypedDict('MessagesImageBlock', {
+    'type': 'str',
+    'source': 'MessagesImageSource',
+    'cache_control': 'NotRequired[CacheControl]',
+}, total=True)
+
+MessagesDocumentSource = TypedDict('MessagesDocumentSource', {
+    'type': 'str',
+    'media_type': 'NotRequired[str]',
+    'data': 'NotRequired[str]',
+    'url': 'NotRequired[str]',
+}, total=True)
+
+MessagesDocumentBlock = TypedDict('MessagesDocumentBlock', {
+    'type': 'str',
+    'source': 'MessagesDocumentSource',
+    'title': 'NotRequired[str]',
+    'context': 'NotRequired[str]',
+    'cache_control': 'NotRequired[CacheControl]',
+}, total=True)
+
+MessagesToolUseBlock = TypedDict('MessagesToolUseBlock', {
+    'type': 'str',
+    'id': 'str',
+    'name': 'str',
+    'input': 'dict[str, Any]',
+    'cache_control': 'NotRequired[CacheControl]',
+}, total=True)
+
+MessagesToolResultBlock = TypedDict('MessagesToolResultBlock', {
+    'type': 'str',
+    'tool_use_id': 'str',
+    'is_error': 'NotRequired[bool]',
+    'content': 'NotRequired[str | list[MessagesTextBlock | MessagesImageBlock]]',
+    'cache_control': 'NotRequired[CacheControl]',
+}, total=True)
+
+MessagesThinkingBlock = TypedDict('MessagesThinkingBlock', {
+    'type': 'str',
+    'thinking': 'str',
+    'signature': 'str',
+}, total=True)
+
+MessagesRedactedThinkingBlock = TypedDict('MessagesRedactedThinkingBlock', {
+    'type': 'str',
+    'data': 'str',
+}, total=True)
+
+MessagesMessage = TypedDict('MessagesMessage', {
+    'role': 'str',
+    'content': 'str | list[MessagesRequestContentBlock]',
+}, total=True)
+
+MessagesTool = TypedDict('MessagesTool', {
+    'name': 'str',
+    'description': 'NotRequired[str]',
+    'input_schema': 'dict[str, Any]',
+    'cache_control': 'NotRequired[CacheControl]',
+}, total=True)
+
+MessagesToolChoice = TypedDict('MessagesToolChoice', {
+    'type': 'str',
+    'name': 'NotRequired[str]',
+    'disable_parallel_tool_use': 'NotRequired[bool]',
+}, total=True)
+
+MessagesMetadata = TypedDict('MessagesMetadata', {
+    'user_id': 'NotRequired[str]',
+}, total=True)
+
+CreateMessagesRequest = TypedDict('CreateMessagesRequest', {
+    'model': 'str',
+    'max_tokens': 'int',
+    'system': 'NotRequired[str | list[MessagesTextBlock]]',
+    'messages': 'list[MessagesMessage]',
+    'tools': 'NotRequired[list[MessagesTool]]',
+    'tool_choice': 'NotRequired[MessagesToolChoice]',
+    'stream': 'NotRequired[bool]',
+    'temperature': 'NotRequired[float]',
+    'top_p': 'NotRequired[float]',
+    'top_k': 'NotRequired[int]',
+    'stop_sequences': 'NotRequired[list[str]]',
+    'metadata': 'NotRequired[MessagesMetadata]',
+    'thinking': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+MessagesUsage = TypedDict('MessagesUsage', {
+    'input_tokens': 'int',
+    'output_tokens': 'int',
+    'cache_creation_input_tokens': 'NotRequired[int]',
+    'cache_read_input_tokens': 'NotRequired[int]',
+}, total=True)
+
+MessagesResponse = TypedDict('MessagesResponse', {
+    'id': 'str',
+    'type': 'str',
+    'role': 'str',
+    'content': 'list[MessagesResponseContentBlock]',
+    'model': 'str',
+    'stop_reason': 'str',
+    'stop_sequence': 'NotRequired[str | None]',
+    'usage': 'MessagesUsage',
+}, total=True)
+
+MessagesError = TypedDict('MessagesError', {
+    'type': 'str',
+    'error': 'dict[str, Any]',
+}, total=True)
+
+MessagesStreamEvent = TypedDict('MessagesStreamEvent', {
+    'type': 'str',
+    'message': 'NotRequired[MessagesResponse]',
+    'index': 'NotRequired[int]',
+    'content_block': 'NotRequired[MessagesResponseContentBlock]',
+    'delta': 'NotRequired[dict[str, Any]]',
+    'usage': 'NotRequired[MessagesUsage]',
+    'error': 'NotRequired[MessagesError]',
+}, total=True)
+
+MCPTool = TypedDict('MCPTool', {
+    'name': 'str',
+    'description': 'NotRequired[str]',
+    'server': 'NotRequired[str]',
+    'input_schema': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
+ListToolsResponse = TypedDict('ListToolsResponse', {
+    'object': 'str',
+    'data': 'list[MCPTool]',
+}, total=True)
+
+
+# Raw schema trees for runtime validation (api/validation.py).
+SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
+              'enum': ['anthropic',
+                       'cloudflare',
+                       'cohere',
+                       'deepseek',
+                       'google',
+                       'groq',
+                       'llamacpp',
+                       'minimax',
+                       'mistral',
+                       'moonshot',
+                       'nvidia',
+                       'ollama',
+                       'ollama_cloud',
+                       'openai',
+                       'zai',
+                       'tpu']},
+ 'ProviderAuthType': {'type': 'string', 'enum': ['bearer', 'xheader', 'query', 'none']},
+ 'Endpoints': {'type': 'object',
+               'properties': {'models': {'type': 'string'}, 'chat': {'type': 'string'}}},
+ 'SSEvent': {'description': 'One server-sent event as relayed by the gateway',
+             'type': 'object',
+             'properties': {'event': {'type': 'string',
+                                      'description': 'SSE event name (message-start | '
+                                                     'stream-start | content-start | '
+                                                     'content-delta | content-end | '
+                                                     'message-end | stream-end)'},
+                            'data': {'type': 'string',
+                                     'description': 'Raw data payload of the frame'},
+                            'retry': {'type': 'integer'}}},
+ 'Error': {'type': 'object',
+           'required': ['error'],
+           'properties': {'error': {'type': 'string'}}},
+ 'ContextWindow': {'type': 'integer',
+                   'description': 'Effective context window in tokens (runtime > provider > '
+                                  'community tier)'},
+ 'Pricing': {'type': 'object',
+             'properties': {'prompt': {'type': 'string',
+                                       'description': 'USD per prompt token (decimal string)'},
+                            'completion': {'type': 'string',
+                                           'description': 'USD per completion token (decimal '
+                                                          'string)'},
+                            'cache_read': {'type': 'string',
+                                           'description': 'USD per cached-prompt-token read'},
+                            'cache_write': {'type': 'string',
+                                            'description': 'USD per cached-prompt-token write'},
+                            'source': {'type': 'string', 'enum': ['provider', 'community']},
+                            'subscription': {'type': 'boolean',
+                                             'description': 'Zero-rate but gated behind a paid '
+                                                            'subscription'}}},
+ 'Model': {'type': 'object',
+           'required': ['id', 'object'],
+           'properties': {'id': {'type': 'string'},
+                          'object': {'type': 'string'},
+                          'created': {'type': 'integer'},
+                          'owned_by': {'type': 'string'},
+                          'served_by': {'$ref': '#/components/schemas/Provider'},
+                          'context_window': {'$ref': '#/components/schemas/ContextWindow'},
+                          'pricing': {'$ref': '#/components/schemas/Pricing'}}},
+ 'ListModelsResponse': {'type': 'object',
+                        'required': ['object', 'data'],
+                        'properties': {'provider': {'$ref': '#/components/schemas/Provider'},
+                                       'object': {'type': 'string'},
+                                       'data': {'type': 'array',
+                                                'items': {'$ref': '#/components/schemas/Model'}}}},
+ 'MessageRole': {'type': 'string',
+                 'enum': ['system', 'user', 'assistant', 'tool', 'developer', 'function']},
+ 'ImageURL': {'type': 'object',
+              'required': ['url'],
+              'properties': {'url': {'type': 'string'},
+                             'detail': {'type': 'string', 'enum': ['auto', 'low', 'high']}}},
+ 'TextContentPart': {'type': 'object',
+                     'required': ['type', 'text'],
+                     'properties': {'type': {'type': 'string', 'const': 'text'},
+                                    'text': {'type': 'string'}}},
+ 'ImageContentPart': {'type': 'object',
+                      'required': ['type', 'image_url'],
+                      'properties': {'type': {'type': 'string', 'const': 'image_url'},
+                                     'image_url': {'$ref': '#/components/schemas/ImageURL'}}},
+ 'MessageContentPart': {'oneOf': [{'$ref': '#/components/schemas/TextContentPart'},
+                                  {'$ref': '#/components/schemas/ImageContentPart'}]},
+ 'MessageContent': {'description': 'String or typed multimodal parts',
+                    'oneOf': [{'type': 'string'},
+                              {'type': 'array',
+                               'items': {'$ref': '#/components/schemas/MessageContentPart'}}]},
+ 'Message': {'type': 'object',
+             'required': ['role'],
+             'properties': {'role': {'$ref': '#/components/schemas/MessageRole'},
+                            'content': {'$ref': '#/components/schemas/MessageContent'},
+                            'reasoning': {'type': 'string',
+                                          'description': 'Parsed reasoning content '
+                                                         '(reasoning_format=parsed)'},
+                            'reasoning_content': {'type': 'string'},
+                            'tool_calls': {'type': 'array',
+                                           'items': {'$ref': '#/components/schemas/ChatCompletionMessageToolCall'}},
+                            'tool_call_id': {'type': 'string',
+                                             'description': 'For role=tool',
+                                             'the id of the call this message answers': None}}},
+ 'ChatCompletionMessageToolCallFunction': {'type': 'object',
+                                           'required': ['name', 'arguments'],
+                                           'properties': {'name': {'type': 'string'},
+                                                          'arguments': {'type': 'string',
+                                                                        'description': 'JSON-encoded '
+                                                                                       'argument '
+                                                                                       'object'}}},
+ 'ChatCompletionMessageToolCall': {'type': 'object',
+                                   'required': ['id', 'type', 'function'],
+                                   'properties': {'id': {'type': 'string'},
+                                                  'type': {'type': 'string',
+                                                           'const': 'function'},
+                                                  'function': {'$ref': '#/components/schemas/ChatCompletionMessageToolCallFunction'}}},
+ 'FunctionParameters': {'type': 'object',
+                        'description': "JSON-Schema object describing the function's "
+                                       'arguments'},
+ 'FunctionObject': {'type': 'object',
+                    'required': ['name'],
+                    'properties': {'name': {'type': 'string'},
+                                   'description': {'type': 'string'},
+                                   'parameters': {'$ref': '#/components/schemas/FunctionParameters'},
+                                   'strict': {'type': 'boolean'}}},
+ 'ChatCompletionTool': {'type': 'object',
+                        'required': ['type', 'function'],
+                        'properties': {'type': {'type': 'string', 'const': 'function'},
+                                       'function': {'$ref': '#/components/schemas/FunctionObject'}}},
+ 'ChatCompletionNamedToolChoice': {'type': 'object',
+                                   'required': ['type', 'function'],
+                                   'properties': {'type': {'type': 'string',
+                                                           'const': 'function'},
+                                                  'function': {'type': 'object',
+                                                               'required': ['name'],
+                                                               'properties': {'name': {'type': 'string'}}}}},
+ 'ChatCompletionToolChoiceOption': {'oneOf': [{'type': 'string',
+                                               'enum': ['none', 'auto', 'required']},
+                                              {'$ref': '#/components/schemas/ChatCompletionNamedToolChoice'}]},
+ 'ChatCompletionStreamOptions': {'type': 'object',
+                                 'properties': {'include_usage': {'type': 'boolean'}}},
+ 'ResponseFormatText': {'type': 'object',
+                        'required': ['type'],
+                        'properties': {'type': {'type': 'string', 'const': 'text'}}},
+ 'ResponseFormatJsonObject': {'type': 'object',
+                              'required': ['type'],
+                              'properties': {'type': {'type': 'string',
+                                                      'const': 'json_object'}}},
+ 'ResponseFormatJsonSchemaSchema': {'type': 'object',
+                                    'description': 'The JSON Schema the output must conform '
+                                                   'to'},
+ 'ResponseFormatJsonSchema': {'type': 'object',
+                              'required': ['type', 'json_schema'],
+                              'properties': {'type': {'type': 'string', 'const': 'json_schema'},
+                                             'json_schema': {'type': 'object',
+                                                             'required': ['name'],
+                                                             'properties': {'name': {'type': 'string'},
+                                                                            'description': {'type': 'string'},
+                                                                            'schema': {'$ref': '#/components/schemas/ResponseFormatJsonSchemaSchema'},
+                                                                            'strict': {'type': 'boolean'}}}}},
+ 'CreateChatCompletionRequest': {'type': 'object',
+                                 'required': ['model', 'messages'],
+                                 'properties': {'model': {'type': 'string'},
+                                                'messages': {'type': 'array',
+                                                             'minItems': 1,
+                                                             'items': {'$ref': '#/components/schemas/Message'}},
+                                                'max_tokens': {'type': 'integer',
+                                                               'description': 'Deprecated in '
+                                                                              'favor of '
+                                                                              'max_completion_tokens'},
+                                                'max_completion_tokens': {'type': 'integer'},
+                                                'temperature': {'type': 'number',
+                                                                'minimum': 0,
+                                                                'maximum': 2},
+                                                'top_p': {'type': 'number',
+                                                          'minimum': 0,
+                                                          'maximum': 1},
+                                                'frequency_penalty': {'type': 'number',
+                                                                      'minimum': -2,
+                                                                      'maximum': 2},
+                                                'presence_penalty': {'type': 'number',
+                                                                     'minimum': -2,
+                                                                     'maximum': 2},
+                                                'n': {'type': 'integer',
+                                                      'minimum': 1,
+                                                      'maximum': 128},
+                                                'stop': {'oneOf': [{'type': 'string'},
+                                                                   {'type': 'array',
+                                                                    'items': {'type': 'string'},
+                                                                    'minItems': 1,
+                                                                    'maxItems': 4}]},
+                                                'seed': {'type': 'integer'},
+                                                'logprobs': {'type': 'boolean'},
+                                                'top_logprobs': {'type': 'integer',
+                                                                 'minimum': 0,
+                                                                 'maximum': 20},
+                                                'response_format': {'oneOf': [{'$ref': '#/components/schemas/ResponseFormatText'},
+                                                                              {'$ref': '#/components/schemas/ResponseFormatJsonSchema'},
+                                                                              {'$ref': '#/components/schemas/ResponseFormatJsonObject'}]},
+                                                'logit_bias': {'type': 'object',
+                                                               'additionalProperties': {'type': 'integer'}},
+                                                'user': {'type': 'string'},
+                                                'stream': {'type': 'boolean'},
+                                                'stream_options': {'$ref': '#/components/schemas/ChatCompletionStreamOptions'},
+                                                'tools': {'type': 'array',
+                                                          'items': {'$ref': '#/components/schemas/ChatCompletionTool'}},
+                                                'tool_choice': {'$ref': '#/components/schemas/ChatCompletionToolChoiceOption'},
+                                                'parallel_tool_calls': {'type': 'boolean'},
+                                                'reasoning_format': {'type': 'string',
+                                                                     'description': 'raw | '
+                                                                                    'parsed'},
+                                                'reasoning_effort': {'type': 'string',
+                                                                     'enum': ['minimal',
+                                                                              'low',
+                                                                              'medium',
+                                                                              'high']}}},
+ 'CompletionUsage': {'type': 'object',
+                     'required': ['prompt_tokens', 'completion_tokens', 'total_tokens'],
+                     'properties': {'prompt_tokens': {'type': 'integer'},
+                                    'completion_tokens': {'type': 'integer'},
+                                    'total_tokens': {'type': 'integer'},
+                                    'completion_tokens_details': {'type': 'object',
+                                                                  'properties': {'accepted_prediction_tokens': {'type': 'integer'},
+                                                                                 'audio_tokens': {'type': 'integer'},
+                                                                                 'reasoning_tokens': {'type': 'integer'},
+                                                                                 'rejected_prediction_tokens': {'type': 'integer'}}},
+                                    'prompt_tokens_details': {'type': 'object',
+                                                              'properties': {'audio_tokens': {'type': 'integer'},
+                                                                             'cached_tokens': {'type': 'integer'}}}}},
+ 'ChatCompletionTokenLogprob': {'type': 'object',
+                                'required': ['token', 'logprob'],
+                                'properties': {'token': {'type': 'string'},
+                                               'logprob': {'type': 'number'},
+                                               'bytes': {'type': 'array',
+                                                         'items': {'type': 'integer'}},
+                                               'top_logprobs': {'type': 'array',
+                                                                'items': {'type': 'object',
+                                                                          'properties': {'token': {'type': 'string'},
+                                                                                         'logprob': {'type': 'number'},
+                                                                                         'bytes': {'type': 'array',
+                                                                                                   'items': {'type': 'integer'}}}}}}},
+ 'FinishReason': {'type': 'string',
+                  'enum': ['stop', 'length', 'tool_calls', 'content_filter', 'function_call']},
+ 'ChatCompletionChoice': {'type': 'object',
+                          'required': ['index', 'message', 'finish_reason'],
+                          'properties': {'index': {'type': 'integer'},
+                                         'message': {'$ref': '#/components/schemas/Message'},
+                                         'finish_reason': {'$ref': '#/components/schemas/FinishReason'},
+                                         'logprobs': {'type': 'object',
+                                                      'properties': {'content': {'type': 'array',
+                                                                                 'items': {'$ref': '#/components/schemas/ChatCompletionTokenLogprob'}}}}}},
+ 'CreateChatCompletionResponse': {'type': 'object',
+                                  'required': ['id', 'object', 'created', 'model', 'choices'],
+                                  'properties': {'id': {'type': 'string'},
+                                                 'object': {'type': 'string',
+                                                            'const': 'chat.completion'},
+                                                 'created': {'type': 'integer'},
+                                                 'model': {'type': 'string'},
+                                                 'system_fingerprint': {'type': 'string'},
+                                                 'choices': {'type': 'array',
+                                                             'items': {'$ref': '#/components/schemas/ChatCompletionChoice'}},
+                                                 'usage': {'$ref': '#/components/schemas/CompletionUsage'}}},
+ 'ChatCompletionMessageToolCallChunk': {'type': 'object',
+                                        'required': ['index'],
+                                        'properties': {'index': {'type': 'integer'},
+                                                       'id': {'type': 'string'},
+                                                       'type': {'type': 'string',
+                                                                'const': 'function'},
+                                                       'function': {'type': 'object',
+                                                                    'properties': {'name': {'type': 'string'},
+                                                                                   'arguments': {'type': 'string'}}}}},
+ 'ChatCompletionStreamResponseDelta': {'type': 'object',
+                                       'properties': {'role': {'$ref': '#/components/schemas/MessageRole'},
+                                                      'content': {'type': 'string'},
+                                                      'reasoning': {'type': 'string'},
+                                                      'reasoning_content': {'type': 'string'},
+                                                      'refusal': {'type': 'string'},
+                                                      'tool_calls': {'type': 'array',
+                                                                     'items': {'$ref': '#/components/schemas/ChatCompletionMessageToolCallChunk'}}}},
+ 'ChatCompletionStreamChoice': {'type': 'object',
+                                'required': ['index', 'delta'],
+                                'properties': {'index': {'type': 'integer'},
+                                               'delta': {'$ref': '#/components/schemas/ChatCompletionStreamResponseDelta'},
+                                               'finish_reason': {'oneOf': [{'$ref': '#/components/schemas/FinishReason'},
+                                                                           {'type': 'null'}]},
+                                               'logprobs': {'type': 'object',
+                                                            'properties': {'content': {'type': 'array',
+                                                                                       'items': {'$ref': '#/components/schemas/ChatCompletionTokenLogprob'}}}}}},
+ 'CreateChatCompletionStreamResponse': {'type': 'object',
+                                        'required': ['id',
+                                                     'object',
+                                                     'created',
+                                                     'model',
+                                                     'choices'],
+                                        'properties': {'id': {'type': 'string'},
+                                                       'object': {'type': 'string',
+                                                                  'const': 'chat.completion.chunk'},
+                                                       'created': {'type': 'integer'},
+                                                       'model': {'type': 'string'},
+                                                       'system_fingerprint': {'type': 'string'},
+                                                       'choices': {'type': 'array',
+                                                                   'items': {'$ref': '#/components/schemas/ChatCompletionStreamChoice'}},
+                                                       'usage': {'oneOf': [{'$ref': '#/components/schemas/CompletionUsage'},
+                                                                           {'type': 'null'}]}}},
+ 'ResponseRole': {'type': 'string', 'enum': ['user', 'assistant', 'system', 'developer']},
+ 'ResponseInputText': {'type': 'object',
+                       'required': ['type', 'text'],
+                       'properties': {'type': {'type': 'string', 'const': 'input_text'},
+                                      'text': {'type': 'string'}}},
+ 'ResponseInputImage': {'type': 'object',
+                        'required': ['type'],
+                        'properties': {'type': {'type': 'string', 'const': 'input_image'},
+                                       'image_url': {'type': 'string'},
+                                       'detail': {'type': 'string',
+                                                  'enum': ['auto', 'low', 'high']}}},
+ 'ResponseInputContentPart': {'oneOf': [{'$ref': '#/components/schemas/ResponseInputText'},
+                                        {'$ref': '#/components/schemas/ResponseInputImage'}]},
+ 'ResponseInputItem': {'type': 'object',
+                       'required': ['role', 'content'],
+                       'properties': {'type': {'type': 'string', 'const': 'message'},
+                                      'role': {'$ref': '#/components/schemas/ResponseRole'},
+                                      'content': {'oneOf': [{'type': 'string'},
+                                                            {'type': 'array',
+                                                             'items': {'$ref': '#/components/schemas/ResponseInputContentPart'}}]}}},
+ 'ResponseInput': {'oneOf': [{'type': 'string'},
+                             {'type': 'array',
+                              'items': {'$ref': '#/components/schemas/ResponseInputItem'}}]},
+ 'ResponseTool': {'type': 'object',
+                  'required': ['type'],
+                  'properties': {'type': {'type': 'string', 'const': 'function'},
+                                 'name': {'type': 'string'},
+                                 'description': {'type': 'string'},
+                                 'parameters': {'type': 'object'},
+                                 'strict': {'type': 'boolean'}}},
+ 'ResponseToolChoice': {'oneOf': [{'type': 'string', 'enum': ['none', 'auto', 'required']},
+                                  {'type': 'object',
+                                   'required': ['type'],
+                                   'properties': {'type': {'type': 'string',
+                                                           'const': 'function'},
+                                                  'name': {'type': 'string'}}}]},
+ 'ResponseReasoning': {'type': 'object',
+                       'properties': {'effort': {'type': 'string',
+                                                 'enum': ['minimal', 'low', 'medium', 'high']},
+                                      'summary': {'type': 'string',
+                                                  'enum': ['auto', 'concise', 'detailed']}}},
+ 'ResponseTextConfig': {'type': 'object',
+                        'properties': {'format': {'oneOf': [{'$ref': '#/components/schemas/ResponseFormatText'},
+                                                            {'$ref': '#/components/schemas/ResponseFormatJsonSchema'},
+                                                            {'$ref': '#/components/schemas/ResponseFormatJsonObject'}]}}},
+ 'CreateResponseRequest': {'type': 'object',
+                           'required': ['model', 'input'],
+                           'properties': {'model': {'type': 'string'},
+                                          'input': {'$ref': '#/components/schemas/ResponseInput'},
+                                          'instructions': {'type': 'string'},
+                                          'max_output_tokens': {'type': 'integer'},
+                                          'temperature': {'type': 'number'},
+                                          'top_p': {'type': 'number'},
+                                          'stream': {'type': 'boolean'},
+                                          'store': {'type': 'boolean'},
+                                          'previous_response_id': {'type': 'string'},
+                                          'tools': {'type': 'array',
+                                                    'items': {'$ref': '#/components/schemas/ResponseTool'}},
+                                          'tool_choice': {'$ref': '#/components/schemas/ResponseToolChoice'},
+                                          'parallel_tool_calls': {'type': 'boolean'},
+                                          'reasoning': {'$ref': '#/components/schemas/ResponseReasoning'},
+                                          'text': {'$ref': '#/components/schemas/ResponseTextConfig'},
+                                          'metadata': {'type': 'object',
+                                                       'additionalProperties': {'type': 'string'}}}},
+ 'ResponseStatus': {'type': 'string',
+                    'enum': ['completed',
+                             'failed',
+                             'in_progress',
+                             'cancelled',
+                             'queued',
+                             'incomplete']},
+ 'ResponseError': {'type': 'object',
+                   'required': ['code', 'message'],
+                   'properties': {'code': {'type': 'string'}, 'message': {'type': 'string'}}},
+ 'ResponseIncompleteDetails': {'type': 'object', 'properties': {'reason': {'type': 'string'}}},
+ 'ResponseOutputText': {'type': 'object',
+                        'required': ['type', 'text'],
+                        'properties': {'type': {'type': 'string', 'const': 'output_text'},
+                                       'text': {'type': 'string'},
+                                       'annotations': {'type': 'array',
+                                                       'items': {'type': 'object'}}}},
+ 'ResponseOutputRefusal': {'type': 'object',
+                           'required': ['type', 'refusal'],
+                           'properties': {'type': {'type': 'string', 'const': 'refusal'},
+                                          'refusal': {'type': 'string'}}},
+ 'ResponseOutputContent': {'oneOf': [{'$ref': '#/components/schemas/ResponseOutputText'},
+                                     {'$ref': '#/components/schemas/ResponseOutputRefusal'}]},
+ 'ResponseOutputMessage': {'type': 'object',
+                           'required': ['id', 'type', 'role', 'content', 'status'],
+                           'properties': {'id': {'type': 'string'},
+                                          'type': {'type': 'string', 'const': 'message'},
+                                          'role': {'type': 'string', 'const': 'assistant'},
+                                          'status': {'$ref': '#/components/schemas/ResponseStatus'},
+                                          'content': {'type': 'array',
+                                                      'items': {'$ref': '#/components/schemas/ResponseOutputContent'}}}},
+ 'ResponseFunctionToolCall': {'type': 'object',
+                              'required': ['type', 'call_id', 'name', 'arguments'],
+                              'properties': {'id': {'type': 'string'},
+                                             'type': {'type': 'string',
+                                                      'const': 'function_call'},
+                                             'call_id': {'type': 'string'},
+                                             'name': {'type': 'string'},
+                                             'arguments': {'type': 'string'},
+                                             'status': {'$ref': '#/components/schemas/ResponseStatus'}}},
+ 'ResponseReasoningSummaryPart': {'type': 'object',
+                                  'required': ['type', 'text'],
+                                  'properties': {'type': {'type': 'string',
+                                                          'const': 'summary_text'},
+                                                 'text': {'type': 'string'}}},
+ 'ResponseReasoningItem': {'type': 'object',
+                           'required': ['id', 'type', 'summary'],
+                           'properties': {'id': {'type': 'string'},
+                                          'type': {'type': 'string', 'const': 'reasoning'},
+                                          'summary': {'type': 'array',
+                                                      'items': {'$ref': '#/components/schemas/ResponseReasoningSummaryPart'}},
+                                          'status': {'$ref': '#/components/schemas/ResponseStatus'}}},
+ 'ResponseOutputItem': {'oneOf': [{'$ref': '#/components/schemas/ResponseOutputMessage'},
+                                  {'$ref': '#/components/schemas/ResponseFunctionToolCall'},
+                                  {'$ref': '#/components/schemas/ResponseReasoningItem'}]},
+ 'ResponseUsage': {'type': 'object',
+                   'required': ['input_tokens', 'output_tokens', 'total_tokens'],
+                   'properties': {'input_tokens': {'type': 'integer'},
+                                  'output_tokens': {'type': 'integer'},
+                                  'total_tokens': {'type': 'integer'},
+                                  'input_tokens_details': {'type': 'object',
+                                                           'properties': {'cached_tokens': {'type': 'integer'}}},
+                                  'output_tokens_details': {'type': 'object',
+                                                            'properties': {'reasoning_tokens': {'type': 'integer'}}}}},
+ 'Response': {'type': 'object',
+              'required': ['id', 'object', 'created_at', 'model', 'status', 'output'],
+              'properties': {'id': {'type': 'string'},
+                             'object': {'type': 'string', 'const': 'response'},
+                             'created_at': {'type': 'integer'},
+                             'model': {'type': 'string'},
+                             'status': {'$ref': '#/components/schemas/ResponseStatus'},
+                             'error': {'oneOf': [{'$ref': '#/components/schemas/ResponseError'},
+                                                 {'type': 'null'}]},
+                             'incomplete_details': {'oneOf': [{'$ref': '#/components/schemas/ResponseIncompleteDetails'},
+                                                              {'type': 'null'}]},
+                             'instructions': {'type': 'string'},
+                             'max_output_tokens': {'type': 'integer'},
+                             'output': {'type': 'array',
+                                        'items': {'$ref': '#/components/schemas/ResponseOutputItem'}},
+                             'previous_response_id': {'type': 'string'},
+                             'temperature': {'type': 'number'},
+                             'top_p': {'type': 'number'},
+                             'usage': {'$ref': '#/components/schemas/ResponseUsage'},
+                             'metadata': {'type': 'object',
+                                          'additionalProperties': {'type': 'string'}}}},
+ 'ResponseStreamEvent': {'type': 'object',
+                         'required': ['type'],
+                         'properties': {'type': {'type': 'string',
+                                                 'description': 'Event discriminator '
+                                                                '(response.created | '
+                                                                'response.in_progress | '
+                                                                'response.output_item.added | '
+                                                                'response.content_part.added | '
+                                                                'response.output_text.delta | '
+                                                                'response.output_text.done | '
+                                                                'response.content_part.done | '
+                                                                'response.output_item.done | '
+                                                                'response.completed | '
+                                                                'response.failed | error)'},
+                                        'response': {'$ref': '#/components/schemas/Response'},
+                                        'output_index': {'type': 'integer'},
+                                        'content_index': {'type': 'integer'},
+                                        'item_id': {'type': 'string'},
+                                        'item': {'$ref': '#/components/schemas/ResponseOutputItem'},
+                                        'delta': {'type': 'string'},
+                                        'text': {'type': 'string'},
+                                        'error': {'$ref': '#/components/schemas/ResponseError'}}},
+ 'CacheControl': {'type': 'object',
+                  'required': ['type'],
+                  'properties': {'type': {'type': 'string', 'enum': ['ephemeral']},
+                                 'ttl': {'type': 'string', 'enum': ['5m', '1h']}}},
+ 'MessagesTextBlock': {'type': 'object',
+                       'required': ['type', 'text'],
+                       'properties': {'type': {'type': 'string', 'const': 'text'},
+                                      'text': {'type': 'string'},
+                                      'cache_control': {'$ref': '#/components/schemas/CacheControl'}}},
+ 'MessagesImageSource': {'type': 'object',
+                         'required': ['type'],
+                         'properties': {'type': {'type': 'string', 'enum': ['base64', 'url']},
+                                        'media_type': {'type': 'string',
+                                                       'enum': ['image/jpeg',
+                                                                'image/png',
+                                                                'image/gif',
+                                                                'image/webp']},
+                                        'data': {'type': 'string',
+                                                 'description': 'Base64 image payload '
+                                                                '(type=base64)'},
+                                        'url': {'type': 'string',
+                                                'description': 'Image URL (type=url)'}}},
+ 'MessagesImageBlock': {'type': 'object',
+                        'required': ['type', 'source'],
+                        'properties': {'type': {'type': 'string', 'const': 'image'},
+                                       'source': {'$ref': '#/components/schemas/MessagesImageSource'},
+                                       'cache_control': {'$ref': '#/components/schemas/CacheControl'}}},
+ 'MessagesDocumentSource': {'type': 'object',
+                            'required': ['type'],
+                            'properties': {'type': {'type': 'string',
+                                                    'enum': ['base64', 'text', 'url']},
+                                           'media_type': {'type': 'string'},
+                                           'data': {'type': 'string'},
+                                           'url': {'type': 'string'}}},
+ 'MessagesDocumentBlock': {'type': 'object',
+                           'required': ['type', 'source'],
+                           'properties': {'type': {'type': 'string', 'const': 'document'},
+                                          'source': {'$ref': '#/components/schemas/MessagesDocumentSource'},
+                                          'title': {'type': 'string'},
+                                          'context': {'type': 'string'},
+                                          'cache_control': {'$ref': '#/components/schemas/CacheControl'}}},
+ 'MessagesToolUseBlock': {'type': 'object',
+                          'required': ['type', 'id', 'name', 'input'],
+                          'properties': {'type': {'type': 'string', 'const': 'tool_use'},
+                                         'id': {'type': 'string'},
+                                         'name': {'type': 'string'},
+                                         'input': {'type': 'object'},
+                                         'cache_control': {'$ref': '#/components/schemas/CacheControl'}}},
+ 'MessagesToolResultBlock': {'type': 'object',
+                             'required': ['type', 'tool_use_id'],
+                             'properties': {'type': {'type': 'string', 'const': 'tool_result'},
+                                            'tool_use_id': {'type': 'string'},
+                                            'is_error': {'type': 'boolean'},
+                                            'content': {'oneOf': [{'type': 'string'},
+                                                                  {'type': 'array',
+                                                                   'items': {'oneOf': [{'$ref': '#/components/schemas/MessagesTextBlock'},
+                                                                                       {'$ref': '#/components/schemas/MessagesImageBlock'}]}}]},
+                                            'cache_control': {'$ref': '#/components/schemas/CacheControl'}}},
+ 'MessagesThinkingBlock': {'type': 'object',
+                           'required': ['type', 'thinking', 'signature'],
+                           'properties': {'type': {'type': 'string', 'const': 'thinking'},
+                                          'thinking': {'type': 'string'},
+                                          'signature': {'type': 'string'}}},
+ 'MessagesRedactedThinkingBlock': {'type': 'object',
+                                   'required': ['type', 'data'],
+                                   'properties': {'type': {'type': 'string',
+                                                           'const': 'redacted_thinking'},
+                                                  'data': {'type': 'string'}}},
+ 'MessagesRequestContentBlock': {'oneOf': [{'$ref': '#/components/schemas/MessagesTextBlock'},
+                                           {'$ref': '#/components/schemas/MessagesImageBlock'},
+                                           {'$ref': '#/components/schemas/MessagesDocumentBlock'},
+                                           {'$ref': '#/components/schemas/MessagesToolUseBlock'},
+                                           {'$ref': '#/components/schemas/MessagesToolResultBlock'},
+                                           {'$ref': '#/components/schemas/MessagesThinkingBlock'},
+                                           {'$ref': '#/components/schemas/MessagesRedactedThinkingBlock'}]},
+ 'MessagesMessage': {'type': 'object',
+                     'required': ['role', 'content'],
+                     'properties': {'role': {'type': 'string', 'enum': ['user', 'assistant']},
+                                    'content': {'oneOf': [{'type': 'string'},
+                                                          {'type': 'array',
+                                                           'items': {'$ref': '#/components/schemas/MessagesRequestContentBlock'}}]}}},
+ 'MessagesTool': {'type': 'object',
+                  'required': ['name', 'input_schema'],
+                  'properties': {'name': {'type': 'string'},
+                                 'description': {'type': 'string'},
+                                 'input_schema': {'type': 'object',
+                                                  'description': 'JSON Schema of the tool '
+                                                                 'input'},
+                                 'cache_control': {'$ref': '#/components/schemas/CacheControl'}}},
+ 'MessagesToolChoice': {'type': 'object',
+                        'required': ['type'],
+                        'properties': {'type': {'type': 'string',
+                                                'enum': ['auto', 'any', 'tool', 'none']},
+                                       'name': {'type': 'string',
+                                                'description': 'Required when type=tool'},
+                                       'disable_parallel_tool_use': {'type': 'boolean'}}},
+ 'MessagesMetadata': {'type': 'object', 'properties': {'user_id': {'type': 'string'}}},
+ 'CreateMessagesRequest': {'type': 'object',
+                           'required': ['model', 'max_tokens', 'messages'],
+                           'properties': {'model': {'type': 'string'},
+                                          'max_tokens': {'type': 'integer'},
+                                          'system': {'oneOf': [{'type': 'string'},
+                                                               {'type': 'array',
+                                                                'items': {'$ref': '#/components/schemas/MessagesTextBlock'}}]},
+                                          'messages': {'type': 'array',
+                                                       'items': {'$ref': '#/components/schemas/MessagesMessage'}},
+                                          'tools': {'type': 'array',
+                                                    'items': {'$ref': '#/components/schemas/MessagesTool'}},
+                                          'tool_choice': {'$ref': '#/components/schemas/MessagesToolChoice'},
+                                          'stream': {'type': 'boolean'},
+                                          'temperature': {'type': 'number'},
+                                          'top_p': {'type': 'number'},
+                                          'top_k': {'type': 'integer'},
+                                          'stop_sequences': {'type': 'array',
+                                                             'items': {'type': 'string'}},
+                                          'metadata': {'$ref': '#/components/schemas/MessagesMetadata'},
+                                          'thinking': {'type': 'object',
+                                                       'required': ['type', 'budget_tokens'],
+                                                       'properties': {'type': {'type': 'string',
+                                                                               'const': 'enabled'},
+                                                                      'budget_tokens': {'type': 'integer'}}}}},
+ 'MessagesResponseContentBlock': {'oneOf': [{'$ref': '#/components/schemas/MessagesTextBlock'},
+                                            {'$ref': '#/components/schemas/MessagesToolUseBlock'},
+                                            {'$ref': '#/components/schemas/MessagesThinkingBlock'},
+                                            {'$ref': '#/components/schemas/MessagesRedactedThinkingBlock'}]},
+ 'MessagesUsage': {'type': 'object',
+                   'required': ['input_tokens', 'output_tokens'],
+                   'properties': {'input_tokens': {'type': 'integer'},
+                                  'output_tokens': {'type': 'integer'},
+                                  'cache_creation_input_tokens': {'type': 'integer'},
+                                  'cache_read_input_tokens': {'type': 'integer'}}},
+ 'MessagesResponse': {'type': 'object',
+                      'required': ['id',
+                                   'type',
+                                   'role',
+                                   'content',
+                                   'model',
+                                   'stop_reason',
+                                   'usage'],
+                      'properties': {'id': {'type': 'string'},
+                                     'type': {'type': 'string', 'const': 'message'},
+                                     'role': {'type': 'string', 'const': 'assistant'},
+                                     'content': {'type': 'array',
+                                                 'items': {'$ref': '#/components/schemas/MessagesResponseContentBlock'}},
+                                     'model': {'type': 'string'},
+                                     'stop_reason': {'type': 'string',
+                                                     'enum': ['end_turn',
+                                                              'max_tokens',
+                                                              'stop_sequence',
+                                                              'tool_use',
+                                                              'pause_turn',
+                                                              'refusal']},
+                                     'stop_sequence': {'oneOf': [{'type': 'string'},
+                                                                 {'type': 'null'}]},
+                                     'usage': {'$ref': '#/components/schemas/MessagesUsage'}}},
+ 'MessagesError': {'type': 'object',
+                   'required': ['type', 'error'],
+                   'properties': {'type': {'type': 'string', 'const': 'error'},
+                                  'error': {'type': 'object',
+                                            'required': ['type', 'message'],
+                                            'properties': {'type': {'type': 'string',
+                                                                    'description': 'invalid_request_error '
+                                                                                   '| '
+                                                                                   'authentication_error '
+                                                                                   '| '
+                                                                                   'api_error '
+                                                                                   '| '
+                                                                                   'overloaded_error'},
+                                                           'message': {'type': 'string'}}}}},
+ 'MessagesStreamEvent': {'type': 'object',
+                         'required': ['type'],
+                         'properties': {'type': {'type': 'string',
+                                                 'enum': ['message_start',
+                                                          'content_block_start',
+                                                          'content_block_delta',
+                                                          'content_block_stop',
+                                                          'message_delta',
+                                                          'message_stop',
+                                                          'ping',
+                                                          'error']},
+                                        'message': {'$ref': '#/components/schemas/MessagesResponse'},
+                                        'index': {'type': 'integer'},
+                                        'content_block': {'$ref': '#/components/schemas/MessagesResponseContentBlock'},
+                                        'delta': {'type': 'object',
+                                                  'properties': {'type': {'type': 'string',
+                                                                          'description': 'text_delta '
+                                                                                         '| '
+                                                                                         'input_json_delta '
+                                                                                         '| '
+                                                                                         'thinking_delta '
+                                                                                         '| '
+                                                                                         'signature_delta'},
+                                                                 'text': {'type': 'string'},
+                                                                 'partial_json': {'type': 'string'},
+                                                                 'thinking': {'type': 'string'},
+                                                                 'signature': {'type': 'string'},
+                                                                 'stop_reason': {'type': 'string'},
+                                                                 'stop_sequence': {'oneOf': [{'type': 'string'},
+                                                                                             {'type': 'null'}]}}},
+                                        'usage': {'$ref': '#/components/schemas/MessagesUsage'},
+                                        'error': {'$ref': '#/components/schemas/MessagesError'}}},
+ 'MCPTool': {'type': 'object',
+             'required': ['name'],
+             'properties': {'name': {'type': 'string'},
+                            'description': {'type': 'string'},
+                            'server': {'type': 'string'},
+                            'input_schema': {'type': 'object'}}},
+ 'ListToolsResponse': {'type': 'object',
+                       'required': ['object', 'data'],
+                       'properties': {'object': {'type': 'string'},
+                                      'data': {'type': 'array',
+                                               'items': {'$ref': '#/components/schemas/MCPTool'}}}}}

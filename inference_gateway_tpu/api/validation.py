@@ -1,0 +1,158 @@
+"""Request validation against the generated schema surface.
+
+The reference rejects malformed bodies at bind time with typed errors
+(gin binding against oapi-codegen structs, api/routes.go:599-613); this
+module is the dict-world equivalent: a small JSON-Schema-subset checker
+that walks ``api/types_gen.SCHEMAS`` (generated from openapi.yaml) and
+returns human-readable problem strings. Handlers turn a non-empty list
+into a 400 with the gateway's Error envelope.
+
+Supported keywords — the subset openapi.yaml actually uses: type
+(including "null"), const, enum, required, properties, items, oneOf,
+additionalProperties (schema form), minItems, maxItems, minimum,
+maximum, $ref. Unknown keywords are ignored (permissive by design:
+unknown FIELDS in requests pass through, matching the passthrough
+posture of the gateway).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from inference_gateway_tpu.api.types_gen import SCHEMAS
+
+_TYPE_CHECKS = {
+    "string": lambda v: isinstance(v, str),
+    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "number": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "boolean": lambda v: isinstance(v, bool),
+    "object": lambda v: isinstance(v, dict),
+    "array": lambda v: isinstance(v, list),
+    "null": lambda v: v is None,
+}
+
+
+def _resolve(schema: dict[str, Any]) -> dict[str, Any]:
+    while isinstance(schema, dict) and "$ref" in schema:
+        name = schema["$ref"].rsplit("/", 1)[-1]
+        schema = SCHEMAS[name]
+    return schema
+
+
+def _validate(value: Any, schema: Any, path: str, errors: list[str], depth: int = 0) -> None:
+    if not isinstance(schema, dict) or depth > 32:
+        return
+    schema = _resolve(schema)
+
+    if "oneOf" in schema:
+        branches = schema["oneOf"]
+        attempts: list[list[str]] = []
+        for branch in branches:
+            trial: list[str] = []
+            _validate(value, branch, path, trial, depth + 1)
+            if not trial:
+                return  # some branch accepts
+            attempts.append(trial)
+        # No branch matched: report the closest branch's complaints so
+        # the message stays actionable. "Closest" = fewest errors, but a
+        # branch that at least got the top-level TYPE right beats one
+        # that rejected the value outright (a {type: image_url} part
+        # should complain about its missing url, not "expected string").
+        def rank(trial: list[str]) -> tuple[int, int, int]:
+            wrong_type = any(e.startswith(f"{path}: expected ") for e in trial)
+            # A branch whose `type`/discriminator const rejected the
+            # value is the wrong variant; prefer the branch the client
+            # actually meant (its errors are about the real problem).
+            disc = f"{path}.type: must be " if path else "type: must be "
+            wrong_variant = any(e.startswith(disc) for e in trial)
+            return (1 if wrong_type else 0, 1 if wrong_variant else 0, len(trial))
+
+        best = min(attempts, key=rank) if attempts else []
+        errors.extend(best or [f"{path}: matches no allowed variant"])
+        return
+
+    t = schema.get("type")
+    if t is not None:
+        check = _TYPE_CHECKS.get(t)
+        if check is not None and not check(value):
+            errors.append(f"{path}: expected {t}, got {type(value).__name__}")
+            return
+
+    if "const" in schema and value != schema["const"]:
+        errors.append(f"{path}: must be {schema['const']!r}")
+        return
+    if "enum" in schema and value not in schema["enum"]:
+        errors.append(f"{path}: {value!r} not one of {schema['enum']}")
+        return
+
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        if "minimum" in schema and value < schema["minimum"]:
+            errors.append(f"{path}: {value} below minimum {schema['minimum']}")
+        if "maximum" in schema and value > schema["maximum"]:
+            errors.append(f"{path}: {value} above maximum {schema['maximum']}")
+
+    if isinstance(value, dict):
+        for req in schema.get("required") or ():
+            if req not in value:
+                errors.append(f"{path}.{req}: required field missing" if path else f"{req}: required field missing")
+        props = schema.get("properties") or {}
+        required = set(schema.get("required") or ())
+        for key, sub in props.items():
+            if key in value:
+                # Explicit null on an OPTIONAL field means "absent" —
+                # OpenAI's own payloads carry `"content": null` in
+                # tool-calling assistant turns and SDKs serialize unset
+                # optionals as null; rejecting them would 400 standard
+                # traffic (round-3 review finding).
+                if value[key] is None and key not in required:
+                    continue
+                _validate(value[key], sub, f"{path}.{key}" if path else key, errors, depth + 1)
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, dict):
+            for key, v in value.items():
+                if key not in props:
+                    _validate(v, addl, f"{path}.{key}" if path else key, errors, depth + 1)
+
+    if isinstance(value, list):
+        if "minItems" in schema and len(value) < schema["minItems"]:
+            errors.append(f"{path}: needs at least {schema['minItems']} item(s)")
+        if "maxItems" in schema and len(value) > schema["maxItems"]:
+            errors.append(f"{path}: at most {schema['maxItems']} item(s)")
+        items = schema.get("items")
+        if items is not None:
+            for i, v in enumerate(value):
+                _validate(v, items, f"{path}[{i}]", errors, depth + 1)
+
+
+def validate(instance: Any, schema_name: str, max_errors: int = 8) -> list[str]:
+    """Validate ``instance`` against a named schema; [] means valid."""
+    errors: list[str] = []
+    _validate(instance, {"$ref": f"#/components/schemas/{schema_name}"}, "", errors)
+    return errors[:max_errors]
+
+
+def validate_chat_request(body: Any) -> list[str]:
+    if not isinstance(body, dict):
+        return ["request body must be a JSON object"]
+    return validate(body, "CreateChatCompletionRequest")
+
+
+def validate_messages_request(body: Any) -> list[str]:
+    """Load-bearing fields only: the Messages path is a byte-for-byte
+    passthrough (routes.go:808-980 parses just {model, stream}), so
+    over-validating content blocks here could reject payloads the
+    upstream accepts (e.g. future Anthropic block types). The gateway
+    checks exactly what it must parse to route."""
+    if not isinstance(body, dict):
+        return ["request body must be a JSON object"]
+    errors: list[str] = []
+    if not isinstance(body.get("model"), str) or not body.get("model"):
+        errors.append("model: required string")
+    if "max_tokens" in body and (isinstance(body["max_tokens"], bool)
+                                 or not isinstance(body["max_tokens"], int)):
+        errors.append("max_tokens: must be an integer")
+    if "messages" in body and not isinstance(body["messages"], list):
+        errors.append("messages: must be an array")
+    if "stream" in body and not isinstance(body["stream"], bool):
+        errors.append("stream: must be a boolean")
+    return errors

@@ -122,12 +122,19 @@ def generate_constants_py(spec: dict) -> str:
 # ---------------------------------------------------------------------------
 def check_generated_code(spec: dict) -> list[str]:
     """Delete-and-regenerate must reproduce generated modules byte-identically."""
+    from inference_gateway_tpu.codegen.typesgen import generate_types_py
+
     problems = []
     gen_path = REPO_ROOT / "inference_gateway_tpu" / "providers" / "constants_gen.py"
     want = generate_constants_py(spec)
     current = gen_path.read_text() if gen_path.exists() else ""
     if current != want:
         problems.append("providers/constants_gen.py drift — run codegen -type Code")
+    types_path = REPO_ROOT / "inference_gateway_tpu" / "api" / "types_gen.py"
+    want_types = generate_types_py(spec)
+    current_types = types_path.read_text() if types_path.exists() else ""
+    if current_types != want_types:
+        problems.append("api/types_gen.py drift — run codegen -type Types")
     return problems
 def check_provider_registry(spec: dict) -> list[str]:
     """Registry/constants must match x-provider-configs exactly."""
@@ -263,13 +270,19 @@ def check_config_defaults(spec: dict) -> list[str]:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="spec-driven generation + drift guards")
     parser.add_argument("-type", dest="gen_type", default="All",
-                        choices=["MD", "Env", "Code", "Check", "All"])
+                        choices=["MD", "Env", "Code", "Types", "Check", "All"])
     args = parser.parse_args(argv)
     spec = load_spec()
 
     if args.gen_type in ("Code", "All"):
         target = REPO_ROOT / "inference_gateway_tpu" / "providers" / "constants_gen.py"
         target.write_text(generate_constants_py(spec))
+        print(f"wrote {target.relative_to(REPO_ROOT)}")
+    if args.gen_type in ("Types", "All"):
+        from inference_gateway_tpu.codegen.typesgen import generate_types_py
+
+        target = REPO_ROOT / "inference_gateway_tpu" / "api" / "types_gen.py"
+        target.write_text(generate_types_py(spec))
         print(f"wrote {target.relative_to(REPO_ROOT)}")
     if args.gen_type in ("MD", "All"):
         (REPO_ROOT / "Configurations.md").write_text(generate_configurations_md(spec))

@@ -1,0 +1,107 @@
+"""openapi.yaml → api/types_gen.py (typed API surface).
+
+The reference's single source of truth emits its whole typed request/
+response surface with oapi-codegen (providers/types/common_types.go:
+1358-2664 — chat req/resp/stream chunk, Messages incl. thinking/
+tool-use stream events, Responses API, Model/Pricing/ContextWindow/
+SSEvent). This generator is the Python equivalent: from
+``components.schemas`` it emits
+
+- ``SCHEMAS``: the schema trees as a Python literal (the runtime
+  validator ``api/validation.py`` resolves ``$ref``s against it), and
+- a ``TypedDict`` per object schema (IDE/typing surface; payloads stay
+  plain dicts on the wire, matching the gateway's dict-based handlers).
+
+Byte-identity drift-gated like every other generated module
+(``codegen -type Check``; reference ci.yml dirty-tree check).
+"""
+
+from __future__ import annotations
+
+import pprint
+from typing import Any
+
+_PY_TYPES = {
+    "string": "str",
+    "integer": "int",
+    "number": "float",
+    "boolean": "bool",
+    "object": "dict[str, Any]",
+    "null": "None",
+}
+
+
+def _py_type(schema: dict[str, Any] | None) -> str:
+    """Best-effort Python annotation for a property schema."""
+    if not isinstance(schema, dict):
+        return "Any"
+    if "$ref" in schema:
+        # Refs resolve to plain dicts at runtime; annotate by name for
+        # readability ("Message"-shaped dict). The whole annotation is
+        # emitted as one quoted forward-reference string, so bare names
+        # are fine here.
+        return schema["$ref"].rsplit("/", 1)[-1]
+    if "oneOf" in schema:
+        parts = [_py_type(s) for s in schema["oneOf"]]
+        uniq = list(dict.fromkeys(parts))
+        return " | ".join(uniq) if uniq else "Any"
+    t = schema.get("type")
+    if t == "array":
+        return f"list[{_py_type(schema.get('items'))}]"
+    return _PY_TYPES.get(t, "Any")
+
+
+def _typed_dicts(schemas: dict[str, Any]) -> list[str]:
+    out: list[str] = []
+    for name, schema in schemas.items():
+        if not isinstance(schema, dict) or schema.get("type") != "object":
+            continue
+        props = schema.get("properties")
+        if not isinstance(props, dict) or not props:
+            continue
+        required = set(schema.get("required") or ())
+        out.append("")
+        out.append(f"{name} = TypedDict({name!r}, {{")
+        for prop, ps in props.items():
+            ann = _py_type(ps)
+            if prop not in required:
+                ann = f"NotRequired[{ann}]"
+            # One quoted forward-reference string per annotation: schema
+            # names may be defined later in the module (or in unions),
+            # and strings keep evaluation lazy.
+            out.append(f"    {prop!r}: {ann!r},")
+        out.append("}, total=True)")
+    return out
+
+
+def generate_types_py(spec: dict[str, Any]) -> str:
+    schemas = spec["components"]["schemas"]
+    aliases = [
+        f"{name} = {_py_type(schema)}"
+        for name, schema in schemas.items()
+        if isinstance(schema, dict) and schema.get("type") == "string" and "enum" in schema
+    ]
+    lines = [
+        '"""GENERATED from openapi.yaml components.schemas — do not edit.',
+        "",
+        "Regenerate: ``python -m inference_gateway_tpu.codegen -type Types``.",
+        "Drift-gated by ``-type Check``. The reference generates its typed",
+        "surface the same way (oapi-codegen -> providers/types/",
+        "common_types.go); here payloads stay dicts and these TypedDicts +",
+        "SCHEMAS give the typing/validation surface.",
+        '"""',
+        "",
+        "from typing import Any, NotRequired, TypedDict",
+        "",
+        "# String enums (annotation aliases; the validator enforces values).",
+        *aliases,
+        "",
+        "# Object shapes.",
+        *_typed_dicts(schemas),
+        "",
+        "",
+        "# Raw schema trees for runtime validation (api/validation.py).",
+        "SCHEMAS: dict[str, Any] = " + pprint.pformat(schemas, width=96, sort_dicts=False),
+        "",
+    ]
+    return "\n".join(lines)

@@ -580,3 +580,71 @@ PRESETS: dict[str, LlamaConfig] = {
         intermediate_size=28672, rope_theta=500000.0, max_position_embeddings=8192,
     ),
 }
+
+
+def forward_pipelined(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # (B, T)
+    positions: jnp.ndarray,  # (B, T)
+    lengths: jnp.ndarray,  # (B,)
+    mesh,
+    microbatches: int = 4,
+    last_only: bool = True,
+) -> jnp.ndarray:
+    """Pipeline-parallel prefill over the mesh's ``pp`` axis
+    (parallel/pipeline.py — SURVEY §2.4 PP row): the stacked layer
+    pytree is sharded by stage, B is split into microbatches, and
+    activations stream through the GPipe schedule. Embed and the
+    lm_head run replicated outside the pipeline (they're the first/last
+    "stage 0"/"stage N" work and tiny next to the layer stack). No KV
+    cache: PP targets prefill/batch-scoring throughput where
+    microbatching hides the bubble; decode stays tp-sharded
+    (latency-bound, SURVEY §7)."""
+    from inference_gateway_tpu.ops.attention import causal_prefill_mask, gqa_attend
+    from inference_gateway_tpu.parallel.pipeline import pipeline_apply
+
+    B, T = tokens.shape
+    M = microbatches
+    assert B % M == 0, "batch must split into microbatches"
+    Bm = B // M
+
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+
+    payload = {
+        "x": x.reshape(M, Bm, T, -1),
+        "positions": positions.reshape(M, Bm, T),
+        "lengths": lengths.reshape(M, Bm),
+    }
+
+    inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
+
+    def stage_fn(layers_local, p):
+        # Per-row context rebuilt locally from the (small) streamed
+        # positions/lengths instead of permuting (B, T, T) masks.
+        cos, sin = rope_cos_sin(p["positions"], inv_freq)
+        mask = causal_prefill_mask(p["positions"], p["lengths"])
+        if cfg.sliding_window:
+            mask = mask & (p["positions"][:, None, :] >
+                           p["positions"][:, :, None] - cfg.sliding_window)
+
+        def body(x, lp):
+            x, _, _ = _layer(x, lp, cos, sin, None, None, None, None,
+                             lambda q, k, v: gqa_attend(q, k, v, mask), cfg, False)
+            return x, None
+
+        x, _ = jax.lax.scan(body, p["x"], layers_local)
+        return {"x": x, "positions": p["positions"], "lengths": p["lengths"]}
+
+    out = pipeline_apply(mesh, stage_fn, params["layers"], payload)
+    x = out["x"].reshape(B, T, -1)
+
+    x = rms_norm(x, _nw(params["final_norm"], cfg), cfg.rms_norm_eps)
+    if last_only:
+        idx = jnp.maximum(lengths - 1 - positions[:, 0], 0)
+        x = x[jnp.arange(B), idx]
+    if cfg.tie_word_embeddings:
+        return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    return qmatmul(x, params["lm_head"]).astype(jnp.float32)

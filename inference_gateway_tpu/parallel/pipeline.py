@@ -1,0 +1,114 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a ``pp``
+mesh axis.
+
+SURVEY.md §2.4 PP row (round-2 verdict next #8): 70B-class models on
+v5e need layer sharding beyond tp — 70B bf16 weights are 140 GiB, so
+even tp=8 leaves 17.5 GiB/chip of weights alone, over the 16 GiB HBM.
+Sharding the LAYER axis over a ``pp`` mesh axis splits the weight
+budget by stages (tp×pp=16 → 8.75 GiB/chip), at the cost of a fill/
+drain bubble of (stages-1)/(microbatches+stages-1).
+
+TPU-first design: the stacked ``params["layers"]`` pytree is sharded on
+its leading (layer) axis over ``pp`` — each stage holds a (L/pp, ...)
+contiguous block. Under ``shard_map``, every tick each stage applies
+its local block (a ``lax.scan`` over its layers) to the microbatch it
+currently holds, then the activations rotate one stage forward with
+``lax.ppermute`` (ICI neighbour transfer). All stages compute
+concurrently on different microbatches — the classic GPipe schedule,
+expressed as a single ``lax.scan`` over M + pp - 1 ticks so XLA
+pipelines compute against the permute.
+
+Composition: ``tp`` continues to shard heads/ffn WITHIN each stage
+(specs from parallel/sharding.py apply unchanged to the per-stage
+block); ``dp`` replicates. Decode with a KV cache is deliberately NOT
+pipelined here — at decode's tiny per-step batches the bubble dominates
+(latency-bound, SURVEY §7 "hard parts"); PP earns its keep on prefill
+and batch scoring, which is what this module accelerates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(
+    mesh: Mesh,
+    stage_fn,  # (layers_local, payload) -> payload : applies ONE STAGE's block
+    layers,  # stacked (L, ...) pytree; leading axis sharded over `axis`
+    payload_micro,  # pytree of (M, ...) arrays — microbatched activations + per-row context
+    axis: str = "pp",
+):
+    """Stream M microbatched payloads through the layer pipeline.
+
+    ``payload_micro`` is a pytree whose leaves all carry a leading
+    microbatch axis M (e.g. {"x": (M, B, T, H), "positions": (M, B, T),
+    "lengths": (M, B)}). The whole payload rotates stage-to-stage so
+    stages can rebuild per-row context (RoPE tables, ragged masks)
+    locally — streaming positions/lengths (small) beats permuting
+    precomputed (B, T, T) masks (large). Returns the payload pytree
+    after all L layers.
+    """
+    n = mesh.shape[axis]
+    leaves = jax.tree.leaves(payload_micro)
+    M = leaves[0].shape[0]
+
+    def local_fn(payload_all, layers_local):
+        my = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def varying(t):
+            return jax.tree.map(lambda v: jax.lax.pcast(v, (axis,), to="varying"), t)
+
+        zero = varying(jax.tree.map(lambda a: jnp.zeros_like(a[0]), payload_all))
+        out0 = varying(jax.tree.map(jnp.zeros_like, payload_all))
+
+        def tick(carry, t):
+            cur, out = carry
+            # Stage 0 ingests microbatch t (clamped; ticks past M feed
+            # dead data that never reaches the output window).
+            feed = jax.tree.map(lambda a: a[jnp.minimum(t, M - 1)], payload_all)
+            cur = jax.tree.map(lambda f, c: jnp.where(my == 0, f, c), feed, cur)
+            y = stage_fn(layers_local, cur)
+            # The last stage completes microbatch t-(n-1) at tick t.
+            done_idx = t - (n - 1)
+            take = (my == n - 1) & (done_idx >= 0)
+            idx = jnp.maximum(done_idx, 0)
+            out = jax.tree.map(
+                lambda o, yy: jax.lax.dynamic_update_index_in_dim(
+                    o, jnp.where(take, yy, o[idx]), idx, 0),
+                out, y,
+            )
+            # Rotate the payload one stage forward.
+            nxt = jax.tree.map(lambda v: jax.lax.ppermute(v, axis, perm), y)
+            return (nxt, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (zero, out0), jnp.arange(M + n - 1))
+        # Output lives on the last stage only; psum replicates it.
+        return jax.tree.map(
+            lambda o: jax.lax.psum(jnp.where(my == n - 1, o, jnp.zeros_like(o)), axis),
+            out,
+        )
+
+    layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    payload_specs = jax.tree.map(lambda _: P(), payload_micro)
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(payload_specs, layer_specs),
+        out_specs=jax.tree.map(lambda _: P(), payload_micro),
+        check_vma=False,
+    )(payload_micro, layers)
+
+
+def pipeline_hbm_plan(n_params: int, n_chips: int, tp: int, pp: int,
+                      wbytes: int = 2) -> dict:
+    """Per-chip weight bytes under (tp, pp) — the sizing argument for
+    70B-class on v5e (SURVEY §2.4): weights split across both axes."""
+    per_chip = n_params * wbytes // (tp * pp)
+    return {
+        "weights_per_chip": per_chip,
+        "fits_v5e": per_chip < 12 * 1024**3,  # leave >=4 GiB for KV+act
+        "bubble_fraction": (pp - 1) / (pp - 1 + 8),  # at 8 microbatches
+    }

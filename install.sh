@@ -1,0 +1,62 @@
+#!/bin/bash
+# Installer for inference-gateway-tpu (reference install.sh parity):
+# fetches a release wheel/sdist from GitHub releases (or installs from
+# the current checkout with --local) into a dedicated virtualenv and
+# links the CLI entry points.
+set -euo pipefail
+
+VERSION="${VERSION:-latest}"
+INSTALL_DIR="${INSTALL_DIR:-$HOME/.local/share/inference-gateway-tpu}"
+BIN_DIR="${BIN_DIR:-$HOME/.local/bin}"
+REPO="${REPO:-inference-gateway/inference-gateway-tpu}"
+
+say()  { printf '\033[0;32m==>\033[0m %s\n' "$1"; }
+warn() { printf '\033[1;33mWarning:\033[0m %s\n' "$1"; }
+die()  { printf '\033[0;31mError:\033[0m %s\n' "$1" >&2; exit 1; }
+
+command -v python3 >/dev/null || die "python3 is required"
+PYV=$(python3 -c 'import sys; print("%d%02d" % sys.version_info[:2])')
+[ "$PYV" -ge 311 ] || die "Python >= 3.11 required (found $(python3 -V))"
+
+say "Creating virtualenv in ${INSTALL_DIR}"
+python3 -m venv "${INSTALL_DIR}/venv"
+PIP="${INSTALL_DIR}/venv/bin/pip"
+"$PIP" install --quiet --upgrade pip
+
+if [ "${1:-}" = "--local" ]; then
+    say "Installing from the current checkout"
+    "$PIP" install "$(cd "$(dirname "$0")" && pwd)"
+else
+    if [ "$VERSION" = "latest" ]; then
+        URL="https://github.com/${REPO}/releases/latest/download/inference_gateway_tpu.tar.gz"
+    else
+        URL="https://github.com/${REPO}/releases/download/v${VERSION}/inference_gateway_tpu.tar.gz"
+    fi
+    say "Downloading ${URL}"
+    TMP=$(mktemp -d)
+    trap 'rm -rf "$TMP"' EXIT
+    if command -v curl >/dev/null; then
+        curl -fsSL -o "$TMP/pkg.tar.gz" "$URL" || die "download failed: $URL"
+    else
+        wget -qO "$TMP/pkg.tar.gz" "$URL" || die "download failed: $URL"
+    fi
+    "$PIP" install "$TMP/pkg.tar.gz"
+fi
+
+say "Linking CLI entry points into ${BIN_DIR}"
+mkdir -p "$BIN_DIR"
+cat > "${BIN_DIR}/inference-gateway-tpu" <<WRAP
+#!/bin/sh
+exec "${INSTALL_DIR}/venv/bin/python" -m inference_gateway_tpu.main "\$@"
+WRAP
+cat > "${BIN_DIR}/inference-gateway-tpu-sidecar" <<WRAP
+#!/bin/sh
+exec "${INSTALL_DIR}/venv/bin/python" -m inference_gateway_tpu.serving "\$@"
+WRAP
+chmod +x "${BIN_DIR}/inference-gateway-tpu" "${BIN_DIR}/inference-gateway-tpu-sidecar"
+
+case ":$PATH:" in
+    *":${BIN_DIR}:"*) ;;
+    *) warn "${BIN_DIR} is not on PATH" ;;
+esac
+say "Installed. Run: inference-gateway-tpu (gateway) / inference-gateway-tpu-sidecar (TPU serving)"

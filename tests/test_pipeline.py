@@ -1,0 +1,76 @@
+"""Pipeline parallelism (round-2 verdict next #8, SURVEY §2.4 PP row).
+
+The GPipe microbatch pipeline (parallel/pipeline.py) must reproduce the
+single-device forward exactly on the virtual 8-device CPU mesh, and the
+HBM plan must show why PP is required for 70B-class on v5e.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.experimental import mesh_utils
+
+from inference_gateway_tpu.models import llama
+from inference_gateway_tpu.parallel.pipeline import pipeline_hbm_plan
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+def _pp_mesh(n):
+    devs = mesh_utils.create_device_mesh((n,), devices=jax.devices()[:n])
+    return Mesh(devs, ("pp",))
+
+
+def test_pipelined_forward_matches_dense():
+    cfg = llama.LlamaConfig(
+        vocab_size=256, hidden_size=64, num_layers=8, num_heads=4, num_kv_heads=2,
+        intermediate_size=128, max_position_embeddings=256,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    mesh = _pp_mesh(4)  # 8 layers -> 4 stages of 2
+
+    rng = np.random.default_rng(5)
+    B, T = 8, 32  # 4 microbatches of 2
+    tokens = jnp.asarray(rng.integers(1, 250, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lengths = jnp.asarray([T, 30, 20, T, 5, T, 17, 9], jnp.int32)
+
+    ref, _ = llama.forward(params, cfg, tokens, positions, lengths,
+                           mode="prefill", last_only=True)
+    got = llama.forward_pipelined(params, cfg, tokens, positions, lengths,
+                                  mesh, microbatches=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_forward_eight_stages():
+    """pp = device count (1 layer per stage) — the deepest factoring."""
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=32, num_layers=8, num_heads=2, num_kv_heads=1,
+        intermediate_size=64, max_position_embeddings=64,
+    )
+    params = llama.init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.float32)
+    mesh = _pp_mesh(8)
+    rng = np.random.default_rng(6)
+    B, T = 4, 16  # 2 microbatches
+    tokens = jnp.asarray(rng.integers(1, 120, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lengths = jnp.full((B,), T, jnp.int32)
+
+    ref, _ = llama.forward(params, cfg, tokens, positions, lengths,
+                           mode="prefill", last_only=True)
+    got = llama.forward_pipelined(params, cfg, tokens, positions, lengths,
+                                  mesh, microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_70b_needs_pp_and_plan_fits():
+    """The sizing argument SURVEY §2.4 makes: Llama-3-70B bf16 does not
+    fit tp=8 alone on v5e, and fits with pp added."""
+    n_params = 70_000_000_000
+    tp_only = pipeline_hbm_plan(n_params, n_chips=8, tp=8, pp=1)
+    assert not tp_only["fits_v5e"], "70B would 'fit' tp-only — plan wrong"
+    with_pp = pipeline_hbm_plan(n_params, n_chips=16, tp=8, pp=2)
+    assert with_pp["fits_v5e"]
+    assert with_pp["bubble_fraction"] < 0.2
