@@ -19,7 +19,7 @@ from typing import Any
 from inference_gateway_tpu.netio.server import Handler, Request, Response, StreamingResponse
 from inference_gateway_tpu.providers.routing import determine_provider_and_model_name
 
-INFERENCE_PATHS = ("/v1/chat/completions",)
+INFERENCE_PATHS = ("/v1/chat/completions", "/v1/responses")
 USAGE_SCAN_CHUNKS = 4  # telemetry.go:195
 MCP_TOOL_PREFIX = "mcp_"
 
@@ -48,6 +48,8 @@ def parse_usage(payload: dict[str, Any]) -> tuple[int, int] | None:
     usage = payload.get("usage")
     if not isinstance(usage, dict):
         return None
+    if "input_tokens" in usage:  # Responses API shape (/v1/responses)
+        return int(usage.get("input_tokens") or 0), int(usage.get("output_tokens") or 0)
     return int(usage.get("prompt_tokens") or 0), int(usage.get("completion_tokens") or 0)
 
 
@@ -116,6 +118,10 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                         except ValueError:
                             continue
                         usage = parse_usage(payload) or usage
+                        # Responses API streams carry usage inside the
+                        # final event's nested `response` object.
+                        if isinstance(payload.get("response"), dict):
+                            usage = parse_usage(payload["response"]) or usage
                         for choice in payload.get("choices") or []:
                             delta = choice.get("delta") or {}
                             for tc in delta.get("tool_calls") or []:

@@ -1,0 +1,271 @@
+"""OpenAI-compatible Responses API — implemented, not just spec'd.
+
+The reference carries /v1/responses in its spec with generated types but
+intentionally registers no handler (main.go:256-266, "spec'd ahead of
+implementation"). This gateway goes one step further: a stateless
+translation layer maps Responses requests onto the chat-completions
+surface every provider (including the TPU sidecar) already serves, and
+maps the result back into Response objects / typed stream events.
+
+Deliberately stateless (the gateway keeps no response store, matching
+its whole design): `previous_response_id` is rejected with a typed
+error and `store` is accepted-and-ignored, both documented in the spec.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, AsyncIterator
+
+
+def _rid(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:24]}"
+
+
+# ---------------------------------------------------------------------------
+# Request translation: CreateResponseRequest -> CreateChatCompletionRequest
+# ---------------------------------------------------------------------------
+def responses_to_chat_request(body: dict[str, Any]) -> dict[str, Any]:
+    messages: list[dict[str, Any]] = []
+    if body.get("instructions"):
+        messages.append({"role": "system", "content": body["instructions"]})
+
+    inp = body.get("input")
+    if isinstance(inp, str):
+        messages.append({"role": "user", "content": inp})
+    else:
+        for item in inp or []:
+            role = item.get("role", "user")
+            content = item.get("content")
+            if isinstance(content, str):
+                messages.append({"role": role, "content": content})
+                continue
+            parts = []
+            for part in content or []:
+                t = part.get("type")
+                if t == "input_text":
+                    parts.append({"type": "text", "text": part.get("text", "")})
+                elif t == "input_image":
+                    parts.append({"type": "image_url",
+                                  "image_url": {"url": part.get("image_url", "")}})
+            messages.append({"role": role, "content": parts})
+
+    chat: dict[str, Any] = {"model": body["model"], "messages": messages}
+    if body.get("max_output_tokens") is not None:
+        chat["max_completion_tokens"] = body["max_output_tokens"]
+    for key in ("temperature", "top_p", "parallel_tool_calls"):
+        if body.get(key) is not None:
+            chat[key] = body[key]
+    if body.get("stream"):
+        chat["stream"] = True
+        chat["stream_options"] = {"include_usage": True}
+
+    tools = body.get("tools")
+    if tools:
+        chat["tools"] = [
+            {"type": "function", "function": {
+                k: v for k, v in (("name", t.get("name")),
+                                  ("description", t.get("description")),
+                                  ("parameters", t.get("parameters")),
+                                  ("strict", t.get("strict"))) if v is not None}}
+            for t in tools if t.get("type") == "function"
+        ]
+    tc = body.get("tool_choice")
+    if tc is not None:
+        if isinstance(tc, dict) and tc.get("type") == "function":
+            chat["tool_choice"] = {"type": "function", "function": {"name": tc.get("name", "")}}
+        else:
+            chat["tool_choice"] = tc
+    fmt = (body.get("text") or {}).get("format")
+    if fmt:
+        chat["response_format"] = fmt
+    eff = (body.get("reasoning") or {}).get("effort")
+    if eff:
+        chat["reasoning_effort"] = eff
+    return chat
+
+
+# ---------------------------------------------------------------------------
+# Response translation: chat completion -> Response
+# ---------------------------------------------------------------------------
+def _usage_from_chat(usage: dict[str, Any] | None) -> dict[str, Any]:
+    usage = usage or {}
+    it = int(usage.get("prompt_tokens") or 0)
+    ot = int(usage.get("completion_tokens") or 0)
+    out = {"input_tokens": it, "output_tokens": ot,
+           "total_tokens": int(usage.get("total_tokens") or it + ot)}
+    details = usage.get("prompt_tokens_details") or {}
+    if details.get("cached_tokens"):
+        out["input_tokens_details"] = {"cached_tokens": int(details["cached_tokens"])}
+    cdetails = usage.get("completion_tokens_details") or {}
+    if cdetails.get("reasoning_tokens"):
+        out["output_tokens_details"] = {"reasoning_tokens": int(cdetails["reasoning_tokens"])}
+    return out
+
+
+def chat_to_response(chat: dict[str, Any], req_body: dict[str, Any]) -> dict[str, Any]:
+    output: list[dict[str, Any]] = []
+    status = "completed"
+    for choice in chat.get("choices") or []:
+        msg = choice.get("message") or {}
+        for tc in msg.get("tool_calls") or []:
+            fn = tc.get("function") or {}
+            output.append({
+                "id": _rid("fc"), "type": "function_call", "status": "completed",
+                "call_id": tc.get("id", ""), "name": fn.get("name", ""),
+                "arguments": fn.get("arguments", ""),
+            })
+        if msg.get("content") is not None:
+            output.append({
+                "id": _rid("msg"), "type": "message", "role": "assistant",
+                "status": "completed",
+                "content": [{"type": "output_text", "text": msg.get("content") or "",
+                             "annotations": []}],
+            })
+        if choice.get("finish_reason") == "length":
+            status = "incomplete"
+    resp: dict[str, Any] = {
+        "id": _rid("resp"),
+        "object": "response",
+        "created_at": int(chat.get("created") or time.time()),
+        "model": chat.get("model") or req_body.get("model", ""),
+        "status": status,
+        "error": None,
+        "incomplete_details": {"reason": "max_output_tokens"} if status == "incomplete" else None,
+        "output": output,
+        "usage": _usage_from_chat(chat.get("usage")),
+        "metadata": req_body.get("metadata") or {},
+    }
+    for key in ("temperature", "top_p", "max_output_tokens", "instructions"):
+        if req_body.get(key) is not None:
+            resp[key] = req_body[key]
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# Stream translation: chat SSE chunks -> typed response.* events
+# ---------------------------------------------------------------------------
+def _event(etype: str, payload: dict[str, Any]) -> bytes:
+    return (f"event: {etype}\n".encode()
+            + b"data: " + json.dumps({"type": etype, **payload}).encode() + b"\n\n")
+
+
+async def stream_response_events(
+    chat_stream: AsyncIterator[bytes], req_body: dict[str, Any]
+) -> AsyncIterator[bytes]:
+    """Map a chat-completions SSE stream onto the Responses API's typed
+    event sequence: response.created -> output_item.added ->
+    content_part.added -> output_text.delta* -> ...done -> completed."""
+    resp_id = _rid("resp")
+    item_id = _rid("msg")
+    base = {
+        "id": resp_id, "object": "response", "created_at": int(time.time()),
+        "model": req_body.get("model", ""), "status": "in_progress",
+        "error": None, "incomplete_details": None, "output": [],
+        "metadata": req_body.get("metadata") or {},
+    }
+    yield _event("response.created", {"response": dict(base)})
+
+    from inference_gateway_tpu.netio.sse import parse_data_line
+
+    started = False
+    text_parts: list[str] = []
+    tool_calls: dict[int, dict[str, Any]] = {}  # index -> accumulated call
+    usage: dict[str, Any] | None = None
+    finish = None
+    buffer = b""
+    async for block in chat_stream:
+        buffer += block
+        while b"\n" in buffer:
+            line, buffer = buffer.split(b"\n", 1)
+            data = parse_data_line(line)
+            if not data or data == b"[DONE]":
+                continue
+            try:
+                chunk = json.loads(data)
+            except ValueError:
+                continue
+            if chunk.get("usage"):
+                usage = chunk["usage"]
+            for choice in chunk.get("choices") or []:
+                if choice.get("finish_reason"):
+                    finish = choice["finish_reason"]
+                delta_obj = choice.get("delta") or {}
+                # Tool-call deltas accumulate by index (same contract as
+                # providers/types.accumulate_streaming_tool_calls); they
+                # surface as function_call output items at the end.
+                for tc in delta_obj.get("tool_calls") or []:
+                    call = tool_calls.setdefault(tc.get("index", 0), {
+                        "id": "", "name": "", "arguments": ""})
+                    if tc.get("id"):
+                        call["id"] = tc["id"]
+                    fn = tc.get("function") or {}
+                    if fn.get("name"):
+                        call["name"] = fn["name"]
+                    if fn.get("arguments"):
+                        call["arguments"] += fn["arguments"]
+                delta = delta_obj.get("content")
+                if not delta:
+                    continue
+                if not started:
+                    started = True
+                    yield _event("response.output_item.added", {
+                        "output_index": 0,
+                        "item": {"id": item_id, "type": "message", "role": "assistant",
+                                 "status": "in_progress", "content": []},
+                    })
+                    yield _event("response.content_part.added", {
+                        "item_id": item_id, "output_index": 0, "content_index": 0,
+                        "part": {"type": "output_text", "text": "", "annotations": []},
+                    })
+                text_parts.append(delta)
+                yield _event("response.output_text.delta", {
+                    "item_id": item_id, "output_index": 0, "content_index": 0,
+                    "delta": delta,
+                })
+
+    text = "".join(text_parts)
+    if started:
+        yield _event("response.output_text.done", {
+            "item_id": item_id, "output_index": 0, "content_index": 0, "text": text,
+        })
+        yield _event("response.content_part.done", {
+            "item_id": item_id, "output_index": 0, "content_index": 0,
+            "part": {"type": "output_text", "text": text, "annotations": []},
+        })
+        yield _event("response.output_item.done", {
+            "output_index": 0,
+            "item": {"id": item_id, "type": "message", "role": "assistant",
+                     "status": "completed",
+                     "content": [{"type": "output_text", "text": text, "annotations": []}]},
+        })
+    output: list[dict[str, Any]] = []
+    # Accumulated tool calls become function_call items, each announced
+    # with an added/done event pair before the final response (review
+    # finding: a streamed tool-calling answer must not end as an empty
+    # "completed" response).
+    for idx in sorted(tool_calls):
+        call = tool_calls[idx]
+        if not call["name"]:
+            continue
+        item = {"id": _rid("fc"), "type": "function_call", "status": "completed",
+                "call_id": call["id"], "name": call["name"],
+                "arguments": call["arguments"]}
+        oi = len(output) + (1 if started else 0)
+        yield _event("response.output_item.added", {
+            "output_index": oi, "item": dict(item, status="in_progress")})
+        yield _event("response.output_item.done", {"output_index": oi, "item": item})
+        output.append(item)
+    final = dict(base)
+    final["status"] = "incomplete" if finish == "length" else "completed"
+    if finish == "length":
+        final["incomplete_details"] = {"reason": "max_output_tokens"}
+    msg_items = [{
+        "id": item_id, "type": "message", "role": "assistant", "status": "completed",
+        "content": [{"type": "output_text", "text": text, "annotations": []}],
+    }] if started else []
+    final["output"] = msg_items + output
+    final["usage"] = _usage_from_chat(usage)
+    yield _event("response.completed", {"response": final})

@@ -82,6 +82,7 @@ class RouterImpl:
         r.get("/health", self.healthcheck_handler)
         r.get("/v1/models", self.list_models_handler)
         r.post("/v1/chat/completions", self.chat_completions_handler)
+        r.post("/v1/responses", self.responses_handler)
         r.post("/v1/messages", self.messages_handler)
         r.get("/v1/mcp/tools", self.list_tools_handler)
         r.post("/v1/metrics", self.metrics_ingestion_handler)
@@ -288,6 +289,98 @@ class RouterImpl:
         return resp
 
     # ------------------------------------------------------------------
+    async def responses_handler(self, req: Request) -> Response:
+        """POST /v1/responses — OpenAI Responses API, IMPLEMENTED.
+
+        The reference specs this endpoint but registers no handler
+        (main.go:256-266); here a stateless translation maps it onto
+        the chat-completions surface every provider serves
+        (api/responses.py). previous_response_id is rejected (no
+        response store by design); store is accepted-and-ignored."""
+        from inference_gateway_tpu.api.responses import (
+            chat_to_response,
+            responses_to_chat_request,
+            stream_response_events,
+        )
+        from inference_gateway_tpu.api.validation import validate
+
+        try:
+            body = req.json()
+        except (ValueError, UnicodeDecodeError):
+            return error_json("Failed to decode request", 400)
+        if not isinstance(body, dict):
+            return error_json("Failed to decode request", 400)
+        problems = validate(body, "CreateResponseRequest")
+        if problems:
+            return error_json("Invalid request: " + "; ".join(problems), 400)
+        if body.get("previous_response_id"):
+            return error_json(
+                "previous_response_id is not supported: the gateway keeps no "
+                "response store (stateless by design)", 400)
+
+        original_model = body.get("model") or ""
+        model = original_model
+        provider_id = req.query_get("provider")
+        # Same logical-model selector the chat path consults
+        # (routes.py chat handler): a routing-pool alias must resolve
+        # identically on both endpoints.
+        if self.selector is not None and not provider_id:
+            routed = self.selector.select(model)
+            if routed is not None:
+                provider_id = routed.provider
+                model = routed.model
+        if not provider_id:
+            detected, model = routing.determine_provider_and_model_name(model)
+            if detected is None:
+                return error_json(
+                    "Unable to determine provider for model. Please specify a provider "
+                    "using the ?provider= query parameter or use the provider/model "
+                    "format (e.g., openai/gpt-4).", 400)
+            provider_id = detected
+        if self.cfg.allowed_models:
+            if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
+                return error_json("Model not allowed. Please check the list of allowed models.", 403)
+        elif self.cfg.disallowed_models:
+            if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
+                return error_json("Model is disallowed. Please use a different model.", 403)
+        try:
+            provider = self._build_provider(provider_id)
+        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
+            return self._provider_error(e, provider_id)
+
+        chat_req = responses_to_chat_request(dict(body, model=model))
+        # Same vision gate as the chat path (routes.go:670-706): strip
+        # image parts for providers that can't take them.
+        if self.cfg.enable_vision:
+            msgs = chat_req.get("messages") or []
+            if any(has_image_content(m) for m in msgs if isinstance(m, dict)):
+                if not provider.supports_vision(model):
+                    chat_req["messages"] = [
+                        strip_image_content(m) if isinstance(m, dict) else m for m in msgs
+                    ]
+        ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
+
+        if body.get("stream"):
+            try:
+                stream = await provider.stream_chat_completions(chat_req, ctx)
+            except HTTPError as e:
+                return error_json(e.message, e.status_code)
+            except HTTPClientError as e:
+                return error_json(str(e), 502)
+            return StreamingResponse.sse(stream_response_events(stream, body))
+
+        try:
+            result = await asyncio.wait_for(
+                provider.chat_completions(chat_req, ctx), timeout=self.cfg.server.read_timeout
+            )
+        except asyncio.TimeoutError:
+            return error_json("Request timed out", 504)
+        except HTTPError as e:
+            return error_json(e.message, e.status_code)
+        except HTTPClientError as e:
+            return error_json(str(e), 502)
+        return Response.json(chat_to_response(result, body))
+
     async def messages_handler(self, req: Request) -> Response:
         """POST /v1/messages — Anthropic passthrough, no loopback hop
         (routes.go:808-980)."""

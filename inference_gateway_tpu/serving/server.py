@@ -186,7 +186,7 @@ class SidecarServer:
             "total_slots": self.engine.config.max_slots,
         })
 
-    async def metrics(self, req: Request) -> Response:
+    def _metrics_snapshot(self) -> dict:
         m = dict(self.engine.metrics)
         m["queue_depth"] = self.scheduler.queue_depth
         m["active_requests"] = self.scheduler.active_requests()
@@ -196,7 +196,31 @@ class SidecarServer:
             m["kv_pages_free"] = self.engine.allocator.free_page_count()
         if self.engine.prefix_cache is not None:
             m["prefix_cache"] = self.engine.prefix_cache.stats()
-        return Response.json(m)
+        return m
+
+    async def metrics(self, req: Request) -> Response:
+        """GET /metrics — JSON by default; Prometheus text format when
+        the client asks for it (Accept: text/plain or ?format=prometheus)
+        so the monitoring example's Prometheus can scrape the sidecar
+        directly (tpu_sidecar_* series on the Grafana dashboard)."""
+        m = self._metrics_snapshot()
+        accept = req.headers.get("Accept") or ""
+        if "text/plain" not in accept and req.query_get("format") != "prometheus":
+            return Response.json(m)
+        flat = dict(m)
+        prefix_stats = flat.pop("prefix_cache", None)
+        if isinstance(prefix_stats, dict):
+            for k, v in prefix_stats.items():
+                flat[f"prefix_cache_{k}"] = v
+        lines = []
+        for key, val in sorted(flat.items()):
+            if not isinstance(val, (int, float)):
+                continue
+            name = f"tpu_sidecar_{key}"
+            kind = "counter" if key.endswith(("_tokens", "_steps", "_batches", "hits", "misses")) else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {val}")
+        return Response.text("\n".join(lines) + "\n", content_type="text/plain; version=0.0.4")
 
     # ------------------------------------------------------------------
     def _decode_images(self, messages: list[dict[str, Any]]) -> list:

@@ -165,3 +165,41 @@ def test_paged_sliding_window_matches_dense():
     finally:
         sched_d.stop()
         sched_p.stop()
+
+
+def test_paged_engine_serves_all_llama_family_variants():
+    """The paged path is family-generic: Qwen2 (qkv biases), Gemma
+    (norm offset + embed scale + gelu + custom head_dim), and Mistral
+    (sliding window) must all produce identical tokens paged vs dense."""
+    from inference_gateway_tpu.models import llama
+
+    variants = {
+        "qwen2-like": llama.LlamaConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+            intermediate_size=96, max_position_embeddings=256, qkv_bias=True,
+            tie_word_embeddings=True),
+        "gemma-like": llama.LlamaConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=1,
+            intermediate_size=96, head_dim=16, max_position_embeddings=256,
+            tie_word_embeddings=True, hidden_act="gelu_tanh", norm_offset=True,
+            embed_scale=True, rms_norm_eps=1e-6),
+        "mistral-like": llama.LlamaConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+            intermediate_size=96, max_position_embeddings=256, sliding_window=20),
+    }
+    rng = np.random.default_rng(17)
+    for name, cfg in variants.items():
+        common = dict(model="test-tiny", max_slots=2, max_seq_len=64, dtype="float32",
+                      max_prefill_batch=1, use_mesh=False, decode_chunk=4,
+                      prefill_buckets=(16, 32, 64))
+        dense = Engine(EngineConfig(**common, attention="dense"), model_cfg=cfg)
+        paged = Engine(EngineConfig(**common, attention="paged", page_size=8), model_cfg=cfg)
+        sd, sp = Scheduler(dense), Scheduler(paged)
+        sd.start(); sp.start()
+        try:
+            prompt = [int(x) for x in rng.integers(1, 250, size=24)]
+            want, _ = generate_sync(sd, prompt, max_tokens=8, temperature=0.0)
+            got, _ = generate_sync(sp, prompt, max_tokens=8, temperature=0.0)
+            assert got == want, f"{name}: paged vs dense divergence"
+        finally:
+            sd.stop(); sp.stop()

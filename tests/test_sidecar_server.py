@@ -155,3 +155,19 @@ async def test_metrics_endpoint(sidecar, client):
     m = resp.json()
     assert m["decode_tokens"] > 0
     assert "queue_depth" in m
+
+
+async def test_metrics_prometheus_format(sidecar, client):
+    """GET /metrics with a text/plain Accept (what Prometheus sends)
+    returns the tpu_sidecar_* exposition the monitoring example's
+    dashboard queries; JSON stays the default."""
+    _, port = sidecar
+    resp = await client.get(f"http://127.0.0.1:{port}/metrics",
+                            headers={"Accept": "text/plain;version=0.0.4"})
+    assert resp.status == 200
+    text = resp.body.decode()
+    assert "# TYPE tpu_sidecar_decode_tokens counter" in text
+    assert "tpu_sidecar_queue_depth" in text
+    # JSON default unchanged.
+    resp = await client.get(f"http://127.0.0.1:{port}/metrics")
+    assert resp.json()["decode_steps"] >= 0
