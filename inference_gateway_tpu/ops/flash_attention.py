@@ -58,7 +58,12 @@ def _flash_kernel(
     length = length_ref[b, 0]
     offset = offset_ref[b, 0]
 
-    q = q_ref[0, 0].astype(jnp.float32).reshape(BQ * G, D)
+    # Keep q/k/v in their storage dtype (bf16 in serving): the MXU takes
+    # bf16 inputs at full rate with f32 accumulation via
+    # preferred_element_type — casting whole panels to f32 first runs
+    # the matmuls at the much slower f32 rate (and doubles VMEM traffic),
+    # exactly what the XLA einsum path (ops/attention.gqa_attend) avoids.
+    q = q_ref[0, 0].reshape(BQ * G, D)
     # Absolute query positions as a (BQ*G, 1) column: row r is query
     # r // G. Built directly in 2D — a (BQ, G) iota reshaped to 1D is a
     # sublane→lane relayout Mosaic refuses to lower ("unsupported shape
@@ -83,8 +88,8 @@ def _flash_kernel(
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         scores = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -101,7 +106,11 @@ def _flash_kernel(
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        # p cast to the value dtype for the PV matmul, as the einsum path
+        # does (probs.astype(v.dtype)) — bf16 MXU with f32 accumulate.
+        acc_new = acc * alpha + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+        )
         return m_new, l_new, acc_new
 
     m0 = jnp.full((BQ * G, 1), NEG_INF, jnp.float32)

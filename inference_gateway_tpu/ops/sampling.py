@@ -29,6 +29,71 @@ def per_row_keys(
     return jnp.where(use_seed[:, None], seeded, unseeded)
 
 
+def chunk_row_keys(
+    rng: jax.Array,
+    seeds: jnp.ndarray,  # (B,)
+    use_seed: jnp.ndarray,  # (B,)
+    positions: jnp.ndarray,  # (B,) positions BEFORE the chunk's first step
+    n_steps: int,
+) -> jnp.ndarray:
+    """All (step, row) keys for a fused decode chunk in one batched
+    derivation: bit-identical to per_row_keys(fold_in(rng, i), seeds,
+    use_seed, positions + 1 + i) per step, but a single vectorized
+    threefry dispatch. Round-3 v5e profiling measured ~0.56 ms/step of
+    in-scan RNG/sampling overhead — many tiny key-derivation launches —
+    which this hoists out of the decode loop."""
+    B = seeds.shape[0]
+    steps = jnp.arange(n_steps)
+    seeded = jax.vmap(lambda i: jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p + 1 + i)
+    )(seeds, positions))(steps)
+    unseeded = jax.vmap(lambda i: jax.vmap(
+        lambda b: jax.random.fold_in(jax.random.fold_in(rng, i), b)
+    )(jnp.arange(B)))(steps)
+    return jnp.where(use_seed[None, :, None], seeded, unseeded)  # (n, B, 2)
+
+
+def effective_top_k(top_k: int, vocab_size: int) -> int:
+    """The k actually sorted by the fused-decode sampling path: top_k=0
+    ("disabled", see sample_tokens) and top_k >= vocab degrade to a
+    full-vocab sort so nucleus semantics are preserved instead of
+    crashing lax.top_k (code-review round 3)."""
+    return top_k if 0 < top_k < vocab_size else vocab_size
+
+
+def chunk_gumbels(keys: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Gumbel noise for every (step, row) of a chunk, batched. Sampling
+    with argmax(filtered + gumbel(key, (k,))) is bit-identical to
+    jax.random.categorical(key, filtered) — categorical IS the gumbel
+    trick — so hoisting the draws out of the scan changes nothing about
+    the sampled streams."""
+    return jax.vmap(jax.vmap(lambda k: jax.random.gumbel(k, (top_k,))))(keys)
+
+
+def sample_tokens_pregumbel(
+    logits: jnp.ndarray,  # (B, V) fp32
+    temperature: jnp.ndarray,  # (B,)
+    top_p: jnp.ndarray,  # (B,)
+    gumbel: jnp.ndarray,  # (B, top_k) precomputed via chunk_gumbels
+    top_k: int,
+) -> jnp.ndarray:
+    """sample_tokens' top-k fast path with the RNG hoisted out: only
+    top_k + nucleus filter + argmax remain in the decode loop."""
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, GREEDY_EPS)[:, None]
+    scaled = logits / temp
+    vals, idx = jax.lax.top_k(scaled, top_k)
+    sorted_probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep = cum - sorted_probs < top_p[:, None]
+    keep = keep.at[:, 0].set(True)
+    filtered = jnp.where(keep, vals, -jnp.inf)
+    sampled_in_k = jnp.argmax(filtered + gumbel, axis=-1)
+    sampled_tok = jnp.take_along_axis(idx, sampled_in_k[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature <= GREEDY_EPS, greedy_tok, sampled_tok)
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # (B, V) fp32
     rng: jax.Array,

@@ -26,7 +26,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from inference_gateway_tpu.models import llama
-from inference_gateway_tpu.ops.sampling import compute_logprobs, per_row_keys, sample_tokens
+from inference_gateway_tpu.ops.sampling import (
+    chunk_gumbels,
+    chunk_row_keys,
+    effective_top_k,
+    compute_logprobs,
+    per_row_keys,
+    sample_tokens,
+    sample_tokens_pregumbel,
+)
 from inference_gateway_tpu.parallel.mesh import create_mesh, default_mesh_shape
 from inference_gateway_tpu.parallel.sharding import (
     check_divisibility,
@@ -73,6 +81,16 @@ class PrefillResult:
     slot: int
     first_token: int
     logprob: float
+
+
+@dataclass
+class _DecodeChunkHandle:
+    """An in-flight fused decode chunk: ``toks_lp`` is a (2·n_steps, S)
+    device-array future (tokens stacked atop logprobs) that materializes
+    when the chunk finishes on device; fetch with decode_chunk_fetch."""
+
+    toks_lp: jax.Array
+    n_steps: int
 
 
 class Engine:
@@ -212,6 +230,12 @@ class Engine:
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_counter = 0
         self._lock = threading.Lock()
+        # Device-resident chained decode state (decode_chunk_submit):
+        # (pending token, position) carry from the last chunk, plus the
+        # uploaded sampling params. Any prefill invalidates the carry —
+        # newly admitted slots' tokens exist only on the host.
+        self._dev_carry = None
+        self._dev_sampling = None
         # Serving metrics surfaced via the sidecar's /metrics endpoint.
         self.metrics = {
             "prefill_tokens": 0,
@@ -317,17 +341,23 @@ class Engine:
     @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
     def _decode_chunk_fn(self, params, cache, tokens, positions, temps, top_ps, seeds, use_seed, rng, n_steps):
         """n_steps fused decode steps (lax.scan); sampling feeds back
-        on-device so the host syncs once per chunk."""
+        on-device so the host syncs once per chunk. RNG (key derivation
+        + gumbel draws) is precomputed for the whole chunk OUTSIDE the
+        scan — one batched dispatch instead of n_steps small ones, which
+        cost ~0.56 ms/step on v5e (round-3 device profile); the streams
+        are bit-identical (see ops/sampling.chunk_gumbels)."""
+        keys = chunk_row_keys(rng, seeds, use_seed, positions, n_steps)
+        k_eff = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
+        gumbels = chunk_gumbels(keys, k_eff)
 
-        def step(carry, i):
+        def step(carry, xs):
             cache, tok, pos = carry
+            i, gum = xs
             logits, cache = self._model.forward(
                 params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache, mode="decode",
             )
             logits = logits[:, 0]
-            keys = per_row_keys(jax.random.fold_in(rng, i), seeds, use_seed, pos + 1)
-            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps,
-                                top_k=self.config.top_k, row_keys=keys)
+            nxt = sample_tokens_pregumbel(logits, temps, top_ps, gum, k_eff)
             nxt = nxt.astype(jnp.int32)
             logprobs = compute_logprobs(logits, nxt)
             # Clamp so attention length never exceeds the cache row even
@@ -336,27 +366,32 @@ class Engine:
             nxt_pos = jnp.minimum(pos + 1, self.config.max_seq_len - 1)
             return (cache, nxt, nxt_pos), (nxt, logprobs)
 
-        (cache, _, _), (toks, logprobs) = jax.lax.scan(
-            step, (cache, tokens, positions), jnp.arange(n_steps)
+        (cache, tok_f, pos_f), (toks, logprobs) = jax.lax.scan(
+            step, (cache, tokens, positions), (jnp.arange(n_steps), gumbels)
         )
-        return toks, logprobs, cache  # (n, S)
+        # tok_f/pos_f: the final sampled token + its position per slot —
+        # returned so the NEXT chunk can chain off device-resident state
+        # with no host round-trip (decode_chunk_submit).
+        return toks, logprobs, tok_f, pos_f, cache  # (n, S) x2, (S,) x2
 
     @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
     def _decode_chunk_fn_paged(self, params, cache, tokens, positions, write_idx,
                                page_table, temps, top_ps, seeds, use_seed, rng, n_steps):
         """Paged variant: write_idx is (S, n_steps) precomputed flat cache
-        positions (OOB = drop)."""
+        positions (OOB = drop). Chunk RNG precomputed outside the scan
+        (see _decode_chunk_fn)."""
+        keys = chunk_row_keys(rng, seeds, use_seed, positions, n_steps)
+        k_eff = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
+        gumbels = chunk_gumbels(keys, k_eff)
 
         def step(carry, inputs):
             cache, tok, pos = carry
-            i, w_idx = inputs
+            i, w_idx, gum = inputs
             logits, cache = self._model.forward_paged(
                 params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache,
                 w_idx[:, None], page_table, mode="decode", last_only=True, mesh=self.mesh,
             )
-            keys = per_row_keys(jax.random.fold_in(rng, i), seeds, use_seed, pos + 1)
-            nxt = sample_tokens(logits, jax.random.fold_in(rng, i), temps, top_ps,
-                                top_k=self.config.top_k, row_keys=keys)
+            nxt = sample_tokens_pregumbel(logits, temps, top_ps, gum, k_eff)
             nxt = nxt.astype(jnp.int32)
             logprobs = compute_logprobs(logits, nxt)
             # Clamp the carried position so the attention length stays
@@ -368,10 +403,10 @@ class Engine:
             nxt_pos = jnp.minimum(pos + 1, self.config.max_seq_len - 1)
             return (cache, nxt, nxt_pos), (nxt, logprobs)
 
-        (cache, _, _), (toks, logprobs) = jax.lax.scan(
-            step, (cache, tokens, positions), (jnp.arange(n_steps), write_idx.T)
+        (cache, tok_f, pos_f), (toks, logprobs) = jax.lax.scan(
+            step, (cache, tokens, positions), (jnp.arange(n_steps), write_idx.T, gumbels)
         )
-        return toks, logprobs, cache
+        return toks, logprobs, tok_f, pos_f, cache
 
     @partial(jax.jit, static_argnames=("self", "ring"), donate_argnums=(2,))
     def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
@@ -429,6 +464,10 @@ class Engine:
         ``embeds`` optionally carries per-row (T_i, H) multimodal
         embedding overrides (from prepare_multimodal)."""
         assert prompts and len(prompts) == len(slots)
+        # Chained decode state is host-stale once new slots enter: the
+        # admitted slots' first tokens exist only on the host, so the
+        # next chunk must be submitted chain=False.
+        self._dev_carry = None
         # Prompts beyond the largest bucket take a long-context path:
         # ring attention over the sp axis when the mesh has one (ONE
         # sequence-sharded pass, O(T/sp) memory per device — dense AND
@@ -690,14 +729,28 @@ class Engine:
             e.slot = slot
             raise
 
-    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray, active: np.ndarray,
-                     temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None,
-                     seeds: np.ndarray | None = None, use_seed: np.ndarray | None = None):
-        """Run ``n_steps`` fused decode steps for ALL slots.
+    def decode_chunk_submit(self, tokens: np.ndarray, positions: np.ndarray,
+                            active: np.ndarray, temps: np.ndarray, top_ps: np.ndarray,
+                            n_steps: int | None = None, seeds: np.ndarray | None = None,
+                            use_seed: np.ndarray | None = None, chain: bool = False):
+        """Dispatch ``n_steps`` fused decode steps WITHOUT waiting.
 
-        tokens/positions: (S,) pending token + its write position per
-        slot; active: (S,) bool. Returns (tokens, logprobs) as numpy
-        (n_steps, S) — one host readback per chunk.
+        JAX dispatch is asynchronous — the returned handle's arrays are
+        futures. Through a remote-TPU tunnel the per-chunk host↔device
+        round trip costs 50–160 ms (measured, benchmarks/profile_decode
+        round 3), so the scheduler overlaps chunk N's readback with chunk
+        N+1's execution by submitting before it fetches.
+
+        chain=False: decode state (pending token, position, sampling
+        params) is loaded from the host arrays — required for the first
+        chunk and after any admission or failure recovery.
+        chain=True: the previous chunk's device-resident final carry is
+        the input — no host upload, no sync. ``tokens`` is ignored;
+        ``positions``/``active`` are used only for paged write-index
+        allocation and metrics, so the caller passes its *predicted*
+        positions (last processed + in-flight steps). Invalid after any
+        prefill (which clears the carry): submitting chain=True then
+        raises instead of silently decoding stale tokens.
         """
         S = self.config.max_slots
         n = n_steps or self.config.decode_chunk
@@ -706,6 +759,19 @@ class Engine:
         if use_seed is None:
             use_seed = np.zeros((S,), bool)
         with self._lock:
+            if chain:
+                if self._dev_carry is None:
+                    raise RuntimeError(
+                        "decode_chunk_submit(chain=True) with no device carry: "
+                        "a prefill or failure invalidated chained decode state; "
+                        "resubmit with chain=False")
+                tok_in, pos_in = self._dev_carry
+                temps_d, tps_d, seeds_d, used_d = self._dev_sampling
+            else:
+                tok_in, pos_in = jnp.asarray(tokens), jnp.asarray(positions)
+                temps_d, tps_d = jnp.asarray(temps), jnp.asarray(top_ps)
+                seeds_d, used_d = jnp.asarray(seeds), jnp.asarray(use_seed)
+                self._dev_sampling = (temps_d, tps_d, seeds_d, used_d)
             if self.paged:
                 write_idx = np.full((S, n), self._flat_size, np.int64)
                 for slot in range(S):
@@ -716,24 +782,40 @@ class Engine:
                         if valid:
                             self._ensure_with_evict(slot, cap)
                             write_idx[slot, :valid] = self.allocator.flat_write_indices(slot, pos, valid)
-                toks, logprobs, self.cache = self._decode_chunk_fn_paged(
-                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                toks, logprobs, tok_f, pos_f, self.cache = self._decode_chunk_fn_paged(
+                    self.params, self.cache, tok_in, pos_in,
                     jnp.asarray(write_idx), jnp.asarray(self.allocator.page_table()),
-                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(seeds),
-                    jnp.asarray(use_seed), self._next_rng(), n_steps=n,
+                    temps_d, tps_d, seeds_d, used_d, self._next_rng(), n_steps=n,
                 )
             else:
-                toks, logprobs, self.cache = self._decode_chunk_fn(
-                    self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(seeds),
-                    jnp.asarray(use_seed), self._next_rng(), n_steps=n,
+                toks, logprobs, tok_f, pos_f, self.cache = self._decode_chunk_fn(
+                    self.params, self.cache, tok_in, pos_in,
+                    temps_d, tps_d, seeds_d, used_d, self._next_rng(), n_steps=n,
                 )
+            self._dev_carry = (tok_f, pos_f)
             n_active = int(active.sum())
             self.metrics["decode_tokens"] += n_active * n
             self.metrics["decode_steps"] += n
-            # Single fused readback (tokens + logprobs in one transfer).
-            both = np.asarray(jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0))
+            # Tokens + logprobs fused into one buffer → one readback.
+            both = jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0)
+        return _DecodeChunkHandle(both, n)
+
+    def decode_chunk_fetch(self, handle: "_DecodeChunkHandle"):
+        """Block until a submitted chunk's results are on the host.
+        Returns (tokens, logprobs) as numpy (n_steps, S)."""
+        both = np.asarray(handle.toks_lp)
+        n = handle.n_steps
         return both[:n].astype(np.int32), both[n:]
+
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray, active: np.ndarray,
+                     temps: np.ndarray, top_ps: np.ndarray, n_steps: int | None = None,
+                     seeds: np.ndarray | None = None, use_seed: np.ndarray | None = None,
+                     chain: bool = False):
+        """Synchronous submit+fetch — run ``n_steps`` fused decode steps
+        for ALL slots and wait for the (n_steps, S) token block."""
+        return self.decode_chunk_fetch(self.decode_chunk_submit(
+            tokens, positions, active, temps, top_ps, n_steps=n_steps,
+            seeds=seeds, use_seed=use_seed, chain=chain))
 
     # ------------------------------------------------------------------
     def save_checkpoint(self, path: str) -> None:

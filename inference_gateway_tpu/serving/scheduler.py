@@ -50,6 +50,18 @@ class _SlotState:
     generated: int = 1  # pending token counts as generated
 
 
+@dataclass
+class _Inflight:
+    """A submitted-but-unfetched decode chunk: the engine handle, the
+    slots that were active at submit time (later admissions must not
+    consume its rows), and its step count (the position offset for the
+    next chained submit's page allocation)."""
+
+    handle: object
+    slots: frozenset[int]
+    n_steps: int
+
+
 class Scheduler:
     def __init__(self, engine: Engine, logger=None):
         from inference_gateway_tpu.logger import NoopLogger
@@ -63,6 +75,7 @@ class Scheduler:
         self._stop = False
         self._ids = itertools.count()
         self._thread: threading.Thread | None = None
+        self._inflight: _Inflight | None = None  # pipelined decode chunk
         self.queue_depth = 0  # exported metric
         # Liveness: wall-clock of the last completed engine step. The
         # sidecar /health endpoint flags "degraded" when requests are
@@ -98,29 +111,46 @@ class Scheduler:
 
     # -- core loop -----------------------------------------------------
     def run(self) -> None:
+        """Pipelined serving loop: at most one decode chunk in flight.
+
+        Steady state submits chunk N+1 (chained off device-resident
+        carry — no host round-trip) BEFORE fetching chunk N's tokens, so
+        the host↔device round trip (50–160 ms through a remote-TPU
+        tunnel, benchmarks/profile_decode.py) overlaps chunk N+1's
+        execution instead of serializing with it. Admission is a
+        pipeline barrier: prefill invalidates the chained carry and host
+        token state is only authoritative when nothing is in flight, so
+        the loop drains first, admits, then resubmits with host state
+        (chain=False).
+        """
         while True:
             with self._wake:
-                while not self._stop and not self._waiting and not self._slots:
+                while (not self._stop and not self._waiting and not self._slots
+                       and self._inflight is None):
                     self._wake.wait(timeout=0.2)
                 if self._stop:
                     break
-            # A single bad request (prompt over the largest bucket in a
-            # mode with no chunked fallback, KV page pool exhausted, ...)
-            # must never kill the scheduler thread — that would wedge
-            # every queued and active request (advisor round-1 medium).
-            try:
-                self._admit()
-            except Exception as e:
-                # _admit's internal paths fail the affected requests
-                # themselves; reaching here means bookkeeping OUTSIDE
-                # those guards broke. Never silent (round-2 verdict
-                # weak #4): a recurring admission bug must be visible.
-                self.logger.error("scheduler admission error", e)
-            if self._slots:
+                want_admit = bool(self._waiting and self._free)
+            if want_admit:
+                self._drain_inflight()
+                # A single bad request (prompt over the largest bucket in
+                # a mode with no chunked fallback, KV page pool
+                # exhausted, ...) must never kill the scheduler thread —
+                # that would wedge every queued and active request
+                # (advisor round-1 medium).
                 try:
-                    self._decode_step()
+                    self._admit()
                 except Exception as e:
-                    self._fail_after_decode_error(e)
+                    # _admit's internal paths fail the affected requests
+                    # themselves; reaching here means bookkeeping OUTSIDE
+                    # those guards broke. Never silent (round-2 verdict
+                    # weak #4): a recurring admission bug must be visible.
+                    self.logger.error("scheduler admission error", e)
+            prev = self._inflight
+            new = self._submit_chunk() if self._slots else None
+            self._inflight = new
+            if prev is not None:
+                self._process_chunk(prev)
 
     def _fail_request(self, req: GenRequest) -> None:
         try:
@@ -195,15 +225,30 @@ class Scheduler:
                 continue
             self._slots[res.slot] = state
 
-    def _decode_step(self) -> None:
-        """One fused decode chunk for all active slots.
+    def _submit_chunk(self) -> "_Inflight | None":
+        """Dispatch one fused decode chunk without waiting for it.
 
-        The engine scans ``decode_chunk`` steps on-device and the host
-        reads the whole (chunk, slots) token block back once — the only
-        per-chunk host↔device sync. Requests that finish mid-chunk have
-        their trailing tokens discarded (bounded wasted work).
+        With a previous chunk still in flight the submit chains off the
+        engine's device-resident carry (host token state is one chunk
+        stale — exactly why ``tokens`` is ignored in chained mode) and
+        positions are *predicted* as last-processed + in-flight steps,
+        which is deterministic because every active slot advances one
+        token per step; the prediction only pre-allocates KV pages for
+        slots that turn out to finish mid-flight, whose pages are
+        reclaimed on release. Failures are attributed and survive as in
+        the synchronous path.
         """
+        # A request that arrived after run()'s want_admit check would
+        # otherwise wait out this whole chunk before prefill; skip the
+        # submit so the next loop iteration drains and admits instead
+        # (the pre-pipelining code bounded admission latency the same
+        # way by shrinking the chunk to one step).
+        with self._wake:
+            if self._waiting and self._free:
+                return None
         S = self.engine.config.max_slots
+        inflight_steps = self._inflight.n_steps if self._inflight is not None else 0
+        chain = self._inflight is not None
         tokens = np.zeros((S,), np.int32)
         positions = np.zeros((S,), np.int32)
         active = np.zeros((S,), bool)
@@ -211,28 +256,56 @@ class Scheduler:
         top_ps = np.ones((S,), np.float32)
         seeds = np.zeros((S,), np.int32)
         use_seed = np.zeros((S,), bool)
+        max_pos = self.engine.config.max_seq_len - 1
         for slot, st in self._slots.items():
             tokens[slot] = st.pending_token
-            positions[slot] = st.pos
+            positions[slot] = min(st.pos + inflight_steps, max_pos)
             active[slot] = True
             temps[slot] = st.req.temperature
             top_ps[slot] = st.req.top_p
             if st.req.seed is not None:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
-
-        # Shrink the chunk when new work is waiting so admission latency
-        # stays bounded; otherwise run the full configured chunk.
         n = self.engine.config.decode_chunk
-        with self._wake:
-            if self._waiting and self._free:
-                n = 1
-        toks, logprobs = self.engine.decode_chunk(tokens, positions, active, temps, top_ps, n_steps=n,
-                                                  seeds=seeds, use_seed=use_seed)
+        try:
+            handle = self.engine.decode_chunk_submit(
+                tokens, positions, active, temps, top_ps, n_steps=n,
+                seeds=seeds, use_seed=use_seed, chain=chain)
+        except Exception as e:
+            self._fail_after_decode_error(e)
+            return None
+        return _Inflight(handle, frozenset(self._slots), n)
+
+    def _drain_inflight(self) -> None:
+        """Block until the in-flight chunk (if any) is processed."""
+        prev = self._inflight
+        self._inflight = None
+        if prev is not None:
+            self._process_chunk(prev)
+
+    def _process_chunk(self, inf: "_Inflight") -> None:
+        """Fetch a submitted chunk's token block and stream it out.
+
+        Requests that finish mid-chunk have their trailing tokens
+        discarded (bounded wasted work); slots admitted after this chunk
+        was submitted are excluded by the submit-time snapshot.
+        """
+        try:
+            toks, logprobs = self.engine.decode_chunk_fetch(inf.handle)
+        except Exception as e:
+            # The device-side failure poisons the chained carry and any
+            # later-submitted chunk; both are invalidated so recovery
+            # resubmits from host state.
+            self.engine._dev_carry = None
+            self._inflight = None
+            self._fail_after_decode_error(e)
+            return
         self.last_step_time = time.monotonic()
 
-        for slot in list(self._slots):
-            st = self._slots[slot]
+        for slot in inf.slots:
+            st = self._slots.get(slot)
+            if st is None:
+                continue
             for j in range(toks.shape[0]):
                 st.pos += 1
                 st.pending_token = int(toks[j, slot])
@@ -277,6 +350,7 @@ def generate_sync(
     top_p: float = 1.0,
     stop_token_ids: frozenset[int] = frozenset(),
     timeout: float = 120.0,
+    seed: int | None = None,
 ) -> tuple[list[int], str | None]:
     """Blocking helper used by tests and the non-streaming path."""
     q: queue.Queue = queue.Queue()
@@ -286,7 +360,7 @@ def generate_sync(
 
     scheduler.submit(GenRequest(
         prompt_ids=prompt_ids, max_tokens=max_tokens, temperature=temperature,
-        top_p=top_p, stop_token_ids=stop_token_ids, callback=cb,
+        top_p=top_p, stop_token_ids=stop_token_ids, callback=cb, seed=seed,
     ))
     out: list[int] = []
     deadline = time.monotonic() + timeout

@@ -156,19 +156,21 @@ def test_random_slot_faults_fail_only_culprits():
     eng = Engine(cfg)
 
     rng = np.random.default_rng(7)
-    orig_decode_chunk = eng.decode_chunk
+    # The scheduler's pipelined loop goes through submit; injecting there
+    # exercises the submit-failure attribution path.
+    orig_submit = eng.decode_chunk_submit
     state = {"calls": 0}
 
-    def flaky_decode_chunk(tokens, positions, active, temps, top_ps, **kw):
+    def flaky_submit(tokens, positions, active, temps, top_ps, **kw):
         state["calls"] += 1
         # Every few chunks, blame a random active slot (attributable).
         if state["calls"] % 5 == 3:
             live = np.flatnonzero(active)
             if live.size:
                 raise _SlotFault(int(rng.choice(live)))
-        return orig_decode_chunk(tokens, positions, active, temps, top_ps, **kw)
+        return orig_submit(tokens, positions, active, temps, top_ps, **kw)
 
-    eng.decode_chunk = flaky_decode_chunk
+    eng.decode_chunk_submit = flaky_submit
     s = Scheduler(eng)
     s.start()
     try:
@@ -198,7 +200,7 @@ def test_random_slot_faults_fail_only_culprits():
         assert completed > N * 0.5, (errored, completed)
         assert errored > 0  # faults did fire
         # Loop still alive afterwards with the fault injector removed.
-        eng.decode_chunk = orig_decode_chunk
+        eng.decode_chunk_submit = orig_submit
         toks, reason = _collect(s, [9, 8, 7], max_tokens=4)
         assert reason in ("stop", "length")
         # No slot leak: all slots back in the free pool once drained.
@@ -215,16 +217,19 @@ def test_unattributable_fault_fails_batch_but_not_thread():
                        max_prefill_batch=2, use_mesh=False, attention="dense",
                        decode_chunk=2, prefill_buckets=(16, 32, 64))
     eng = Engine(cfg)
-    orig = eng.decode_chunk
+    # Inject at fetch: a device-side error surfaces when the chunk's
+    # results materialize, which is where a real XLA fault lands in the
+    # pipelined loop.
+    orig_fetch = eng.decode_chunk_fetch
     state = {"armed": True}
 
-    def flaky(tokens, positions, active, temps, top_ps, **kw):
+    def flaky(handle):
         if state["armed"]:
             state["armed"] = False
             raise RuntimeError("transient XLA error")  # no .slot attribute
-        return orig(tokens, positions, active, temps, top_ps, **kw)
+        return orig_fetch(handle)
 
-    eng.decode_chunk = flaky
+    eng.decode_chunk_fetch = flaky
     s = Scheduler(eng)
     s.start()
     try:
@@ -258,13 +263,13 @@ def test_release_failure_does_not_kill_cleanup_of_other_victims():
             raise RuntimeError("release bookkeeping bug")
         return orig_release(slot)
 
-    orig_decode = eng.decode_chunk
+    orig_submit = eng.decode_chunk_submit
 
     def fail_once(tokens, positions, active, temps, top_ps, **kw):
-        eng.decode_chunk = orig_decode
+        eng.decode_chunk_submit = orig_submit
         raise RuntimeError("unattributable")
 
-    eng.decode_chunk = fail_once
+    eng.decode_chunk_submit = fail_once
     eng.release_slot = flaky_release
     s = Scheduler(eng)
     s.start()
