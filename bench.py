@@ -140,26 +140,36 @@ def _steady_state_decode_tps(engine, batch: int, prompt_len: int, steps: int) ->
         active[s] = True
 
     chunk = engine.config.decode_chunk
+    max_pos = engine.config.max_seq_len - 1
 
-    def run_chunk():
+    def set_positions():
         for s in slots:
-            positions[s] = pos[s]
-        toks, _ = engine.decode_chunk(tokens, positions, active, temps, top_ps)
-        for s in slots:
+            positions[s] = min(pos[s], max_pos)
             pos[s] += chunk
-            tokens[s] = toks[-1, s]
 
+    # Pipelined steady state — the serving path: the scheduler keeps one
+    # chunk in flight, chaining chunk N+1 off the device-resident carry
+    # while chunk N's tokens cross the tunnel (serving/scheduler.py).
+    set_positions()
+    inflight = engine.decode_chunk_submit(tokens, positions, active, temps, top_ps)
     # Warmup: the first dispatches after compile are slow through the
     # remote-TPU tunnel; measure steady state only.
     for i in range(4):
-        run_chunk()
+        set_positions()
+        nxt = engine.decode_chunk_submit(tokens, positions, active, temps, top_ps, chain=True)
+        engine.decode_chunk_fetch(inflight)
+        inflight = nxt
         _progress(f"warmup chunk {i + 1}/4 done")
 
     n_chunks = max(steps // chunk, 1)
     start = time.perf_counter()
     for _ in range(n_chunks):
-        run_chunk()
+        set_positions()
+        nxt = engine.decode_chunk_submit(tokens, positions, active, temps, top_ps, chain=True)
+        engine.decode_chunk_fetch(inflight)
+        inflight = nxt
     elapsed = time.perf_counter() - start
+    engine.decode_chunk_fetch(inflight)
     for s in slots:
         engine.release_slot(s)
     return (n_chunks * chunk * batch) / elapsed
@@ -189,14 +199,10 @@ def kernel_microbench(interpret: bool = False) -> dict:
     on_tpu = jax.devices()[0].platform in ("tpu", "axon") and not interpret
     iters = 30 if on_tpu else 3
 
+    from inference_gateway_tpu.utils.benchtime import timeit_device
+
     def timeit(fn, *args):
-        r = fn(*args)
-        jax.block_until_ready(r)  # compile
-        t = time.perf_counter()
-        for _ in range(iters):
-            r = fn(*args)
-        jax.block_until_ready(r)
-        return (time.perf_counter() - t) / iters * 1e6, r  # µs, result
+        return timeit_device(fn, *args, iters=iters)  # µs, result
 
     # Paged decode at serving shape: TinyLlama heads, 64 slots, len 512.
     B, Hq, Hkv, D, ps = 64, 32, 4, 64, 64

@@ -206,31 +206,14 @@ def lab_paged_attention(
 
 
 # ---------------------------------------------------------------------------
-def timeit(fn, *args, iters=30):
-    """us/call with rotated inputs.
+from inference_gateway_tpu.utils.benchtime import timeit_device
 
-    The first measurement pass here reused identical input buffers every
-    iteration and read the production paged kernel at 24 us/call — above
-    the HBM roofline for the bytes it must stream, i.e. physically
-    impossible; repeated identical dispatches are evidently short-
-    circuited somewhere in the remote-execution path. Rotating the first
-    argument across 4 distinct buffers defeats that; implied bandwidth
-    is sanity-checked by the caller.
-    """
-    variants = [args]
-    for i in range(1, 4):
-        a0 = args[0] + jnp.asarray(i, args[0].dtype)
-        variants.append((a0,) + args[1:])
-    r = fn(*args)
-    jax.block_until_ready(r)  # compile
-    for va in variants:
-        fn(*va)  # warm each variant
-    jax.block_until_ready(r)
-    t = time.perf_counter()
-    for i in range(iters):
-        r = fn(*variants[i % 4])
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t) / iters * 1e6, fn(*args)
+
+def timeit(fn, *args, iters=30):
+    """us/call with rotated inputs (see utils/benchtime.py for why:
+    identical repeated dispatches get short-circuited below JAX, and
+    warm-up must block on its own results)."""
+    return timeit_device(fn, *args, iters=iters)
 
 
 def main():

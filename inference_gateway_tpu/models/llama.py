@@ -522,6 +522,12 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=32000, hidden_size=2048, num_layers=22, num_heads=32, num_kv_heads=4,
         intermediate_size=5632, max_position_embeddings=2048,
     ),
+    # Draft for speculative decoding against 32k-vocab llama targets
+    # (TinyLlama/Llama-2): ~8x fewer FLOPs per token than TinyLlama.
+    "llama-draft-150m": LlamaConfig(
+        vocab_size=32000, hidden_size=512, num_layers=4, num_heads=8, num_kv_heads=2,
+        intermediate_size=1408, max_position_embeddings=2048,
+    ),
     "llama-2-7b": LlamaConfig(
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=32,
         intermediate_size=11008, max_position_embeddings=4096,

@@ -53,6 +53,20 @@ def chunk_row_keys(
     return jnp.where(use_seed[None, :, None], seeded, unseeded)  # (n, B, 2)
 
 
+def top_k_nucleus(scaled: jnp.ndarray, top_p: jnp.ndarray, top_k: int):
+    """The one top-k + nucleus filter all samplers share: sort the k
+    best (already-tempered) logits, drop everything outside the smallest
+    prefix whose probability mass reaches top_p (always keeping the
+    argmax). Returns (filtered_vals (..., k) with -inf outside the
+    nucleus, idx (..., k))."""
+    vals, idx = jax.lax.top_k(scaled, top_k)  # sorted desc
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p[..., None]
+    keep = keep.at[..., 0].set(True)
+    return jnp.where(keep, vals, -jnp.inf), idx
+
+
 def effective_top_k(top_k: int, vocab_size: int) -> int:
     """The k actually sorted by the fused-decode sampling path: top_k=0
     ("disabled", see sample_tokens) and top_k >= vocab degrade to a
@@ -82,13 +96,7 @@ def sample_tokens_pregumbel(
     logits = logits.astype(jnp.float32)
     greedy_tok = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, GREEDY_EPS)[:, None]
-    scaled = logits / temp
-    vals, idx = jax.lax.top_k(scaled, top_k)
-    sorted_probs = jax.nn.softmax(vals, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    keep = cum - sorted_probs < top_p[:, None]
-    keep = keep.at[:, 0].set(True)
-    filtered = jnp.where(keep, vals, -jnp.inf)
+    filtered, idx = top_k_nucleus(logits / temp, top_p, top_k)
     sampled_in_k = jnp.argmax(filtered + gumbel, axis=-1)
     sampled_tok = jnp.take_along_axis(idx, sampled_in_k[:, None], axis=-1)[:, 0]
     return jnp.where(temperature <= GREEDY_EPS, greedy_tok, sampled_tok)
@@ -118,12 +126,7 @@ def sample_tokens(
         # step budget on real v5e hardware (round-3 profiling: sorts
         # lower terribly on TPU; the whole 22-layer TinyLlama forward
         # was cheaper than one 32k-column argsort).
-        vals, idx = jax.lax.top_k(scaled, top_k)  # (B, k) desc + indices
-        sorted_probs = jax.nn.softmax(vals, axis=-1)
-        cum = jnp.cumsum(sorted_probs, axis=-1)
-        keep = cum - sorted_probs < top_p[:, None]
-        keep = keep.at[:, 0].set(True)
-        filtered = jnp.where(keep, vals, -jnp.inf)
+        filtered, idx = top_k_nucleus(scaled, top_p, top_k)
         if row_keys is None:
             sampled_in_k = jax.random.categorical(rng, filtered, axis=-1)
         else:

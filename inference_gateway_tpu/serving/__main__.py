@@ -26,6 +26,11 @@ def main() -> None:
     p.add_argument("--page-size", type=int, default=32)
     p.add_argument("--decode-chunk", type=int, default=8)
     p.add_argument("--vision-model", default=None, help="vision tower preset for multimodal")
+    p.add_argument("--spec-draft", default=None,
+                   help="speculative decoding: llama-family draft model preset/"
+                        "path sharing the target's vocab (serving/speculative.py)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens proposed per speculative round")
     p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
     p.add_argument("--metrics-push-url", default=None,
                    help="gateway OTLP push endpoint (e.g. http://gateway:8080/v1/metrics)")
@@ -58,6 +63,8 @@ def main() -> None:
         page_size=args.page_size,
         decode_chunk=args.decode_chunk,
         vision_model=args.vision_model,
+        spec_draft=args.spec_draft,
+        spec_k=args.spec_k,
     )
     asyncio.run(serve(cfg, host=args.host, port=args.port, served_model_name=args.served_model_name,
                       metrics_push_url=args.metrics_push_url))

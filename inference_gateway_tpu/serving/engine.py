@@ -74,6 +74,12 @@ class EngineConfig:
     # latency (dominant through remote-TPU tunnels) at the cost of up to
     # chunk-1 wasted steps per finished request.
     decode_chunk: int = 8
+    # Speculative decoding: a llama-family draft model (preset name or
+    # HF path, same vocab as the target) proposes spec_k tokens per
+    # round; the target verifies all of them in one forward
+    # (serving/speculative.py). None = disabled.
+    spec_draft: str | None = None
+    spec_k: int = 4
 
 
 @dataclass
@@ -215,6 +221,30 @@ class Engine:
                 cache_specs = {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}
                 cache = jax.device_put(cache, named(self.mesh, cache_specs))
             self.cache = cache
+
+        # Optional draft model for speculative decoding (config.spec_draft
+        # names a llama-family preset/checkpoint sharing the target's
+        # vocab). The draft keeps its own DENSE slot cache — it is small,
+        # and dense rows make the ≤2-token catch-up writes trivial.
+        self.spec = config.spec_draft is not None
+        self.draft_cfg = None
+        self.draft_params = None
+        self.draft_cache = None
+        if self.spec:
+            assert not self.is_moe, "speculative decoding: MoE targets not supported yet"
+            assert self.mesh is None, (
+                "speculative decoding is single-device for now (draft params "
+                "are unsharded); run with use_mesh=False")
+            if config.spec_draft in llama.PRESETS:
+                self.draft_cfg = llama.PRESETS[config.spec_draft]
+                self.draft_params = llama.init_params(
+                    jax.random.PRNGKey(config.seed + 11), self.draft_cfg, dtype=self.dtype)
+            else:
+                self.draft_cfg, self.draft_params = self._load_hf(config.spec_draft)
+            assert self.draft_cfg.vocab_size == self.model_cfg.vocab_size, (
+                "draft and target must share a vocabulary")
+            self.draft_cache = llama.init_cache(
+                self.draft_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
 
         # Optional vision tower for the ENABLE_VISION multimodal path.
         self.vision_cfg = None
@@ -490,6 +520,11 @@ class Engine:
             e is not None and len(p) > biggest for e, p in zip(embeds, prompts)
         ):
             long_path = False
+        if self.spec and any(len(p) > biggest for p in prompts):
+            raise ValueError(
+                "speculative decoding requires prompts within the largest "
+                "prefill bucket (the draft has no long-context prefill path "
+                "yet); size prefill_buckets to cover max_seq_len")
         if long_path and any(len(p) > biggest for p in prompts):
             results = []
             short_idx = [i for i, p in enumerate(prompts) if len(p) <= biggest]
@@ -599,6 +634,18 @@ class Engine:
                 )
             self.metrics["prefill_tokens"] += int(lengths.sum())
             self.metrics["prefill_batches"] += 1
+            if self.spec:
+                # The draft model ingests the FULL prompt into its own
+                # dense cache (no prefix sharing on the draft side), so
+                # every spec round's catch-up stays ≤ 2 tokens.
+                d_tokens = np.zeros((Bp, bucket), np.int32)
+                for i, prompt in enumerate(prompts):
+                    d_tokens[i, : len(prompt)] = prompt
+                d_positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (Bp, bucket))
+                self.draft_cache = self._draft_prefill_fn(
+                    self.draft_params, self.draft_cache, jnp.asarray(d_tokens),
+                    jnp.asarray(d_positions), jnp.asarray(lengths), jnp.asarray(slot_arr),
+                )
         toks = np.asarray(toks)
         logprobs = np.asarray(logprobs)
         return [PrefillResult(slot, int(toks[i]), float(logprobs[i])) for i, slot in enumerate(slots)]
@@ -799,6 +846,166 @@ class Engine:
             # Tokens + logprobs fused into one buffer → one readback.
             both = jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0)
         return _DecodeChunkHandle(both, n)
+
+    # -- speculative decoding (serving/speculative.py) ------------------
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    def _draft_prefill_fn(self, dparams, dcache, tokens, positions, lengths, slot_ids):
+        _, dcache = llama.forward(
+            dparams, self.draft_cfg, tokens, positions, lengths, dcache,
+            mode="prefill", last_only=True, slot_ids=slot_ids,
+        )
+        return dcache
+
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(3, 4))
+    def _spec_round_fn(self, params, dparams, cache, dcache, catchup, catchup_len,
+                       catchup_pos, temps, top_ps, write_idx, page_table,
+                       uniforms, draft_gumbels, extra_gumbel):
+        """One speculative round for ALL slots (static shapes).
+
+        catchup (S, 2): the emitted tokens the draft hasn't ingested
+        (always 1 or 2 — see serving/speculative.py); catchup_pos (S,)
+        is the position of catchup[:, 0] (== the draft's current cache
+        length D); the pending token sits at P = D + catchup_len - 1.
+        Returns (out_tokens (S, K+1), logprobs (S, K+1), counts (S,),
+        cache, dcache).
+        """
+        from inference_gateway_tpu.serving.speculative import spec_accept, strip_dist, strip_sample
+
+        dcfg = self.draft_cfg
+        K = self.config.spec_k
+        k = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
+        S = catchup.shape[0]
+        D = catchup_pos
+        P = D + catchup_len - 1
+        greedy = temps <= 1e-4
+        slot_ids = jnp.arange(S, dtype=jnp.int32)
+        max_len = self.config.max_seq_len
+
+        # --- draft catch-up: ≤2-token block at positions D, D+1 --------
+        cu_positions = D[:, None] + jnp.arange(2, dtype=jnp.int32)[None, :]
+        dlogits, dcache = llama.forward(
+            dparams, dcfg, catchup, cu_positions, D + catchup_len, dcache,
+            mode="prefill_chunk", last_only=True, slot_ids=slot_ids,
+        )
+
+        # --- K draft proposals (scan over draft decode steps) ----------
+        q0_probs, q0_idx = strip_dist(dlogits, temps, top_ps, k)
+        d1 = strip_sample(q0_probs, q0_idx, draft_gumbels[:, 0], greedy)
+
+        def dstep(carry, xs):
+            dcache, tok, pos = carry
+            i, gum = xs
+            lg, dcache = llama.forward(
+                dparams, dcfg, tok[:, None], pos[:, None], pos + 1, dcache,
+                mode="decode", slot_ids=slot_ids,
+            )
+            qp, qi = strip_dist(lg[:, 0], temps, top_ps, k)
+            nxt = strip_sample(qp, qi, gum, greedy)
+            return (dcache, nxt, jnp.minimum(pos + 1, max_len - 1)), (nxt, qp, qi)
+
+        if K > 1:
+            (dcache, _, _), (d_rest, q_rest_p, q_rest_i) = jax.lax.scan(
+                dstep, (dcache, d1, jnp.minimum(P + 1, max_len - 1)),
+                (jnp.arange(1, K), draft_gumbels[:, 1:].swapaxes(0, 1)),
+            )
+            draft_tokens = jnp.concatenate([d1[:, None], d_rest.swapaxes(0, 1)], axis=1)
+            q_probs = jnp.concatenate([q0_probs[:, None], q_rest_p.swapaxes(0, 1)], axis=1)
+            q_idx = jnp.concatenate([q0_idx[:, None], q_rest_i.swapaxes(0, 1)], axis=1)
+        else:
+            draft_tokens = d1[:, None]
+            q_probs, q_idx = q0_probs[:, None], q0_idx[:, None]
+
+        # --- target verify: one forward over [pending, d_1..d_K] -------
+        pending = jnp.take_along_axis(catchup, (catchup_len - 1)[:, None], axis=1)
+        ver_tokens = jnp.concatenate([pending, draft_tokens], axis=1)  # (S, K+1)
+        ver_positions = jnp.minimum(
+            P[:, None] + jnp.arange(K + 1, dtype=jnp.int32)[None, :], max_len - 1)
+        ver_lengths = jnp.minimum(P + K + 1, max_len)
+        if self.paged:
+            logits, cache = self._model.forward_paged(
+                params, self.model_cfg, ver_tokens, ver_positions, ver_lengths,
+                cache, write_idx, page_table, mode="prefill_chunk", last_only=False,
+                mesh=self.mesh,
+            )
+        else:
+            logits, cache = self._model.forward(
+                params, self.model_cfg, ver_tokens, ver_positions, ver_lengths,
+                cache, mode="prefill_chunk", last_only=False, slot_ids=slot_ids,
+            )
+        p_probs, p_idx = strip_dist(
+            logits, jnp.broadcast_to(temps[:, None], (S, K + 1)),
+            jnp.broadcast_to(top_ps[:, None], (S, K + 1)), k)
+
+        out, counts = spec_accept(p_probs, p_idx, q_probs, q_idx, draft_tokens,
+                                  uniforms, extra_gumbel, greedy)
+        # Target logprob of each emitted token: dist at position j
+        # predicts the token emitted as out[:, j].
+        logp_full = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logprobs = jnp.take_along_axis(logp_full, out[:, :, None], axis=2)[:, :, 0]
+        return out, logprobs, counts, cache, dcache
+
+    def spec_round(self, catchup: np.ndarray, catchup_len: np.ndarray,
+                   catchup_pos: np.ndarray, active: np.ndarray,
+                   temps: np.ndarray, top_ps: np.ndarray,
+                   seeds: np.ndarray | None = None,
+                   use_seed: np.ndarray | None = None):
+        """One speculative round for all slots: draft K, verify once,
+        emit 1..K+1 tokens per live slot. Returns (out_tokens (S, K+1),
+        logprobs (S, K+1), counts (S,)) as numpy."""
+        assert self.spec, "engine built without spec_draft"
+        S = self.config.max_slots
+        K = self.config.spec_k
+        k = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
+        if seeds is None:
+            seeds = np.zeros((S,), np.int32)
+        if use_seed is None:
+            use_seed = np.zeros((S,), bool)
+        with self._lock:
+            base_pos = catchup_pos + catchup_len - 1  # P per slot
+            if self.paged:
+                write_idx = np.full((S, K + 1), self._flat_size, np.int64)
+                for slot in range(S):
+                    if active[slot]:
+                        pos = int(base_pos[slot])
+                        cap = min(pos + K + 1, self.config.max_seq_len)
+                        valid = max(0, cap - pos)
+                        if valid:
+                            self._ensure_with_evict(slot, cap)
+                            write_idx[slot, :valid] = self.allocator.flat_write_indices(slot, pos, valid)
+                page_table = jnp.asarray(self.allocator.page_table())
+            else:
+                write_idx = np.zeros((S, K + 1), np.int64)
+                page_table = jnp.zeros((S, 1), jnp.int32)
+            # Per-round randomness: seeded rows derive from (seed, P) so a
+            # request's stream is reproducible regardless of batching.
+            rng = self._next_rng()
+            keys = jnp.where(
+                jnp.asarray(use_seed)[:, None],
+                jax.vmap(lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p))(
+                    jnp.asarray(seeds), jnp.asarray(base_pos.astype(np.int32))),
+                jax.vmap(lambda b: jax.random.fold_in(rng, b))(jnp.arange(S)),
+            )
+            uniforms = jax.vmap(lambda kk: jax.random.uniform(jax.random.fold_in(kk, 0), (K,)))(keys)
+            draft_gumbels = jax.vmap(lambda kk: jax.random.gumbel(jax.random.fold_in(kk, 1), (K, k)))(keys)
+            extra_gumbel = jax.vmap(lambda kk: jax.random.gumbel(jax.random.fold_in(kk, 2), (k,)))(keys)
+            out, logprobs, counts, self.cache, self.draft_cache = self._spec_round_fn(
+                self.params, self.draft_params, self.cache, self.draft_cache,
+                jnp.asarray(catchup.astype(np.int32)), jnp.asarray(catchup_len.astype(np.int32)),
+                jnp.asarray(catchup_pos.astype(np.int32)), jnp.asarray(temps),
+                jnp.asarray(top_ps), jnp.asarray(write_idx), page_table,
+                uniforms, draft_gumbels, extra_gumbel,
+            )
+            self._dev_carry = None  # spec rounds don't chain with decode chunks
+            n_active = int(active.sum())
+            self.metrics["decode_steps"] += 1
+            both = np.asarray(jnp.concatenate(
+                [out.astype(jnp.float32), logprobs,
+                 counts.astype(jnp.float32)[:, None]], axis=1))
+        out_np = both[:, :K + 1].astype(np.int32)
+        logp_np = both[:, K + 1:2 * (K + 1)]
+        counts_np = both[:, -1].astype(np.int32)
+        self.metrics["decode_tokens"] += int(counts_np[active].sum()) if n_active else 0
+        return out_np, logp_np, counts_np
 
     def decode_chunk_fetch(self, handle: "_DecodeChunkHandle"):
         """Block until a submitted chunk's results are on the host.

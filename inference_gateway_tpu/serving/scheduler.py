@@ -48,6 +48,11 @@ class _SlotState:
     pending_token: int  # sampled but not yet written
     pending_logprob: float
     generated: int = 1  # pending token counts as generated
+    # Speculative decoding bookkeeping (engine.spec): how many tokens the
+    # draft model's cache holds, and the ≤2 emitted tokens it has not
+    # ingested yet (serving/speculative.py invariants).
+    draft_len: int = 0
+    catchup: tuple = ()
 
 
 @dataclass
@@ -146,11 +151,26 @@ class Scheduler:
                     # those guards broke. Never silent (round-2 verdict
                     # weak #4): a recurring admission bug must be visible.
                     self.logger.error("scheduler admission error", e)
+            if self.engine.spec:
+                # Speculative rounds are synchronous (draft + verify per
+                # round, 1..K+1 tokens out); no chunk pipeline.
+                if self._slots:
+                    try:
+                        self._spec_step()
+                    except Exception as e:
+                        self._fail_after_decode_error(e)
+                continue
             prev = self._inflight
             new = self._submit_chunk() if self._slots else None
             self._inflight = new
             if prev is not None:
-                self._process_chunk(prev)
+                try:
+                    self._process_chunk(prev)
+                except Exception as e:
+                    # _process_chunk guards its fetch and release paths;
+                    # reaching here means emission bookkeeping broke.
+                    # Never let it kill the scheduler thread.
+                    self._fail_after_decode_error(e)
 
     def _fail_request(self, req: GenRequest) -> None:
         try:
@@ -218,7 +238,9 @@ class Scheduler:
             return
         for req, res in zip(batch, results):
             state = _SlotState(req, pos=len(req.prompt_ids), pending_token=res.first_token,
-                               pending_logprob=res.logprob)
+                               pending_logprob=res.logprob,
+                               draft_len=len(req.prompt_ids),
+                               catchup=(res.first_token,))
             finished, reason = self._emit(state, res.first_token, res.logprob)
             if finished:
                 self._release(res.slot, reason)
@@ -276,6 +298,61 @@ class Scheduler:
             return None
         return _Inflight(handle, frozenset(self._slots), n)
 
+    def _spec_step(self) -> None:
+        """One speculative round: emits 1..K+1 tokens per live slot.
+
+        Per-slot bookkeeping follows serving/speculative.py's invariants:
+        st.pos is the pending token's position P, st.draft_len the draft
+        cache's valid length D, st.catchup the ≤2 emitted tokens the
+        draft hasn't ingested (P == D + len(catchup) - 1 always).
+        """
+        S = self.engine.config.max_slots
+        K = self.engine.config.spec_k
+        catchup = np.zeros((S, 2), np.int32)
+        catchup_len = np.ones((S,), np.int32)
+        catchup_pos = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        top_ps = np.ones((S,), np.float32)
+        seeds = np.zeros((S,), np.int32)
+        use_seed = np.zeros((S,), bool)
+        for slot, st in self._slots.items():
+            cu = st.catchup
+            catchup[slot, : len(cu)] = cu
+            catchup_len[slot] = len(cu)
+            catchup_pos[slot] = st.draft_len
+            active[slot] = True
+            temps[slot] = st.req.temperature
+            top_ps[slot] = st.req.top_p
+            if st.req.seed is not None:
+                seeds[slot] = int(st.req.seed)
+                use_seed[slot] = True
+
+        out, logprobs, counts = self.engine.spec_round(
+            catchup, catchup_len, catchup_pos, active, temps, top_ps,
+            seeds=seeds, use_seed=use_seed)
+        self.last_step_time = time.monotonic()
+
+        for slot in list(self._slots):
+            st = self._slots[slot]
+            n = int(counts[slot])
+            P = st.pos
+            finished = False
+            for j in range(n):
+                st.pos += 1
+                st.pending_token = int(out[slot, j])
+                st.pending_logprob = float(logprobs[slot, j])
+                st.generated += 1
+                finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
+                if finished:
+                    del self._slots[slot]
+                    self._release_guarded(slot, reason)
+                    break
+            if not finished:
+                st.draft_len = P + min(n, K)
+                st.catchup = tuple(int(t) for t in out[slot, max(n - 2, 0):n]) \
+                    if n == K + 1 else (int(out[slot, n - 1]),)
+
     def _drain_inflight(self) -> None:
         """Block until the in-flight chunk (if any) is processed."""
         prev = self._inflight
@@ -314,8 +391,18 @@ class Scheduler:
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:
                     del self._slots[slot]
-                    self._release(slot, reason)
+                    self._release_guarded(slot, reason)
                     break
+
+    def _release_guarded(self, slot: int, reason: str | None) -> None:
+        """Release on the normal finish path: an allocator bookkeeping
+        error must fail at most this slot's cleanup, never the scheduler
+        thread (the invariant the pre-pipelining loop guarded with its
+        decode-step try/except; code-review round 3)."""
+        try:
+            self._release(slot, reason)
+        except Exception as e:
+            self.logger.error("slot release failed on finish", e, "slot", slot)
 
     def _emit(self, st: _SlotState, token: int, logprob: float) -> tuple[bool, str | None]:
         """Send one token to the request's callback; decide termination."""

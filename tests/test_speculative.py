@@ -1,0 +1,168 @@
+"""Speculative decoding (serving/speculative.py + engine spec rounds).
+
+The load-bearing property: speculation is a THROUGHPUT optimization with
+no semantic surface — greedy streams are token-for-token identical to
+non-speculative greedy decoding (regardless of how bad the draft is),
+and sampled rows draw from the same filtered target distribution.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.scheduler import Scheduler, generate_sync
+from inference_gateway_tpu.serving.speculative import (
+    residual_dist,
+    spec_accept,
+    strip_dist,
+    strip_prob_of,
+)
+
+
+# ---------------------------------------------------------------------------
+# Strip algebra
+# ---------------------------------------------------------------------------
+def test_strip_dist_normalizes_and_filters():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(3, 50)), jnp.float32)
+    temps = jnp.asarray([0.7, 1.0, 0.0])
+    top_ps = jnp.asarray([0.9, 0.5, 1.0])
+    probs, idx = strip_dist(logits, temps, top_ps, 8)
+    assert probs.shape == (3, 8) and idx.shape == (3, 8)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, rtol=1e-5)
+    # Greedy row (temp 0) is one-hot on the argmax.
+    g = np.asarray(probs[2])
+    assert g[0] == pytest.approx(1.0) and np.all(g[1:] == 0)
+    assert int(idx[2, 0]) == int(jnp.argmax(logits[2]))
+
+
+def test_residual_dist_math():
+    # p and q on overlapping strips: residual = norm(max(p - q, 0)).
+    p_probs = jnp.asarray([[0.5, 0.3, 0.2]])
+    p_idx = jnp.asarray([[7, 3, 5]])
+    q_probs = jnp.asarray([[0.6, 0.4, 0.0]])
+    q_idx = jnp.asarray([[3, 7, 9]])  # q(3)=0.6, q(7)=0.4
+    r = np.asarray(residual_dist(p_probs, p_idx, q_probs, q_idx))[0]
+    # max(p-q,0): token7: 0.5-0.4=0.1; token3: 0.3-0.6=0; token5: 0.2-0=0.2
+    np.testing.assert_allclose(r, [0.1 / 0.3, 0.0, 0.2 / 0.3], rtol=1e-5)
+    # p == q collapses to p (degenerate residual).
+    r2 = np.asarray(residual_dist(p_probs, p_idx, p_probs, p_idx))[0]
+    np.testing.assert_allclose(r2, np.asarray(p_probs)[0], rtol=1e-5)
+
+
+def test_spec_accept_greedy_is_exact_argmax():
+    """Greedy rows: accept while draft == target argmax; the extra token
+    is the target argmax at the first mismatch."""
+    S, K, k = 2, 3, 4
+    # Target argmaxes at positions 0..K: tokens 10, 11, 12, 13.
+    p_idx = jnp.tile(jnp.asarray([10, 11, 12, 13])[None, :, None] + jnp.arange(k)[None, None, :] * 100,
+                     (S, 1, 1))
+    p_probs = jnp.tile(jnp.asarray([1.0, 0, 0, 0])[None, None, :], (S, K + 1, 1))
+    q_probs = p_probs[:, :K]
+    # Row 0 drafts all argmaxes; row 1 mismatches at draft 2.
+    draft = jnp.asarray([[10, 11, 12], [10, 99, 12]], jnp.int32)
+    q_idx = jnp.where(draft[:, :, None] == draft[:, :, None], draft[:, :, None], draft[:, :, None])
+    q_idx = jnp.tile(draft[:, :, None], (1, 1, k))  # draft's one-hot strip
+    uniforms = jnp.full((S, K), 0.5)
+    gum = jnp.zeros((S, k))
+    greedy = jnp.asarray([True, True])
+    out, counts = spec_accept(p_probs, p_idx, q_probs, q_idx, draft, uniforms, gum, greedy)
+    out, counts = np.asarray(out), np.asarray(counts)
+    # Row 0: all 3 accepted + bonus argmax(13) -> 4 tokens.
+    assert counts[0] == 4 and list(out[0]) == [10, 11, 12, 13]
+    # Row 1: accepts 10, rejects 99, extra = target argmax at pos 1 = 11.
+    assert counts[1] == 2 and list(out[1, :2]) == [10, 11]
+
+
+# ---------------------------------------------------------------------------
+# Engine rounds
+# ---------------------------------------------------------------------------
+def _mk_cfg(attention, **kw):
+    return EngineConfig(model="test-tiny", max_slots=4, max_seq_len=128, dtype="float32",
+                        max_prefill_batch=2, use_mesh=False, attention=attention,
+                        page_size=16, prefix_cache=False, decode_chunk=4,
+                        prefill_buckets=(16, 32, 64, 128), **kw)
+
+
+@pytest.mark.parametrize("attention", ["dense", "paged"])
+def test_greedy_spec_equals_greedy_decode(attention):
+    """A DIFFERENT random draft must still reproduce the target's greedy
+    stream exactly — speculation can only change speed, not tokens."""
+    ref_eng = Engine(_mk_cfg(attention))
+    s = Scheduler(ref_eng)
+    s.start()
+    try:
+        refs = [generate_sync(s, p, max_tokens=12)
+                for p in ([1, 2, 3], [9, 8, 7, 6], [5, 5])]
+    finally:
+        s.stop()
+
+    spec_eng = Engine(_mk_cfg(attention, spec_draft="test-tiny", spec_k=3))
+    s2 = Scheduler(spec_eng)
+    s2.start()
+    try:
+        got = [generate_sync(s2, p, max_tokens=12)
+               for p in ([1, 2, 3], [9, 8, 7, 6], [5, 5])]
+    finally:
+        s2.stop()
+    assert got == refs, f"{attention}: spec diverged from greedy reference"
+
+
+def test_self_draft_accepts_everything():
+    """With the draft == the target, greedy rounds accept all K drafts +
+    bonus: counts == K+1 every round."""
+    eng = Engine(_mk_cfg("dense", spec_draft="test-tiny", spec_k=3))
+    eng.draft_params = eng.params
+    eng.draft_cfg = eng.model_cfg
+    eng.draft_cache = eng._model.init_cache(
+        eng.model_cfg, eng.config.max_slots, eng.config.max_seq_len, dtype=eng.dtype)
+
+    res = eng.prefill([[1, 2, 3]], [0], [0.0], [1.0])[0]
+    S = eng.config.max_slots
+    catchup = np.zeros((S, 2), np.int32)
+    catchup[0, 0] = res.first_token
+    catchup_len = np.ones((S,), np.int32)
+    catchup_pos = np.zeros((S,), np.int32)
+    catchup_pos[0] = 3
+    active = np.zeros((S,), bool)
+    active[0] = True
+    temps = np.zeros((S,), np.float32)
+    top_ps = np.ones((S,), np.float32)
+    out, logp, counts = eng.spec_round(catchup, catchup_len, catchup_pos, active, temps, top_ps)
+    assert counts[0] == eng.config.spec_k + 1, (counts[0], list(out[0]))
+
+
+@pytest.mark.parametrize("attention", ["dense", "paged"])
+def test_seeded_spec_sampling_deterministic(attention):
+    """Same seed, same prompt → identical sampled stream across runs."""
+    outs = []
+    for _ in range(2):
+        eng = Engine(_mk_cfg(attention, spec_draft="test-tiny", spec_k=2))
+        s = Scheduler(eng)
+        s.start()
+        try:
+            outs.append(generate_sync(s, [3, 1, 4], max_tokens=10,
+                                      temperature=0.8, top_p=0.9, seed=42))
+        finally:
+            s.stop()
+    assert outs[0] == outs[1]
+
+
+def test_spec_near_max_seq_len_finishes_cleanly():
+    """Rounds that would run past max_seq_len clamp writes and finish
+    with reason 'length' (no page-table overrun in paged mode)."""
+    cfg = _mk_cfg("paged", spec_draft="test-tiny", spec_k=3)
+    eng = Engine(cfg)
+    s = Scheduler(eng)
+    s.start()
+    try:
+        prompt = [1 + (i % 7) for i in range(120)]  # near max_seq_len=128
+        toks, reason = generate_sync(s, prompt, max_tokens=64)
+        assert reason == "length"
+        assert len(toks) >= 1
+    finally:
+        s.stop()
