@@ -118,10 +118,24 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                         except ValueError:
                             continue
                         usage = parse_usage(payload) or usage
-                        # Responses API streams carry usage inside the
-                        # final event's nested `response` object.
-                        if isinstance(payload.get("response"), dict):
-                            usage = parse_usage(payload["response"]) or usage
+                        # Responses API streams: the final
+                        # `response.completed` event carries the nested
+                        # `response` object with usage AND the complete
+                        # `output` array. Scanning output there (not the
+                        # per-item added/done events) is eviction-proof —
+                        # the event is always in the ring's tail window —
+                        # and counts each function call exactly once
+                        # (code-review round 3: item-event matching
+                        # double-counted added+done and lost calls whose
+                        # events fell off the 4-chunk ring).
+                        final = payload.get("response")
+                        if isinstance(final, dict):
+                            usage = parse_usage(final) or usage
+                            for item in final.get("output") or []:
+                                if isinstance(item, dict) and item.get("type") == "function_call":
+                                    name = item.get("name")
+                                    if name:
+                                        tool_names.append(name)
                         for choice in payload.get("choices") or []:
                             delta = choice.get("delta") or {}
                             for tc in delta.get("tool_calls") or []:
@@ -143,6 +157,13 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                 for choice in payload.get("choices") or []:
                     msg = choice.get("message") or {}
                     tool_names.extend(n for n in extract_tool_calls(msg) if n)
+                # Responses API non-streaming bodies carry function calls
+                # as `output` items of type function_call, not `choices`.
+                for item in payload.get("output") or []:
+                    if isinstance(item, dict) and item.get("type") == "function_call":
+                        name = item.get("name")
+                        if name:
+                            tool_names.append(name)
             except ValueError:
                 pass
         record(error_type, usage, tool_names)

@@ -207,54 +207,15 @@ class RouterImpl:
             return error_json("Invalid request: " + "; ".join(problems), 400)
 
         original_model = body.get("model") or ""
-        model = original_model
-        provider_id = req.query_get("provider")
-        routed: routing.Deployment | None = None
-
-        if self.selector is not None and not provider_id:
-            routed = self.selector.select(model)
-            if routed is not None:
-                provider_id = routed.provider
-                model = routed.model
-                self.logger.debug("routed logical model", "alias", original_model,
-                                  "provider", routed.provider, "model", routed.model)
-
-        if not provider_id:
-            detected, model = routing.determine_provider_and_model_name(model)
-            if detected is None:
-                return error_json(
-                    "Unable to determine provider for model. Please specify a provider "
-                    "using the ?provider= query parameter or use the provider/model "
-                    "format (e.g., openai/gpt-4).", 400)
-            provider_id = detected
+        route = self._resolve_route(req, original_model)
+        if isinstance(route, Response):
+            return route
+        provider, provider_id, model, routed = route
 
         body = dict(body)
         body["model"] = model
-
-        # Allow/deny checks use the original (possibly prefixed) id
-        # (routes.go:641-653).
-        if self.cfg.allowed_models:
-            if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
-                return error_json("Model not allowed. Please check the list of allowed models.", 403)
-        elif self.cfg.disallowed_models:
-            if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
-                return error_json("Model is disallowed. Please use a different model.", 403)
-
-        try:
-            provider = self._build_provider(provider_id)
-        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
-            return self._provider_error(e, provider_id)
-
-        # Vision gate (routes.go:670-706).
-        if self.cfg.enable_vision:
-            messages = body.get("messages") or []
-            if any(has_image_content(m) for m in messages if isinstance(m, dict)):
-                if not provider.supports_vision(model):
-                    self.logger.info("filtering images from non-vision model request",
-                                     "provider", provider_id, "model", model)
-                    body["messages"] = [
-                        strip_image_content(m) if isinstance(m, dict) else m for m in messages
-                    ]
+        body["messages"] = self._vision_gate(
+            provider, provider_id, model, body.get("messages") or [])
 
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
         headers_extra = {}
@@ -289,6 +250,56 @@ class RouterImpl:
         return resp
 
     # ------------------------------------------------------------------
+    def _resolve_route(self, req: Request, original_model: str):
+        """Shared model-routing for chat-shaped endpoints (chat
+        completions + responses): routing-pool alias resolution,
+        provider/model prefix parsing, allow/deny enforcement on the
+        ORIGINAL id (routes.go:641-653), and provider construction.
+        Returns (provider, provider_id, model, routed) or an error
+        Response — one implementation so the two endpoints can never
+        drift (code-review round 3)."""
+        model = original_model
+        provider_id = req.query_get("provider")
+        routed: routing.Deployment | None = None
+        if self.selector is not None and not provider_id:
+            routed = self.selector.select(model)
+            if routed is not None:
+                provider_id = routed.provider
+                model = routed.model
+                self.logger.debug("routed logical model", "alias", original_model,
+                                  "provider", routed.provider, "model", routed.model)
+        if not provider_id:
+            detected, model = routing.determine_provider_and_model_name(model)
+            if detected is None:
+                return error_json(
+                    "Unable to determine provider for model. Please specify a provider "
+                    "using the ?provider= query parameter or use the provider/model "
+                    "format (e.g., openai/gpt-4).", 400)
+            provider_id = detected
+        if self.cfg.allowed_models:
+            if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
+                return error_json("Model not allowed. Please check the list of allowed models.", 403)
+        elif self.cfg.disallowed_models:
+            if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
+                return error_json("Model is disallowed. Please use a different model.", 403)
+        try:
+            provider = self._build_provider(provider_id)
+        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
+            return self._provider_error(e, provider_id)
+        return provider, provider_id, model, routed
+
+    def _vision_gate(self, provider, provider_id: str, model: str, messages: list) -> list:
+        """Strip image parts for non-vision providers (routes.go:670-706)."""
+        if not self.cfg.enable_vision:
+            return messages
+        if not any(has_image_content(m) for m in messages if isinstance(m, dict)):
+            return messages
+        if provider.supports_vision(model):
+            return messages
+        self.logger.info("filtering images from non-vision model request",
+                         "provider", provider_id, "model", model)
+        return [strip_image_content(m) if isinstance(m, dict) else m for m in messages]
+
     async def responses_handler(self, req: Request) -> Response:
         """POST /v1/responses — OpenAI Responses API, IMPLEMENTED.
 
@@ -319,45 +330,18 @@ class RouterImpl:
                 "response store (stateless by design)", 400)
 
         original_model = body.get("model") or ""
-        model = original_model
-        provider_id = req.query_get("provider")
-        # Same logical-model selector the chat path consults
-        # (routes.py chat handler): a routing-pool alias must resolve
-        # identically on both endpoints.
-        if self.selector is not None and not provider_id:
-            routed = self.selector.select(model)
-            if routed is not None:
-                provider_id = routed.provider
-                model = routed.model
-        if not provider_id:
-            detected, model = routing.determine_provider_and_model_name(model)
-            if detected is None:
-                return error_json(
-                    "Unable to determine provider for model. Please specify a provider "
-                    "using the ?provider= query parameter or use the provider/model "
-                    "format (e.g., openai/gpt-4).", 400)
-            provider_id = detected
-        if self.cfg.allowed_models:
-            if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
-                return error_json("Model not allowed. Please check the list of allowed models.", 403)
-        elif self.cfg.disallowed_models:
-            if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
-                return error_json("Model is disallowed. Please use a different model.", 403)
-        try:
-            provider = self._build_provider(provider_id)
-        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
-            return self._provider_error(e, provider_id)
+        # Same routing/ACL/provider/vision pipeline as the chat path —
+        # one implementation (routes.py _resolve_route), so pool aliases,
+        # allow/deny semantics, and the vision gate can never drift
+        # between the two endpoints.
+        route = self._resolve_route(req, original_model)
+        if isinstance(route, Response):
+            return route
+        provider, provider_id, model, _routed = route
 
         chat_req = responses_to_chat_request(dict(body, model=model))
-        # Same vision gate as the chat path (routes.go:670-706): strip
-        # image parts for providers that can't take them.
-        if self.cfg.enable_vision:
-            msgs = chat_req.get("messages") or []
-            if any(has_image_content(m) for m in msgs if isinstance(m, dict)):
-                if not provider.supports_vision(model):
-                    chat_req["messages"] = [
-                        strip_image_content(m) if isinstance(m, dict) else m for m in msgs
-                    ]
+        chat_req["messages"] = self._vision_gate(
+            provider, provider_id, model, chat_req.get("messages") or [])
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
 
         if body.get("stream"):

@@ -1,0 +1,176 @@
+"""A `go test -race`-analog for the scheduler-thread/asyncio seam.
+
+CPython has no TSan, so this harness enforces the locking DISCIPLINE
+instead of detecting torn accesses: every shared mutable structure in
+the serving hot path is replaced by a proxy that asserts, on every
+mutation, that the access happens under the lock (or from the thread)
+that owns it. Run a concurrent workload under instrumentation and any
+discipline violation raises with the offending operation and thread —
+the same contract `-race` gives the reference's Go code (SURVEY.md §5,
+Taskfile.yml:109–112), enforced at the same seams:
+
+- ``Scheduler._waiting`` / ``_free`` / ``queue_depth``: mutated only
+  under the ``_wake`` condition (client threads submit; the scheduler
+  thread admits).
+- ``Scheduler._slots``: mutated only by the scheduler thread (reads
+  from server threads — health, metrics — are GIL-atomic by design).
+- ``PageAllocator`` mutating methods: only under ``Engine._lock``
+  (prefill/decode dispatch sections and release_slot).
+
+The harness swaps the scheduler's Condition and the engine's Lock for
+RLock-backed equivalents so ownership is exact (`RLock._is_owned`),
+then wraps the structures. `DisciplineViolation` failures are raised on
+the offending thread AND recorded, so violations on the scheduler
+thread (where raising would only kill the daemon) still fail the test.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class DisciplineViolation(AssertionError):
+    pass
+
+
+class _Recorder:
+    def __init__(self):
+        self.violations: list[str] = []
+        self._lock = threading.Lock()
+
+    def fail(self, msg: str) -> None:
+        full = f"{msg} [thread={threading.current_thread().name}]"
+        with self._lock:
+            self.violations.append(full)
+        raise DisciplineViolation(full)
+
+
+class LockedDeque(deque):
+    """Deque asserting every mutation happens under the owning lock."""
+
+    def __init__(self, iterable, owned, recorder, name):
+        super().__init__(iterable)
+        self._owned = owned
+        self._rec = recorder
+        self._name = name
+
+    def _check(self, op):
+        if not self._owned():
+            self._rec.fail(f"unlocked {op} on {self._name}")
+
+    def append(self, x):
+        self._check("append")
+        return super().append(x)
+
+    def appendleft(self, x):
+        self._check("appendleft")
+        return super().appendleft(x)
+
+    def popleft(self):
+        self._check("popleft")
+        return super().popleft()
+
+    def pop(self):
+        self._check("pop")
+        return super().pop()
+
+    def clear(self):
+        self._check("clear")
+        return super().clear()
+
+
+class LockedList(list):
+    def __init__(self, iterable, owned, recorder, name):
+        super().__init__(iterable)
+        self._owned = owned
+        self._rec = recorder
+        self._name = name
+
+    def _check(self, op):
+        if not self._owned():
+            self._rec.fail(f"unlocked {op} on {self._name}")
+
+    def append(self, x):
+        self._check("append")
+        return super().append(x)
+
+    def pop(self, *a):
+        self._check("pop")
+        return super().pop(*a)
+
+    def remove(self, x):
+        self._check("remove")
+        return super().remove(x)
+
+
+class ThreadOwnedDict(dict):
+    """Dict whose MUTATIONS must come from one designated thread."""
+
+    def __init__(self, mapping, recorder, name):
+        super().__init__(mapping)
+        self.owner_thread: threading.Thread | None = None  # set after start()
+        self._rec = recorder
+        self._name = name
+
+    def _check(self, op):
+        if self.owner_thread is not None and threading.current_thread() is not self.owner_thread:
+            self._rec.fail(
+                f"{op} on {self._name} from non-owner thread "
+                f"(owner={self.owner_thread.name})")
+
+    def __setitem__(self, k, v):
+        self._check("__setitem__")
+        return super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._check("__delitem__")
+        return super().__delitem__(k)
+
+    def pop(self, *a):
+        self._check("pop")
+        return super().pop(*a)
+
+    def clear(self):
+        self._check("clear")
+        return super().clear()
+
+
+def instrument(scheduler, recorder: _Recorder | None = None) -> _Recorder:
+    """Instrument a (not-yet-started) Scheduler + its Engine.
+
+    Returns the recorder; call ``recorder.violations`` after the
+    workload (empty == discipline held). Start the scheduler with
+    ``start_instrumented(scheduler)`` so _slots learns its owner.
+    """
+    rec = recorder or _Recorder()
+
+    # Exact lock ownership: RLock-backed condition / engine lock.
+    wake = threading.Condition(threading.RLock())
+    scheduler._wake = wake
+    owned = wake._is_owned  # exact with RLock
+
+    scheduler._waiting = LockedDeque(scheduler._waiting, owned, rec, "Scheduler._waiting")
+    scheduler._free = LockedList(scheduler._free, owned, rec, "Scheduler._free")
+    scheduler._slots = ThreadOwnedDict(scheduler._slots, rec, "Scheduler._slots")
+
+    engine = scheduler.engine
+    elock = threading.RLock()
+    engine._lock = elock
+    if engine.allocator is not None:
+        alloc = engine.allocator
+        for meth in ("ensure_capacity", "release", "adopt_pages"):
+            orig = getattr(alloc, meth)
+
+            def guarded(*a, _orig=orig, _name=meth, **kw):
+                if not elock._is_owned():
+                    rec.fail(f"PageAllocator.{_name} outside Engine._lock")
+                return _orig(*a, **kw)
+
+            setattr(alloc, meth, guarded)
+    return rec
+
+
+def start_instrumented(scheduler) -> None:
+    scheduler.start()
+    scheduler._slots.owner_thread = scheduler._thread

@@ -280,3 +280,80 @@ async def test_streaming_usage_scan_survives_block_split_lines():
         got += chunk
     assert got == b"".join(blocks)  # client bytes untouched
     assert otel.usage == (11, 5)
+
+
+async def test_responses_api_tool_calls_recorded():
+    """/v1/responses surfaces function calls as `output` items
+    (non-streaming) and `response.output_item.added` events (streaming) —
+    neither carries `choices`, and both must feed tool-call telemetry
+    like the chat path does (code-review round 3)."""
+    from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware
+    from inference_gateway_tpu.netio.server import Headers, Request, Response, StreamingResponse
+
+    class FakeOtel:
+        def __init__(self):
+            self.tools = []
+
+        def record_request_duration(self, *a):
+            pass
+
+        def record_token_usage(self, *a):
+            pass
+
+        def record_tool_call(self, source, team, provider, model, kind, name):
+            self.tools.append(name)
+
+    # Non-streaming: output items of type function_call.
+    body = {
+        "id": "resp_1", "object": "response", "status": "completed",
+        "output": [
+            {"type": "function_call", "name": "get_weather", "arguments": "{}"},
+            {"type": "message", "role": "assistant", "content": []},
+        ],
+        "usage": {"input_tokens": 3, "output_tokens": 2},
+    }
+
+    async def handler(req):
+        return Response.json(body)
+
+    otel = FakeOtel()
+    mw = telemetry_middleware(otel)
+    req = Request(method="POST", path="/v1/responses", query={},
+                  headers=Headers(), body=b'{"model":"ollama/fake"}')
+    await mw(req, handler)
+    assert otel.tools == ["get_weather"]
+
+    # Streaming: a realistic event sequence — the per-item added AND
+    # done events both carry the item, and the final response.completed
+    # carries the complete output array. The scan must count the call
+    # exactly ONCE (from response.completed's output), even though the
+    # added event has been evicted from the 4-chunk ring by the deltas.
+    frames = [
+        b'data: {"type":"response.output_item.added","output_index":0,'
+        b'"item":{"type":"function_call","name":"mcp_get_time","arguments":""}}\n\n',
+    ] + [
+        b'data: {"type":"response.function_call_arguments.delta","delta":"{"}\n\n'
+    ] * 6 + [
+        b'data: {"type":"response.output_item.done","output_index":0,'
+        b'"item":{"type":"function_call","name":"mcp_get_time","arguments":"{}"}}\n\n',
+        b'data: {"type":"response.completed","response":{"usage":'
+        b'{"input_tokens":3,"output_tokens":2},"output":[{"type":"function_call",'
+        b'"name":"mcp_get_time","arguments":"{}"}]}}\n\n',
+        b"data: [DONE]\n\n",
+    ]
+
+    async def stream():
+        for f in frames:
+            yield f
+
+    async def shandler(req):
+        return StreamingResponse.sse(stream())
+
+    otel2 = FakeOtel()
+    mw2 = telemetry_middleware(otel2)
+    req2 = Request(method="POST", path="/v1/responses", query={},
+                   headers=Headers(), body=b'{"model":"ollama/fake"}')
+    resp = await mw2(req2, shandler)
+    async for _ in resp.chunks:
+        pass
+    assert otel2.tools == ["mcp_get_time"]
