@@ -53,6 +53,19 @@ def parse_usage(payload: dict[str, Any]) -> tuple[int, int] | None:
     return int(usage.get("prompt_tokens") or 0), int(usage.get("completion_tokens") or 0)
 
 
+def responses_tool_calls(obj: dict[str, Any]) -> list[str]:
+    """Function-call names in a Responses-API object's `output` array —
+    the one scan both the streaming (response.completed event) and
+    non-streaming branches share."""
+    names = []
+    for item in obj.get("output") or []:
+        if isinstance(item, dict) and item.get("type") == "function_call":
+            name = item.get("name")
+            if name:
+                names.append(name)
+    return names
+
+
 def extract_tool_calls(message: dict[str, Any]) -> list[str]:
     return [
         tc.get("function", {}).get("name", "")
@@ -131,11 +144,7 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                         final = payload.get("response")
                         if isinstance(final, dict):
                             usage = parse_usage(final) or usage
-                            for item in final.get("output") or []:
-                                if isinstance(item, dict) and item.get("type") == "function_call":
-                                    name = item.get("name")
-                                    if name:
-                                        tool_names.append(name)
+                            tool_names.extend(responses_tool_calls(final))
                         for choice in payload.get("choices") or []:
                             delta = choice.get("delta") or {}
                             for tc in delta.get("tool_calls") or []:
@@ -159,11 +168,7 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                     tool_names.extend(n for n in extract_tool_calls(msg) if n)
                 # Responses API non-streaming bodies carry function calls
                 # as `output` items of type function_call, not `choices`.
-                for item in payload.get("output") or []:
-                    if isinstance(item, dict) and item.get("type") == "function_call":
-                        name = item.get("name")
-                        if name:
-                            tool_names.append(name)
+                tool_names.extend(responses_tool_calls(payload))
             except ValueError:
                 pass
         record(error_type, usage, tool_names)

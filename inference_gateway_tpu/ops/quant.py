@@ -1,14 +1,19 @@
-"""Weight-only int8 quantization.
+"""Weight-only quantization: int8 (per-channel) and int4 (group-wise).
 
 Decode throughput on TPU is HBM-bandwidth-bound by the weight stream;
 storing matmul weights as int8 with per-output-channel scales halves
-that traffic (and fits Llama-3-8B in a single v5e chip's 16 GB). The
-dequantize-multiply fuses into the matmul epilogue under XLA.
+that traffic (and fits Llama-3-8B in a single v5e chip's 16 GB); int4
+with group-wise scales halves it again (W4 round-to-nearest, two
+nibbles packed per int8 byte along the contraction axis — the standard
+AWQ/GPTQ storage granularity, without calibration since the container
+has no data). The dequantize chain (shift/mask sign-extend, group
+scale) is elementwise on the weight operand, which XLA fuses into the
+consuming matmul — weights stream packed out of HBM.
 
-``QTensor`` is a registered pytree node, so quantized weights slot into
-the existing stacked-layer pytrees — ``lax.scan`` slices the (q, scale)
-children along the layer axis exactly like plain arrays, and sharding
-specs apply unchanged to the ``q`` child.
+``QTensor``/``Q4Tensor`` are registered pytree nodes, so quantized
+weights slot into the existing stacked-layer pytrees — ``lax.scan``
+slices the children along the layer axis exactly like plain arrays,
+and sharding specs apply per child (parallel/sharding.quantized_specs).
 """
 
 from __future__ import annotations
@@ -50,11 +55,71 @@ def quantize_tensor(w: jnp.ndarray) -> QTensor:
     return QTensor(q, scale)
 
 
+@jax.tree_util.register_pytree_node_class
+class Q4Tensor:
+    """Packed int4 weights + group-wise fp scales.
+
+    q: int8 (..., in/2, out) — two nibbles per byte along the
+    contraction axis (even row = low nibble, odd = high).
+    scale: fp32 (..., n_groups, 1, out). The group size is derivable
+    (in = 2·q.shape[-2]; group = in / n_groups), so no static aux data.
+    """
+
+    def __init__(self, q: jnp.ndarray, scale: jnp.ndarray):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def quantize_tensor_int4(w: jnp.ndarray, group: int = 128) -> Q4Tensor:
+    """Group-wise symmetric int4 ([-8, 7]) over the contraction axis."""
+    wf = w.astype(jnp.float32)
+    cin = wf.shape[-2]
+    group = min(group, cin)
+    assert cin % group == 0 and cin % 2 == 0, (cin, group)
+    G = cin // group
+    lead = wf.shape[:-2]
+    out = wf.shape[-1]
+    wg = wf.reshape(*lead, G, group, out)
+    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # (..., G, 1, out)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / scale), -8, 7).astype(jnp.int8).reshape(*lead, cin, out)
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    packed = ((lo & 0x0F) | ((hi & 0x0F) << 4)).astype(jnp.int8)
+    return Q4Tensor(packed, scale)
+
+
+def _dequant4(w: Q4Tensor, dtype) -> jnp.ndarray:
+    """Unpack + rescale to a full weight; the whole chain is elementwise
+    on the packed operand, so XLA fuses it into the consuming matmul."""
+    p = w.q
+    lead = p.shape[:-2]
+    half, out = p.shape[-2], p.shape[-1]
+    cin = 2 * half
+    G = w.scale.shape[-3]
+    # Arithmetic shifts on int8 sign-extend: low nibble via <<4 then >>4.
+    lo = ((p << 4) >> 4).astype(jnp.int8)
+    hi = (p >> 4).astype(jnp.int8)
+    q = jnp.stack([lo, hi], axis=-2)  # (..., in/2, 2, out)
+    q = q.reshape(*lead, G, cin // G, out)
+    wf = q.astype(dtype) * w.scale.astype(dtype)
+    return wf.reshape(*lead, cin, out)
+
+
 def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """x @ w for plain arrays or QTensors (dequant fused by XLA)."""
+    """x @ w for plain arrays, QTensors, or Q4Tensors (dequant fused)."""
     if isinstance(w, QTensor):
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(x.dtype)
+    if isinstance(w, Q4Tensor):
+        return x @ _dequant4(w, x.dtype)
     return x @ w
 
 
@@ -67,6 +132,8 @@ def qeinsum(eq: str, x: jnp.ndarray, w, out_dtype=None) -> jnp.ndarray:
     if isinstance(w, QTensor):
         y = jnp.einsum(eq, x, w.q.astype(x.dtype), preferred_element_type=jnp.float32)
         y = y * w.scale
+    elif isinstance(w, Q4Tensor):
+        y = jnp.einsum(eq, x, _dequant4(w, x.dtype), preferred_element_type=jnp.float32)
     else:
         y = jnp.einsum(eq, x, w, preferred_element_type=jnp.float32)
     return y.astype(out_dtype) if out_dtype is not None else y
@@ -77,22 +144,28 @@ def qeinsum(eq: str, x: jnp.ndarray, w, out_dtype=None) -> jnp.ndarray:
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
 
 
-def quantize_llama_params(params: dict) -> dict:
-    """Quantize the stacked layer matmuls of a llama/mixtral pytree."""
+def quantize_llama_params(params: dict, mode: str = "int8", group: int = 128) -> dict:
+    """Quantize the stacked layer matmuls of a llama/mixtral pytree.
+    mode: "int8" (per-channel) or "int4" (group-wise packed)."""
+    quant = quantize_tensor if mode == "int8" else (
+        lambda w: quantize_tensor_int4(w, group))
     out = dict(params)
     layers = dict(params["layers"])
     for name in QUANTIZABLE:
         if name in layers:
-            layers[name] = quantize_tensor(layers[name])
+            layers[name] = quant(layers[name])
     out["layers"] = layers
     if "lm_head" in out:
-        out["lm_head"] = quantize_tensor(out["lm_head"])
+        out["lm_head"] = quant(out["lm_head"])
     return out
 
 
-def dequantize_error(w: jnp.ndarray) -> float:
+def dequantize_error(w: jnp.ndarray, mode: str = "int8", group: int = 128) -> float:
     """Max relative reconstruction error (diagnostics)."""
-    qt = quantize_tensor(w)
-    back = qt.q.astype(jnp.float32) * qt.scale
+    if mode == "int8":
+        qt = quantize_tensor(w)
+        back = qt.q.astype(jnp.float32) * qt.scale
+    else:
+        back = _dequant4(quantize_tensor_int4(w, group), jnp.float32)
     denom = jnp.maximum(jnp.abs(w.astype(jnp.float32)), 1e-8)
     return float(jnp.max(jnp.abs(back - w.astype(jnp.float32)) / denom))

@@ -44,15 +44,23 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
     return specs
 
 
-def quantized_specs(specs: dict) -> dict:
-    """Spec tree for an int8-quantized pytree (ops/quant.py): each
-    quantizable weight's P becomes a QTensor node of (q_spec, scale_spec)
-    — the scale keeps the weight's layout except the contraction (-2)
-    axis, which is size 1 and must stay unsharded."""
-    from inference_gateway_tpu.ops.quant import QUANTIZABLE, QTensor
+def quantized_specs(specs: dict, mode: str = "int8") -> dict:
+    """Spec tree for a quantized pytree (ops/quant.py): each quantizable
+    weight's P becomes a QTensor/Q4Tensor node of (q_spec, scale_spec).
 
-    def qspec(p: P) -> QTensor:
+    int8: the scale keeps the weight's layout except the contraction
+    (-2) axis, which is size 1 and must stay unsharded. int4: the packed
+    q keeps the weight's spec verbatim (packing halves the contraction
+    axis but not its sharding), and the scale's group axis inherits the
+    contraction axis's placement ((..., G, 1, out) — a tp shard of the
+    input dimension owns the matching shard of groups)."""
+    from inference_gateway_tpu.ops.quant import QUANTIZABLE, Q4Tensor, QTensor
+
+    def qspec(p: P):
         parts = tuple(p)
+        if mode == "int4":
+            scale = parts[:-2] + (parts[-2], None) + parts[-1:]
+            return Q4Tensor(p, P(*scale))
         scale = parts[:-2] + (None,) + parts[-1:]
         return QTensor(p, P(*scale))
 

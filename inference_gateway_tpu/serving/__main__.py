@@ -20,8 +20,9 @@ def main() -> None:
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--max-prefill-batch", type=int, default=8)
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
-    p.add_argument("--quantize", default=None, choices=["int8"],
-                   help="weight-only quantization (halves weight HBM traffic)")
+    p.add_argument("--quantize", default=None, choices=["int8", "int4"],
+                   help="weight-only quantization: int8 halves the weight HBM "
+                        "stream, int4 (group-128 packed nibbles) quarters it")
     p.add_argument("--attention", default="dense", choices=["dense", "paged"])
     p.add_argument("--page-size", type=int, default=32)
     p.add_argument("--decode-chunk", type=int, default=8)

@@ -66,7 +66,8 @@ class EngineConfig:
     attention: str = "dense"  # "dense" (contiguous cache) | "paged" (Pallas kernel)
     page_size: int = 32
     num_pages: int = 0  # 0 = full reservation
-    quantize: str | None = None  # "int8" = weight-only quantization (ops/quant.py)
+    quantize: str | None = None  # "int8" | "int4" weight-only quantization (ops/quant.py)
+    quant_group: int = 0  # int4 group size; 0 = auto (tp-aware, ≤128)
     prefix_cache: bool = True  # share full prefix KV pages across requests (paged mode)
     # Decode steps fused into one jitted scan per host roundtrip. Token
     # sampling feeds back on-device; the host reads a (chunk, slots)
@@ -168,16 +169,49 @@ class Engine:
         # BEFORE sharding so the mesh path lays out (q, scale) pairs with
         # quantized_specs — int8 now composes with meshes and MoE
         # (round-1 verdict weak #8).
-        if config.quantize == "int8":
+        if config.quantize in ("int8", "int4"):
             from inference_gateway_tpu.ops.quant import quantize_llama_params
 
-            params = jax.jit(quantize_llama_params)(params)
+            # int4 group size must (a) divide every contraction dim and
+            # (b) leave the per-weight group count divisible by tp, so a
+            # tp shard of an input-sharded weight owns whole groups.
+            group = 128
+            if config.quantize == "int4":
+                tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+                cins = [self.model_cfg.hidden_size,
+                        self.model_cfg.num_heads * self.model_cfg.hd,
+                        self.model_cfg.intermediate_size]
+
+                def group_ok(g: int) -> bool:
+                    # (a) divides every contraction dim; (b) per-weight
+                    # group counts divisible by tp, so a tp shard of an
+                    # input-sharded weight owns whole groups (otherwise
+                    # group boundaries cross shard boundaries and XLA
+                    # reshards the weight stream every step).
+                    return all(c % g == 0 and (c // g) % tp == 0 for c in cins)
+
+                if config.quant_group:
+                    group = config.quant_group
+                    if not group_ok(group):
+                        raise ValueError(
+                            f"quant_group={group} incompatible with model dims "
+                            f"{cins} under tp={tp}: need cin % group == 0 and "
+                            f"(cin/group) % tp == 0 for every matmul input dim")
+                else:
+                    group = min(128, min(cins) // tp if tp > 1 else min(cins))
+                    while group > 2 and not group_ok(group):
+                        group //= 2
+                    if not group_ok(group):
+                        raise ValueError(
+                            f"no int4 group size tiles model dims {cins} under tp={tp}")
+            params = jax.jit(partial(quantize_llama_params, mode=config.quantize,
+                                     group=group))(params)
         if self.mesh is not None:
             from inference_gateway_tpu.parallel.sharding import quantized_specs
 
             specs = self._model.param_specs(self.model_cfg) if self.is_moe else llama_param_specs(self.model_cfg)
-            if config.quantize == "int8":
-                specs = quantized_specs(specs)
+            if config.quantize in ("int8", "int4"):
+                specs = quantized_specs(specs, mode=config.quantize)
             params = shard_params(params, self.mesh, specs)
         self.params = params
 

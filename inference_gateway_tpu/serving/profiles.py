@@ -123,18 +123,29 @@ def hbm_plan(profile: ServingProfile) -> dict:
     dp = profile.mesh.get("dp", 1)
     assert dp * tp * ep * profile.mesh.get("sp", 1) == profile.n_chips or profile.n_chips == 1
 
-    wbytes = 1 if profile.quantize == "int8" else 2
+    # Quantization only touches the matmul weights (ops/quant.py
+    # QUANTIZABLE + lm_head); the embedding table always stays at the
+    # serving dtype — price it separately or an int4 plan undercounts
+    # by ~1 GiB exactly where margin is tightest (code-review round 3).
+    wbytes = {"int8": 1, "int4": 0.5}.get(profile.quantize, 2)
+    embed_params = cfg.vocab_size * cfg.hidden_size
     if is_moe:
         n_params = mixtral_param_count(cfg)
         expert_params = cfg.num_layers * cfg.num_experts * 3 * cfg.hidden_size * cfg.intermediate_size
-        dense_params = n_params - expert_params
-        weights_per_chip = dense_params * wbytes // tp + expert_params * wbytes // (ep * tp)
+        dense_q_params = n_params - expert_params - embed_params
+        weights_per_chip = int(
+            embed_params * 2 // tp + dense_q_params * wbytes // tp
+            + expert_params * wbytes // (ep * tp))
     else:
         n_params = llama_param_count(cfg)
-        weights_per_chip = n_params * wbytes // tp
-    # int8 scale rows are ~1/(min matrix dim) of weight bytes; budget 2%.
+        weights_per_chip = int(
+            embed_params * 2 // tp + (n_params - embed_params) * wbytes // tp)
+    # Scale rows: int8 per-channel ~1/(min matrix dim) of weight bytes
+    # (budget 2%); int4 group-128 scales are 4B per 128 nibbles (~6%).
     if profile.quantize == "int8":
         weights_per_chip = int(weights_per_chip * 1.02)
+    elif profile.quantize == "int4":
+        weights_per_chip = int(weights_per_chip * 1.06)
 
     tokens = profile.num_pages * profile.page_size if profile.num_pages else (
         profile.max_slots * profile.max_seq_len
@@ -209,6 +220,25 @@ PROFILES: dict[str, ServingProfile] = {
         decode_chunk=16,
         quantize="int8",
         mesh={"tp": 8},
+    ),
+    # W4 single-chip flagship: int4 group-128 weights put Llama-3-8B's
+    # ~4.3 GiB on ONE v5e chip with ~9 GiB left for KV — the whole
+    # model serves without a mesh. 520 pages x 128 = 66.5k tokens
+    # oversubscribe 48 slots at 8k context (prefix-cache eviction +
+    # per-request OutOfPages beyond that).
+    "v5e-1-llama-3-8b-int4": ServingProfile(
+        name="v5e-1-llama-3-8b-int4",
+        model="llama-3-8b",
+        n_chips=1,
+        max_slots=48,
+        max_seq_len=8192,
+        prefill_buckets=(512, 1024, 2048, 4096, 8192),
+        max_prefill_batch=2,
+        page_size=128,
+        num_pages=520,
+        decode_chunk=16,
+        quantize="int4",
+        mesh={},
     ),
     # BASELINE config 5: Mixtral-8x7B on v5e-16 — experts over ep=8,
     # attention over tp=2. KV shards over tp only (pages are
