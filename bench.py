@@ -255,7 +255,7 @@ def analytic_model() -> dict:
     )
 
     out = {}
-    for name in ("v5e-8-llama-3-8b", "v5e-1-tinyllama"):
+    for name in ("v5e-8-llama-3-8b", "v5e-1-llama-3-8b-int4", "v5e-1-tinyllama"):
         p = PROFILES[name]
         cfg = resolve_model_cfg(p.model)
         plan = hbm_plan(p)

@@ -91,6 +91,20 @@ class PrefillResult:
 
 
 @dataclass
+class PrefillHandle:
+    """An in-flight (or already-materialized) batch prefill: toks and
+    logprobs may be device futures; fetch with prefill_fetch. When the
+    engine had chained decode state at submit time, the results were
+    also scattered into it on-device (``scattered``), so decode chunks
+    keep chaining across the admission with no host sync."""
+
+    toks: object  # (Bp,) device array or np array
+    logprobs: object
+    slots: list
+    scattered: bool = False
+
+
+@dataclass
 class _DecodeChunkHandle:
     """An in-flight fused decode chunk: ``toks_lp`` is a (2·n_steps, S)
     device-array future (tokens stacked atop logprobs) that materializes
@@ -523,15 +537,40 @@ class Engine:
     def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float],
                 top_ps: list[float], embeds: list | None = None,
                 seeds: list | None = None) -> list[PrefillResult]:
-        """Prefill a batch of prompts into their slots; returns each
-        prompt's sampled first token. Pads to (max_prefill_batch, bucket).
-        ``embeds`` optionally carries per-row (T_i, H) multimodal
-        embedding overrides (from prepare_multimodal)."""
+        """Synchronous prefill: submit + fetch."""
+        return self.prefill_fetch(self.prefill_submit(
+            prompts, slots, temps, top_ps, embeds=embeds, seeds=seeds))
+
+    def prefill_fetch(self, handle: PrefillHandle) -> list[PrefillResult]:
+        """Block until a submitted prefill's first tokens are on host."""
+        toks = np.asarray(handle.toks)
+        logprobs = np.asarray(handle.logprobs)
+        return [PrefillResult(slot, int(toks[i]), float(logprobs[i]))
+                for i, slot in enumerate(handle.slots)]
+
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(1, 2, 3, 4, 5, 6))
+    def _admit_scatter_fn(self, tok, pos, temps, top_ps, seeds, use_seed,
+                          slot_arr, new_toks, new_lens, new_temps, new_tps,
+                          new_seeds, new_use):
+        """Fold a prefill batch's results into the chained decode state
+        on-device (OOB padding rows drop) — admission stops being a
+        pipeline barrier: the next chunk chains off state that already
+        contains the admitted slots' first tokens and positions."""
+        upd = lambda a, v: a.at[slot_arr].set(v.astype(a.dtype), mode="drop")
+        return (upd(tok, new_toks), upd(pos, new_lens), upd(temps, new_temps),
+                upd(top_ps, new_tps), upd(seeds, new_seeds), upd(use_seed, new_use))
+
+    def prefill_submit(self, prompts: list[list[int]], slots: list[int], temps: list[float],
+                       top_ps: list[float], embeds: list | None = None,
+                       seeds: list | None = None) -> PrefillHandle:
+        """Prefill a batch of prompts into their slots WITHOUT waiting.
+
+        Pads to (max_prefill_batch, bucket). ``embeds`` optionally
+        carries per-row (T_i, H) multimodal embedding overrides (from
+        prepare_multimodal). Long-prompt paths (ring / chunked) resolve
+        synchronously inside and return a materialized handle.
+        """
         assert prompts and len(prompts) == len(slots)
-        # Chained decode state is host-stale once new slots enter: the
-        # admitted slots' first tokens exist only on the host, so the
-        # next chunk must be submitted chain=False.
-        self._dev_carry = None
         # Prompts beyond the largest bucket take a long-context path:
         # ring attention over the sp axis when the mesh has one (ONE
         # sequence-sharded pass, O(T/sp) memory per device — dense AND
@@ -575,7 +614,25 @@ class Engine:
                     seeds=[(seeds or [None] * len(prompts))[i] for i in short_idx] if seeds else None,
                 )
                 results.extend(zip(short_idx, sub))
-            return [r for _, r in sorted(results)]
+            ordered = [r for _, r in sorted(results)]
+            # Long paths run synchronously and bypass the standard
+            # dispatch, so fold their results into any chained decode
+            # state here (host values — they're already materialized).
+            with self._lock:
+                self._scatter_admission(
+                    np.asarray([r.slot for r in ordered], np.int32),
+                    np.asarray([r.first_token for r in ordered], np.int32),
+                    np.asarray([len(p) for p in prompts], np.int32),
+                    np.asarray(temps, np.float32), np.asarray(top_ps, np.float32),
+                    np.asarray([0 if (seeds is None or s is None) else int(s)
+                                for s in (seeds or [None] * len(prompts))], np.int32),
+                    np.asarray([seeds is not None and s is not None
+                                for s in (seeds or [None] * len(prompts))]),
+                )
+            return PrefillHandle(
+                np.asarray([r.first_token for r in ordered], np.int32),
+                np.asarray([r.logprob for r in ordered], np.float32),
+                [r.slot for r in ordered], scattered=self._dev_carry is not None)
         Bp = self.config.max_prefill_batch
         assert len(prompts) <= Bp
         bucket = self.bucket_for(max(len(p) for p in prompts))
@@ -680,9 +737,30 @@ class Engine:
                     self.draft_params, self.draft_cache, jnp.asarray(d_tokens),
                     jnp.asarray(d_positions), jnp.asarray(lengths), jnp.asarray(slot_arr),
                 )
-        toks = np.asarray(toks)
-        logprobs = np.asarray(logprobs)
-        return [PrefillResult(slot, int(toks[i]), float(logprobs[i])) for i, slot in enumerate(slots)]
+            # Fold results into chained decode state on-device (futures
+            # stay futures — no sync): admission is not a barrier.
+            scattered = self._scatter_admission(
+                slot_arr, toks, lengths, t_arr, p_arr, seed_arr, use_seed)
+        return PrefillHandle(toks[: len(slots)], logprobs[: len(slots)],
+                             list(slots), scattered=scattered)
+
+    def _scatter_admission(self, slot_arr, toks, lengths, t_arr, p_arr,
+                           seed_arr, use_seed) -> bool:
+        """Scatter a prefill batch's (token, pos, sampling) rows into the
+        device-resident chained state, if it exists. Caller holds _lock
+        or is on the scheduler thread."""
+        if self._dev_carry is None:
+            return False
+        tok_d, pos_d = self._dev_carry
+        te_d, tp_d, se_d, us_d = self._dev_sampling
+        new = self._admit_scatter_fn(
+            tok_d, pos_d, te_d, tp_d, se_d, us_d,
+            jnp.asarray(slot_arr), jnp.asarray(toks), jnp.asarray(lengths),
+            jnp.asarray(t_arr), jnp.asarray(p_arr), jnp.asarray(seed_arr),
+            jnp.asarray(use_seed))
+        self._dev_carry = (new[0], new[1])
+        self._dev_sampling = tuple(new[2:])
+        return True
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray, lengths: np.ndarray, temps: np.ndarray, top_ps: np.ndarray):
         """One decode step for ALL slots.

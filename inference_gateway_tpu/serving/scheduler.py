@@ -55,6 +55,11 @@ class _SlotState:
     catchup: tuple = ()
 
 
+# pending_token sentinel: the slot's first token is still a prefill
+# future (async admission); resolved when its handle is processed.
+_TOKEN_PENDING = -1
+
+
 @dataclass
 class _Inflight:
     """A submitted-but-unfetched decode chunk: the engine handle, the
@@ -65,6 +70,15 @@ class _Inflight:
     handle: object
     slots: frozenset[int]
     n_steps: int
+
+
+@dataclass
+class _PendingPrefill:
+    """A submitted-but-unfetched admission batch: the engine prefill
+    handle plus the (request, slot) pairs awaiting their first token."""
+
+    handle: object
+    items: list
 
 
 class Scheduler:
@@ -80,7 +94,9 @@ class Scheduler:
         self._stop = False
         self._ids = itertools.count()
         self._thread: threading.Thread | None = None
-        self._inflight: _Inflight | None = None  # pipelined decode chunk
+        # FIFO of in-flight handles: _PendingPrefill admissions and at
+        # most one _Inflight decode chunk (the pipeline).
+        self._handles: deque = deque()
         self.queue_depth = 0  # exported metric
         # Liveness: wall-clock of the last completed engine step. The
         # sidecar /health endpoint flags "degraded" when requests are
@@ -116,28 +132,45 @@ class Scheduler:
 
     # -- core loop -----------------------------------------------------
     def run(self) -> None:
-        """Pipelined serving loop: at most one decode chunk in flight.
+        """Pipelined serving loop: at most one decode chunk in flight,
+        and admissions that never stall it.
 
         Steady state submits chunk N+1 (chained off device-resident
         carry — no host round-trip) BEFORE fetching chunk N's tokens, so
         the host↔device round trip (50–160 ms through a remote-TPU
         tunnel, benchmarks/profile_decode.py) overlaps chunk N+1's
-        execution instead of serializing with it. Admission is a
-        pipeline barrier: prefill invalidates the chained carry and host
-        token state is only authoritative when nothing is in flight, so
-        the loop drains first, admits, then resubmits with host state
-        (chain=False).
+        execution instead of serializing with it. Admission is asynchronous
+        too: prefill results are scattered into the chained device state
+        on-device (engine._admit_scatter_fn), so a prefill dispatch slots
+        between chunks with no drain. Handles (prefills + chunks) are
+        processed FIFO — a chunk that includes freshly admitted slots is
+        always processed after their prefill, so host bookkeeping sees
+        first tokens in order. Only failure recovery (device carry
+        invalidated) drains the queue and resubmits from host state.
         """
         while True:
             with self._wake:
                 while (not self._stop and not self._waiting and not self._slots
-                       and self._inflight is None):
+                       and not self._handles):
                     self._wake.wait(timeout=0.2)
                 if self._stop:
                     break
                 want_admit = bool(self._waiting and self._free)
+            if self.engine.spec:
+                # Speculative rounds are synchronous (draft + verify per
+                # round, 1..K+1 tokens out); no chunk pipeline.
+                if want_admit:
+                    try:
+                        self._admit()
+                    except Exception as e:
+                        self.logger.error("scheduler admission error", e)
+                if self._slots:
+                    try:
+                        self._spec_step()
+                    except Exception as e:
+                        self._fail_after_decode_error(e)
+                continue
             if want_admit:
-                self._drain_inflight()
                 # A single bad request (prompt over the largest bucket in
                 # a mode with no chunked fallback, KV page pool
                 # exhausted, ...) must never kill the scheduler thread —
@@ -151,26 +184,41 @@ class Scheduler:
                     # those guards broke. Never silent (round-2 verdict
                     # weak #4): a recurring admission bug must be visible.
                     self.logger.error("scheduler admission error", e)
-            if self.engine.spec:
-                # Speculative rounds are synchronous (draft + verify per
-                # round, 1..K+1 tokens out); no chunk pipeline.
-                if self._slots:
-                    try:
-                        self._spec_step()
-                    except Exception as e:
-                        self._fail_after_decode_error(e)
-                continue
-            prev = self._inflight
-            new = self._submit_chunk() if self._slots else None
-            self._inflight = new
-            if prev is not None:
-                try:
-                    self._process_chunk(prev)
-                except Exception as e:
-                    # _process_chunk guards its fetch and release paths;
-                    # reaching here means emission bookkeeping broke.
-                    # Never let it kill the scheduler thread.
-                    self._fail_after_decode_error(e)
+            if self._slots:
+                chain = self.engine._dev_carry is not None
+                if not chain:
+                    # First chunk ever, or recovery after a device
+                    # failure: host state must be authoritative, so
+                    # process every outstanding handle first.
+                    self._drain_all()
+                h = self._submit_chunk(chain=chain)
+                if h is not None:
+                    self._handles.append(h)
+            self._process_handles()
+
+    def _process_handles(self) -> None:
+        """Process outstanding handles FIFO, keeping at most the newest
+        decode chunk in flight (the pipeline)."""
+        while self._handles:
+            if len(self._handles) == 1 and isinstance(self._handles[0], _Inflight):
+                break
+            self._process_one(self._handles.popleft())
+
+    def _drain_all(self) -> None:
+        while self._handles:
+            self._process_one(self._handles.popleft())
+
+    def _process_one(self, h) -> None:
+        try:
+            if isinstance(h, _Inflight):
+                self._process_chunk(h)
+            else:
+                self._process_prefill(h)
+        except Exception as e:
+            # Both processors guard their fetch and release paths;
+            # reaching here means emission bookkeeping broke. Never let
+            # it kill the scheduler thread.
+            self._fail_after_decode_error(e)
 
     def _fail_request(self, req: GenRequest) -> None:
         try:
@@ -209,7 +257,15 @@ class Scheduler:
             self._fail_slot(s)
 
     def _admit(self) -> None:
-        """Move waiting requests into free slots and prefill them."""
+        """Move waiting requests into free slots and prefill them.
+
+        Non-speculative mode dispatches the prefill WITHOUT waiting: the
+        engine scatters first tokens/positions into the chained device
+        state (no pipeline barrier), and the host-side results arrive
+        later via the handle queue (_process_prefill emits the first
+        tokens). Speculative mode admits synchronously — spec rounds
+        need the first token host-side for the draft catch-up block.
+        """
         batch: list[GenRequest] = []
         slots: list[int] = []
         with self._wake:
@@ -223,7 +279,7 @@ class Scheduler:
         embeds = [r.embeds for r in batch]
         seeds = [r.seed for r in batch]
         try:
-            results = self.engine.prefill(
+            handle = self.engine.prefill_submit(
                 [r.prompt_ids for r in batch], slots,
                 [r.temperature for r in batch], [r.top_p for r in batch],
                 embeds=embeds if any(e is not None for e in embeds) else None,
@@ -236,41 +292,66 @@ class Scheduler:
                 self._fail_request(req)
                 self._release(slot, "error")
             return
-        for req, res in zip(batch, results):
-            state = _SlotState(req, pos=len(req.prompt_ids), pending_token=res.first_token,
-                               pending_logprob=res.logprob,
-                               draft_len=len(req.prompt_ids),
-                               catchup=(res.first_token,))
-            finished, reason = self._emit(state, res.first_token, res.logprob)
-            if finished:
-                self._release(res.slot, reason)
-                continue
-            self._slots[res.slot] = state
+        for req, slot in zip(batch, slots):
+            self._slots[slot] = _SlotState(
+                req, pos=len(req.prompt_ids), pending_token=_TOKEN_PENDING,
+                pending_logprob=0.0, draft_len=len(req.prompt_ids))
+        if self.engine.spec:
+            # Spec rounds need first tokens host-side immediately.
+            self._process_prefill(_PendingPrefill(handle, list(zip(batch, slots))))
+        else:
+            self._handles.append(_PendingPrefill(handle, list(zip(batch, slots))))
 
-    def _submit_chunk(self) -> "_Inflight | None":
+    def _process_prefill(self, p: "_PendingPrefill") -> None:
+        """Materialize a prefill's first tokens and stream them out."""
+        try:
+            results = self.engine.prefill_fetch(p.handle)
+        except Exception as e:
+            self.engine._dev_carry = None  # scatter output is poisoned
+            self.logger.error("prefill fetch failed; failing admission batch", e)
+            for req, slot in p.items:
+                if slot in self._slots:
+                    del self._slots[slot]
+                    self._fail_request(req)
+                    self._release_guarded(slot, "error")
+            return
+        self.last_step_time = time.monotonic()
+        for (req, slot), res in zip(p.items, results):
+            st = self._slots.get(slot)
+            if st is None:  # failed/released while in flight
+                continue
+            st.pending_token = res.first_token
+            st.pending_logprob = res.logprob
+            st.catchup = (res.first_token,)
+            finished, reason = self._emit(st, res.first_token, res.logprob)
+            if finished:
+                del self._slots[slot]
+                self._release_guarded(slot, reason)
+
+    def _submit_chunk(self, chain: bool) -> "_Inflight | None":
         """Dispatch one fused decode chunk without waiting for it.
 
-        With a previous chunk still in flight the submit chains off the
-        engine's device-resident carry (host token state is one chunk
-        stale — exactly why ``tokens`` is ignored in chained mode) and
-        positions are *predicted* as last-processed + in-flight steps,
-        which is deterministic because every active slot advances one
-        token per step; the prediction only pre-allocates KV pages for
-        slots that turn out to finish mid-flight, whose pages are
-        reclaimed on release. Failures are attributed and survive as in
-        the synchronous path.
+        Chained submits take tokens from the engine's device-resident
+        carry (host token state may be a chunk stale and freshly
+        admitted slots' tokens may still be prefill futures — exactly
+        why ``tokens`` is ignored in chained mode); positions are
+        *predicted* as last-processed + the steps of any in-flight chunk
+        that includes the slot, which is deterministic because every
+        active slot advances one token per step. The prediction only
+        pre-allocates KV pages for slots that turn out to finish
+        mid-flight, whose pages are reclaimed on release. Failures are
+        attributed and survive as in the synchronous path.
         """
         # A request that arrived after run()'s want_admit check would
         # otherwise wait out this whole chunk before prefill; skip the
-        # submit so the next loop iteration drains and admits instead
-        # (the pre-pipelining code bounded admission latency the same
-        # way by shrinking the chunk to one step).
+        # submit so the next loop iteration admits first (the
+        # pre-pipelining code bounded admission latency the same way by
+        # shrinking the chunk to one step).
         with self._wake:
             if self._waiting and self._free:
                 return None
         S = self.engine.config.max_slots
-        inflight_steps = self._inflight.n_steps if self._inflight is not None else 0
-        chain = self._inflight is not None
+        chunk_handles = [h for h in self._handles if isinstance(h, _Inflight)]
         tokens = np.zeros((S,), np.int32)
         positions = np.zeros((S,), np.int32)
         active = np.zeros((S,), bool)
@@ -280,7 +361,8 @@ class Scheduler:
         use_seed = np.zeros((S,), bool)
         max_pos = self.engine.config.max_seq_len - 1
         for slot, st in self._slots.items():
-            tokens[slot] = st.pending_token
+            inflight_steps = sum(h.n_steps for h in chunk_handles if slot in h.slots)
+            tokens[slot] = max(st.pending_token, 0)
             positions[slot] = min(st.pos + inflight_steps, max_pos)
             active[slot] = True
             temps[slot] = st.req.temperature
@@ -353,13 +435,6 @@ class Scheduler:
                 st.catchup = tuple(int(t) for t in out[slot, max(n - 2, 0):n]) \
                     if n == K + 1 else (int(out[slot, n - 1]),)
 
-    def _drain_inflight(self) -> None:
-        """Block until the in-flight chunk (if any) is processed."""
-        prev = self._inflight
-        self._inflight = None
-        if prev is not None:
-            self._process_chunk(prev)
-
     def _process_chunk(self, inf: "_Inflight") -> None:
         """Fetch a submitted chunk's token block and stream it out.
 
@@ -370,11 +445,11 @@ class Scheduler:
         try:
             toks, logprobs = self.engine.decode_chunk_fetch(inf.handle)
         except Exception as e:
-            # The device-side failure poisons the chained carry and any
-            # later-submitted chunk; both are invalidated so recovery
-            # resubmits from host state.
+            # The device-side failure poisons the chained carry and
+            # every later-submitted handle; all are invalidated so
+            # recovery resubmits from host state.
             self.engine._dev_carry = None
-            self._inflight = None
+            self._handles.clear()
             self._fail_after_decode_error(e)
             return
         self.last_step_time = time.monotonic()

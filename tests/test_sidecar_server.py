@@ -171,3 +171,36 @@ async def test_metrics_prometheus_format(sidecar, client):
     # JSON default unchanged.
     resp = await client.get(f"http://127.0.0.1:{port}/metrics")
     assert resp.json()["decode_steps"] >= 0
+
+
+async def test_spec_decoding_sidecar_end_to_end():
+    """A speculative-decoding engine behind the full HTTP surface:
+    non-streaming chat with usage, and streaming SSE framing with a
+    finish_reason + usage chunk."""
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=2, max_seq_len=128,
+                                 dtype="float32", max_prefill_batch=2, use_mesh=False,
+                                 spec_draft="test-tiny", spec_k=3))
+    server = SidecarServer(engine, served_model_name="tpu-spec")
+    port = await server.start("127.0.0.1", 0)
+    try:
+        client = HTTPClient()
+        body = {"model": "tpu-spec", "max_tokens": 8,
+                "messages": [{"role": "user", "content": "hello"}]}
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                 json.dumps(body).encode())
+        data = resp.json()
+        assert data["choices"][0]["finish_reason"] in ("stop", "length")
+        assert data["usage"]["completion_tokens"] >= 1
+
+        sbody = dict(body, stream=True, stream_options={"include_usage": True})
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                 json.dumps(sbody).encode(), stream=True)
+        chunks = []
+        async for payload in iter_sse_payloads(resp.iter_lines()):
+            chunks.append(json.loads(payload))
+        finishes = [c["choices"][0]["finish_reason"]
+                    for c in chunks if c.get("choices")]
+        assert any(f in ("stop", "length") for f in finishes)
+        assert any(c.get("usage") for c in chunks)
+    finally:
+        await server.shutdown()
