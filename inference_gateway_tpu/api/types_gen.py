@@ -13,6 +13,7 @@ from typing import Any, NotRequired, TypedDict
 Provider = str
 ProviderAuthType = str
 MessageRole = str
+ChatCompletionToolType = str
 FinishReason = str
 ResponseRole = str
 ResponseStatus = str
@@ -74,6 +75,10 @@ ImageContentPart = TypedDict('ImageContentPart', {
     'image_url': 'ImageURL',
 }, total=True)
 
+ToolCallExtraContent = TypedDict('ToolCallExtraContent', {
+    'google': 'NotRequired[dict[str, Any]]',
+}, total=True)
+
 Message = TypedDict('Message', {
     'role': 'MessageRole',
     'content': 'NotRequired[MessageContent]',
@@ -90,8 +95,9 @@ ChatCompletionMessageToolCallFunction = TypedDict('ChatCompletionMessageToolCall
 
 ChatCompletionMessageToolCall = TypedDict('ChatCompletionMessageToolCall', {
     'id': 'str',
-    'type': 'str',
+    'type': 'ChatCompletionToolType',
     'function': 'ChatCompletionMessageToolCallFunction',
+    'extra_content': 'NotRequired[ToolCallExtraContent]',
 }, total=True)
 
 FunctionObject = TypedDict('FunctionObject', {
@@ -102,7 +108,7 @@ FunctionObject = TypedDict('FunctionObject', {
 }, total=True)
 
 ChatCompletionTool = TypedDict('ChatCompletionTool', {
-    'type': 'str',
+    'type': 'ChatCompletionToolType',
     'function': 'FunctionObject',
 }, total=True)
 
@@ -191,6 +197,7 @@ ChatCompletionMessageToolCallChunk = TypedDict('ChatCompletionMessageToolCallChu
     'id': 'NotRequired[str]',
     'type': 'NotRequired[str]',
     'function': 'NotRequired[dict[str, Any]]',
+    'extra_content': 'NotRequired[ToolCallExtraContent]',
 }, total=True)
 
 ChatCompletionStreamResponseDelta = TypedDict('ChatCompletionStreamResponseDelta', {
@@ -590,6 +597,28 @@ SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
                                      'image_url': {'$ref': '#/components/schemas/ImageURL'}}},
  'MessageContentPart': {'oneOf': [{'$ref': '#/components/schemas/TextContentPart'},
                                   {'$ref': '#/components/schemas/ImageContentPart'}]},
+ 'ContentPart': {'description': 'A content part within a multimodal message (reference '
+                                'openapi.yaml:1155)',
+                 'oneOf': [{'$ref': '#/components/schemas/TextContentPart'},
+                           {'$ref': '#/components/schemas/ImageContentPart'}]},
+ 'ProviderSpecificResponse': {'type': 'object',
+                              'description': 'Provider-specific response passed through '
+                                             'verbatim by the proxy endpoints; the shape '
+                                             'depends on the provider and endpoint called '
+                                             '(reference openapi.yaml:1029).',
+                              'additionalProperties': True},
+ 'ToolCallExtraContent': {'type': 'object',
+                          'description': 'Provider-specific opaque data attached to a tool '
+                                         'call; echoed back verbatim on the next request '
+                                         'referencing the call (e.g. Gemini extended-thinking '
+                                         'thought signatures; reference openapi.yaml:1970).',
+                          'properties': {'google': {'type': 'object',
+                                                    'description': 'Google Gemini-specific '
+                                                                   'extra content',
+                                                    'properties': {'thought_signature': {'type': 'string'}}}}},
+ 'ChatCompletionToolType': {'type': 'string',
+                            'description': 'The type of the tool; only `function` is supported',
+                            'enum': ['function']},
  'MessageContent': {'description': 'String or typed multimodal parts',
                     'oneOf': [{'type': 'string'},
                               {'type': 'array',
@@ -617,9 +646,9 @@ SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
  'ChatCompletionMessageToolCall': {'type': 'object',
                                    'required': ['id', 'type', 'function'],
                                    'properties': {'id': {'type': 'string'},
-                                                  'type': {'type': 'string',
-                                                           'const': 'function'},
-                                                  'function': {'$ref': '#/components/schemas/ChatCompletionMessageToolCallFunction'}}},
+                                                  'type': {'$ref': '#/components/schemas/ChatCompletionToolType'},
+                                                  'function': {'$ref': '#/components/schemas/ChatCompletionMessageToolCallFunction'},
+                                                  'extra_content': {'$ref': '#/components/schemas/ToolCallExtraContent'}}},
  'FunctionParameters': {'type': 'object',
                         'description': "JSON-Schema object describing the function's "
                                        'arguments'},
@@ -631,7 +660,7 @@ SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
                                    'strict': {'type': 'boolean'}}},
  'ChatCompletionTool': {'type': 'object',
                         'required': ['type', 'function'],
-                        'properties': {'type': {'type': 'string', 'const': 'function'},
+                        'properties': {'type': {'$ref': '#/components/schemas/ChatCompletionToolType'},
                                        'function': {'$ref': '#/components/schemas/FunctionObject'}}},
  'ChatCompletionNamedToolChoice': {'type': 'object',
                                    'required': ['type', 'function'],
@@ -774,7 +803,8 @@ SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
                                                                 'const': 'function'},
                                                        'function': {'type': 'object',
                                                                     'properties': {'name': {'type': 'string'},
-                                                                                   'arguments': {'type': 'string'}}}}},
+                                                                                   'arguments': {'type': 'string'}}},
+                                                       'extra_content': {'$ref': '#/components/schemas/ToolCallExtraContent'}}},
  'ChatCompletionStreamResponseDelta': {'type': 'object',
                                        'properties': {'role': {'$ref': '#/components/schemas/MessageRole'},
                                                       'content': {'type': 'string'},

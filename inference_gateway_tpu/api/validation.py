@@ -32,24 +32,27 @@ _TYPE_CHECKS = {
 }
 
 
-def _resolve(schema: dict[str, Any]) -> dict[str, Any]:
+def _resolve(schema: dict[str, Any], schemas: dict[str, Any]) -> dict[str, Any]:
+    # Handles both "#/components/schemas/X" (openapi.yaml) and
+    # "#/$defs/X" (the MCP protocol schema) pointer roots.
     while isinstance(schema, dict) and "$ref" in schema:
         name = schema["$ref"].rsplit("/", 1)[-1]
-        schema = SCHEMAS[name]
+        schema = schemas[name]
     return schema
 
 
-def _validate(value: Any, schema: Any, path: str, errors: list[str], depth: int = 0) -> None:
+def _validate(value: Any, schema: Any, path: str, errors: list[str], depth: int = 0,
+              schemas: dict[str, Any] = SCHEMAS) -> None:
     if not isinstance(schema, dict) or depth > 32:
         return
-    schema = _resolve(schema)
+    schema = _resolve(schema, schemas)
 
     if "oneOf" in schema:
         branches = schema["oneOf"]
         attempts: list[list[str]] = []
         for branch in branches:
             trial: list[str] = []
-            _validate(value, branch, path, trial, depth + 1)
+            _validate(value, branch, path, trial, depth + 1, schemas=schemas)
             if not trial:
                 return  # some branch accepts
             attempts.append(trial)
@@ -72,7 +75,14 @@ def _validate(value: Any, schema: Any, path: str, errors: list[str], depth: int 
         return
 
     t = schema.get("type")
-    if t is not None:
+    if isinstance(t, list):
+        # JSON-Schema multi-type arrays (the MCP protocol schema uses
+        # e.g. ["string", "integer"] for RequestId); any match accepts.
+        checks = [_TYPE_CHECKS.get(x) for x in t]
+        if not any(c(value) for c in checks if c is not None):
+            errors.append(f"{path}: expected one of {t}, got {type(value).__name__}")
+            return
+    elif t is not None:
         check = _TYPE_CHECKS.get(t)
         if check is not None and not check(value):
             errors.append(f"{path}: expected {t}, got {type(value).__name__}")
@@ -106,12 +116,12 @@ def _validate(value: Any, schema: Any, path: str, errors: list[str], depth: int 
                 # traffic (round-3 review finding).
                 if value[key] is None and key not in required:
                     continue
-                _validate(value[key], sub, f"{path}.{key}" if path else key, errors, depth + 1)
+                _validate(value[key], sub, f"{path}.{key}" if path else key, errors, depth + 1, schemas=schemas)
         addl = schema.get("additionalProperties")
         if isinstance(addl, dict):
             for key, v in value.items():
                 if key not in props:
-                    _validate(v, addl, f"{path}.{key}" if path else key, errors, depth + 1)
+                    _validate(v, addl, f"{path}.{key}" if path else key, errors, depth + 1, schemas=schemas)
 
     if isinstance(value, list):
         if "minItems" in schema and len(value) < schema["minItems"]:
@@ -121,14 +131,25 @@ def _validate(value: Any, schema: Any, path: str, errors: list[str], depth: int 
         items = schema.get("items")
         if items is not None:
             for i, v in enumerate(value):
-                _validate(v, items, f"{path}[{i}]", errors, depth + 1)
+                _validate(v, items, f"{path}[{i}]", errors, depth + 1, schemas=schemas)
 
 
-def validate(instance: Any, schema_name: str, max_errors: int = 8) -> list[str]:
+def validate(instance: Any, schema_name: str, max_errors: int = 8,
+             schemas: dict[str, Any] | None = None) -> list[str]:
     """Validate ``instance`` against a named schema; [] means valid."""
     errors: list[str] = []
-    _validate(instance, {"$ref": f"#/components/schemas/{schema_name}"}, "", errors)
+    _validate(instance, {"$ref": f"#/components/schemas/{schema_name}"}, "", errors,
+              schemas=schemas if schemas is not None else SCHEMAS)
     return errors[:max_errors]
+
+
+def validate_mcp(instance: Any, schema_name: str, max_errors: int = 8) -> list[str]:
+    """Validate an MCP wire dict against the GENERATED protocol schema
+    (mcp/types_gen.py MCP_SCHEMAS — the mcpwrap analog, round-4 verdict
+    next #9). [] means valid."""
+    from inference_gateway_tpu.mcp.types_gen import MCP_SCHEMAS
+
+    return validate(instance, schema_name, max_errors, schemas=MCP_SCHEMAS)
 
 
 def validate_chat_request(body: Any) -> list[str]:

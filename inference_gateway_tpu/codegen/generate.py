@@ -135,6 +135,13 @@ def check_generated_code(spec: dict) -> list[str]:
     current_types = types_path.read_text() if types_path.exists() else ""
     if current_types != want_types:
         problems.append("api/types_gen.py drift — run codegen -type Types")
+    from inference_gateway_tpu.codegen.mcptypesgen import generate_mcp_types_py
+
+    mcp_path = REPO_ROOT / "inference_gateway_tpu" / "mcp" / "types_gen.py"
+    want_mcp = generate_mcp_types_py()
+    current_mcp = mcp_path.read_text() if mcp_path.exists() else ""
+    if current_mcp != want_mcp:
+        problems.append("mcp/types_gen.py drift — run codegen -type Types")
     return problems
 def check_provider_registry(spec: dict) -> list[str]:
     """Registry/constants must match x-provider-configs exactly."""
@@ -279,10 +286,14 @@ def main(argv: list[str] | None = None) -> int:
         target.write_text(generate_constants_py(spec))
         print(f"wrote {target.relative_to(REPO_ROOT)}")
     if args.gen_type in ("Types", "All"):
+        from inference_gateway_tpu.codegen.mcptypesgen import generate_mcp_types_py
         from inference_gateway_tpu.codegen.typesgen import generate_types_py
 
         target = REPO_ROOT / "inference_gateway_tpu" / "api" / "types_gen.py"
         target.write_text(generate_types_py(spec))
+        print(f"wrote {target.relative_to(REPO_ROOT)}")
+        target = REPO_ROOT / "inference_gateway_tpu" / "mcp" / "types_gen.py"
+        target.write_text(generate_mcp_types_py())
         print(f"wrote {target.relative_to(REPO_ROOT)}")
     if args.gen_type in ("MD", "All"):
         (REPO_ROOT / "Configurations.md").write_text(generate_configurations_md(spec))

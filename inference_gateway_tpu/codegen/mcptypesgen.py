@@ -1,0 +1,61 @@
+"""mcp/mcp-schema.json → mcp/types_gen.py (typed MCP protocol surface).
+
+The reference generates 7,538 LoC of Go MCP types by wrapping the
+official MCP JSON Schema's ``$defs`` into an OpenAPI document and running
+oapi-codegen over it (internal/codegen/mcpwrap.go:16, output
+internal/mcp/generated_types.go). This is the Python equivalent, minus
+the detour: the schema's ``$defs`` ARE the schema map, so we emit
+TypedDicts + the raw schema trees directly with the same machinery the
+API typesgen uses (codegen/typesgen.py).
+
+The schema file is the official public MCP protocol artifact — see
+mcp/SCHEMA_PROVENANCE.md. ``MCP_SCHEMAS``'s ``$ref``s stay in
+``#/$defs/...`` form; resolve_ref in api/validation.py handles both
+pointer roots.
+"""
+
+from __future__ import annotations
+
+import json
+import pprint
+from pathlib import Path
+
+from inference_gateway_tpu.codegen.typesgen import _py_type, _typed_dicts
+
+SCHEMA_PATH = Path(__file__).resolve().parent.parent / "mcp" / "mcp-schema.json"
+
+
+def generate_mcp_types_py(schema_path: Path | None = None) -> str:
+    with open(schema_path or SCHEMA_PATH) as f:
+        doc = json.load(f)
+    schemas = doc["$defs"]
+    aliases = [
+        f"{name} = {_py_type(schema)}"
+        for name, schema in schemas.items()
+        if isinstance(schema, dict) and schema.get("type") == "string" and "enum" in schema
+    ]
+    lines = [
+        '"""GENERATED from mcp/mcp-schema.json $defs — do not edit.',
+        "",
+        "Regenerate: ``python -m inference_gateway_tpu.codegen -type Types``.",
+        "Drift-gated by ``-type Check``. The reference generates its MCP",
+        "surface from the same public schema (internal/codegen/mcpwrap.go →",
+        "internal/mcp/generated_types.go); here payloads stay dicts and",
+        "these TypedDicts + MCP_SCHEMAS give the typing/validation surface.",
+        '"""',
+        "",
+        "from typing import Any, NotRequired, TypedDict",
+        "",
+        "# String enums (annotation aliases; the validator enforces values).",
+        *aliases,
+        "",
+        "# Object shapes.",
+        *_typed_dicts(schemas),
+        "",
+        "",
+        "# Raw schema trees for runtime validation (api/validation.py",
+        "# resolves '#/$defs/...' refs against this map).",
+        "MCP_SCHEMAS: dict[str, Any] = " + pprint.pformat(schemas, width=96, sort_dicts=False),
+        "",
+    ]
+    return "\n".join(lines)

@@ -654,3 +654,191 @@ def forward_pipelined(
     if cfg.tie_word_embeddings:
         return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
     return qmatmul(x, params["lm_head"]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel SERVING forward: stage-sharded layers AND KV cache.
+# ---------------------------------------------------------------------------
+def _layer_tp(x, lp, cos, sin, k_cache, v_cache, slot_ids, scatter_pos,
+              mask, cfg: LlamaConfig, attend_cache: bool, tp_axis: str):
+    """One decoder layer inside a shard_map: heads/ffn are tp-LOCAL
+    (column-sharded qkv/gate/up, row-sharded o/down with an explicit
+    psum), mirroring what GSPMD derives from llama_param_specs — but
+    written manually because the enclosing pipeline stage loop runs
+    under shard_map, where there is no partitioner to derive it.
+    k_cache/v_cache are this stage's LOCAL layer block rows with local
+    kv heads: (Slots, S, Hkv/tp, D)."""
+    B, T, H = x.shape
+    D = cfg.hd
+
+    h = rms_norm(x, _nw(lp["attn_norm"], cfg), cfg.rms_norm_eps)
+    q = qmatmul(h, lp["wq"])  # (B, T, Hq*D/tp)
+    k = qmatmul(h, lp["wk"])
+    v = qmatmul(h, lp["wv"])
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    Hq_l = q.shape[-1] // D
+    Hkv_l = k.shape[-1] // D
+    q = q.reshape(B, T, Hq_l, D)
+    k = k.reshape(B, T, Hkv_l, D)
+    v = v.reshape(B, T, Hkv_l, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_k_cache = new_v_cache = None
+    if k_cache is not None:
+        rows = (jnp.arange(B) if slot_ids is None else slot_ids)[:, None]
+        new_k_cache = k_cache.at[rows, scatter_pos].set(k.astype(k_cache.dtype), mode="drop")
+        new_v_cache = v_cache.at[rows, scatter_pos].set(v.astype(v_cache.dtype), mode="drop")
+
+    if attend_cache:
+        kc = new_k_cache if slot_ids is None else new_k_cache[slot_ids]
+        vc = new_v_cache if slot_ids is None else new_v_cache[slot_ids]
+        attn = gqa_attend(q, kc.astype(q.dtype), vc.astype(q.dtype), mask)
+    else:
+        attn = gqa_attend(q, k, v, mask)
+    o_part = qmatmul(attn.reshape(B, T, Hq_l * D), lp["wo"])  # partial over tp
+    x = x + jax.lax.psum(o_part, tp_axis)
+
+    h = rms_norm(x, _nw(lp["mlp_norm"], cfg), cfg.rms_norm_eps)
+    act = _ACT[cfg.hidden_act]
+    d_part = qmatmul(act(qmatmul(h, lp["wg"])) * qmatmul(h, lp["wu"]), lp["wd"])
+    x = x + jax.lax.psum(d_part, tp_axis)
+    return x, new_k_cache, new_v_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "mode", "last_only", "mesh"))
+def forward_pp(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # (B, T)
+    positions: jnp.ndarray,  # (B, T)
+    lengths: jnp.ndarray,  # (B,)
+    cache: Params,
+    mesh,  # Mesh with a "pp" axis (and optionally "tp")
+    mode: str = "prefill",  # "prefill" | "prefill_chunk" | "decode"
+    last_only: bool = True,
+    slot_ids: jnp.ndarray | None = None,
+    embeds: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, Params]:
+    """SERVING forward with the layer axis sharded over ``pp``.
+
+    This is what lets 70B-class models serve on v5e (SURVEY.md §2.4 PP
+    row; round-4 verdict next #6): tp is capped by kv heads (Hkv=8), and
+    tp=8 alone leaves 17.5 GiB/chip of bf16 weights — over the 16 GiB
+    HBM. Sharding layers over ``pp`` splits weights AND the KV cache by
+    stages.
+
+    Unlike forward_pipelined (GPipe microbatch streaming, no cache —
+    batch-scoring throughput), this variant is CACHE-FULL and runs the
+    stages SEQUENTIALLY per call: stage s applies its local layer block
+    (a lax.scan) and writes its local cache rows, then the activation
+    hops one stage forward over ICI (ppermute). Only the stage holding
+    the live activation computes (lax.cond on axis_index) — each chip
+    streams only its own weight shard once per step, which is the whole
+    point. The pp "bubble" shows up as stage-serial latency per step;
+    decode throughput at large batch stays weight-bandwidth-bound and
+    per-chip weight traffic is 1/(tp·pp) of the model.
+
+    tp within a stage is manual Megatron layout (_layer_tp): shard_map
+    gives each device its (L/pp, .../tp) block, so the partitioner
+    cannot derive the collectives — one psum over "tp" after the o and
+    down projections, exactly what GSPMD inserts for the tp-only path.
+    """
+    B, T = tokens.shape
+    pp = mesh.shape["pp"]
+    x = params["embed"][tokens] if embeds is None else embeds.astype(params["embed"].dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+    inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    S = cache["k"].shape[2]
+    if mode == "decode":
+        mask = decode_mask(S, lengths)
+        if cfg.sliding_window:
+            span = jnp.arange(S)
+            mask = mask & (span[None, None, :] > lengths[:, None, None] - 1 - cfg.sliding_window)
+        scatter_pos = positions
+    elif mode == "prefill_chunk":
+        span = jnp.arange(S)
+        mask = (span[None, None, :] <= positions[:, :, None]) & (
+            span[None, None, :] < lengths[:, None, None])
+        if cfg.sliding_window:
+            mask = mask & (span[None, None, :] > positions[:, :, None] - cfg.sliding_window)
+        valid = positions < lengths[:, None]
+        scatter_pos = jnp.where(valid, positions, S)
+    else:
+        valid = jnp.arange(T)[None, :] < lengths[:, None]
+        mask = causal_prefill_mask(positions, lengths)
+        if cfg.sliding_window:
+            mask = mask & (positions[:, None, :] > positions[:, :, None] - cfg.sliding_window)
+        scatter_pos = jnp.where(valid, positions, S)
+    attend_cache = mode in ("decode", "prefill_chunk")
+
+    from jax.sharding import PartitionSpec as P
+
+    from inference_gateway_tpu.parallel.sharding import pp_layer_specs
+
+    layer_specs = pp_layer_specs(cfg, quantized=_is_quantized(params))
+    cache_spec = P("pp", None, None, "tp", None)
+    rep = P()
+
+    def local_fn(x, layers_local, kc, vc, cos_l, sin_l, mask_l, sids, spos):
+        my = jax.lax.axis_index("pp")
+
+        def stage(operand):
+            xx, kcc, vcc = operand
+
+            def body(carry, per_layer):
+                lp, k_l, v_l = per_layer
+                y, nk, nv = _layer_tp(carry, lp, cos_l, sin_l, k_l, v_l, sids,
+                                      spos, mask_l, cfg, attend_cache, "tp")
+                return y, (nk, nv)
+
+            xx, (nk, nv) = jax.lax.scan(body, xx, (layers_local, kcc, vcc))
+            return xx, nk, nv
+
+        for s in range(pp):
+            x, kc, vc = jax.lax.cond(
+                my == s, stage, lambda o: o, (x, kc, vc))
+            if s < pp - 1:
+                x = jax.lax.ppermute(x, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+        # The finished activation lives on the last stage; replicate it.
+        x = jax.lax.psum(jnp.where(my == pp - 1, x, jnp.zeros_like(x)), "pp")
+        return x, kc, vc
+
+    x, new_k, new_v = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(rep, layer_specs, cache_spec, cache_spec, rep, rep, rep, rep, rep),
+        out_specs=(rep, cache_spec, cache_spec),
+        check_vma=False,
+    )(x, params["layers"], cache["k"], cache["v"], cos, sin, mask,
+      jnp.arange(B, dtype=jnp.int32) if slot_ids is None else slot_ids, scatter_pos)
+
+    x = rms_norm(x, _nw(params["final_norm"], cfg), cfg.rms_norm_eps)
+    if last_only:
+        if mode == "decode":
+            idx = jnp.zeros_like(lengths)
+        else:
+            idx = jnp.maximum(lengths - 1 - positions[:, 0], 0)
+        x = x[jnp.arange(B), idx]
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _is_quantized(params: Params) -> str | None:
+    """Which quantization mode the layer stack carries (None = full)."""
+    from inference_gateway_tpu.ops.quant import Q4Tensor, QTensor
+
+    w = params["layers"]["wq"]
+    if isinstance(w, Q4Tensor):
+        return "int4"
+    if isinstance(w, QTensor):
+        return "int8"
+    return None

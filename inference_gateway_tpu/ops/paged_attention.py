@@ -260,12 +260,69 @@ def paged_attention_sharded(q, k_pages, v_pages, page_table, lengths, num_kv_hea
     )(q, k_pages, v_pages, page_table, lengths)
 
 
+# Measured round 3 on a live v5e at serving shape (BENCH_r03.json
+# extra.kernels_tpu): gather 25,856 µs vs kernel 2,448 µs per call —
+# the GSPMD gather fallback is ~10.6× SLOWER than the Pallas kernel.
+# A serving layout must not land on it by accident; paged_dispatch below
+# is the single decision point and tests/test_paged_dispatch.py pins
+# every committed profile (serving/profiles.py) to a kernel path.
+GATHER_FALLBACK_SLOWDOWN = 10.6
+
+
+def paged_dispatch(num_kv_heads: int, num_q_heads: int, folded_dim: int,
+                   tp: int = 1, platform: str = "tpu", n_devices: int = 1,
+                   force: str | None = None) -> tuple[str, str]:
+    """The ONE decision for which paged-attention path a layout takes.
+
+    Returns (path, reason); path ∈ {"kernel", "kernel_sharded",
+    "gather"}. ``folded_dim`` is the pages' minor axis Hkv·D (per-shard
+    lane alignment is checked against it). Pure function of the layout
+    so profiles/tests can audit dispatch without building arrays
+    (round-4 verdict next #10: the 10.6×-slower gather fallback must be
+    an assertion, not an accident).
+
+    Layouts that hit the gather path:
+    - any non-TPU platform (CPU/GPU test runs);
+    - multi-device meshes with tp == 1 (the kernel is not shard_mapped
+      over dp/sp — pages are replicated there, and a per-device kernel
+      launch would duplicate work);
+    - tp > 1 with kv heads or q heads not divisible by tp, or a
+      per-shard folded axis (Hkv·D/tp) off the 128-lane grid;
+    - single-device with folded_dim % 128 != 0 (Mosaic lane rule).
+    """
+    on_tpu = platform in ("tpu", "axon")
+    if tp > 1:
+        if force is not None:
+            if force == "1" and num_kv_heads % tp == 0 and num_q_heads % tp == 0:
+                return "kernel_sharded", "forced by IG_TPU_PAGED_KERNEL=1"
+            return "gather", "forced off (or heads not tp-divisible) under force flag"
+        if not on_tpu:
+            return "gather", f"platform {platform} is not TPU"
+        if num_kv_heads % tp or num_q_heads % tp:
+            return "gather", f"heads not tp-divisible (Hkv={num_kv_heads}, Hq={num_q_heads}, tp={tp})"
+        if (folded_dim // tp) % 128:
+            return "gather", f"per-shard folded axis {folded_dim // tp} not 128-lane aligned"
+        return "kernel_sharded", f"shard_map over tp={tp}, kv-head-local, no collectives"
+    if force is not None:
+        if force == "1":
+            return "kernel", "forced by IG_TPU_PAGED_KERNEL=1"
+        return "gather", "forced off by IG_TPU_PAGED_KERNEL=0"
+    if not on_tpu:
+        return "gather", f"platform {platform} is not TPU"
+    if n_devices != 1:
+        return "gather", f"{n_devices}-device mesh with tp=1 (kernel is single-device or tp-sharded)"
+    if folded_dim % 128:
+        return "gather", f"folded axis {folded_dim} not 128-lane aligned"
+    return "kernel", "single-device TPU, lane-aligned"
+
+
 def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int,
                     use_kernel: bool | None = None, window: int | None = None,
                     mesh=None) -> jnp.ndarray:
     """Dispatch: Pallas kernel on single-device TPU (when the folded head
     axis is lane-aligned) or shard_mapped over ``tp`` under a mesh; XLA
-    gather path elsewhere. The gather path is head-local math, so under a
+    gather path elsewhere (~10.6× slower at serving shape — see
+    paged_dispatch). The gather path is head-local math, so under a
     mesh GSPMD partitions it across ``tp`` (kv-head shards) with no
     collectives. ``IG_TPU_PAGED_KERNEL=1/0`` forces the kernel choice
     (tests exercise the shard_map path on a CPU mesh in interpret mode).
@@ -274,30 +331,19 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, num_kv_heads: int,
     env flip would not apply to compiled shapes (advisor round-2)."""
     force = FORCE_PAGED_KERNEL
     platform = jax.devices()[0].platform
-    if mesh is not None and mesh.shape.get("tp", 1) > 1:
-        tp = mesh.shape["tp"]
-        shardable = (
-            num_kv_heads % tp == 0
-            and q.shape[1] % tp == 0
-            and (k_pages.shape[-1] // tp) % 128 == 0
-        )
-        if force is not None:
-            use_kernel = force == "1" and num_kv_heads % tp == 0 and q.shape[1] % tp == 0
-        elif use_kernel is None:
-            use_kernel = platform in ("tpu", "axon") and shardable
-        if use_kernel:
-            return paged_attention_sharded(q, k_pages, v_pages, page_table, lengths,
-                                           num_kv_heads, mesh, window=window)
-        return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads,
-                                   window=window)
-    if force is not None:
-        use_kernel = force == "1"
-        interpret = platform not in ("tpu", "axon")
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if use_kernel is not None and force is None and tp == 1:
+        # Explicit caller override (tests); force flag still wins above.
+        path = "kernel" if use_kernel and k_pages.shape[-1] % 128 == 0 else "gather"
     else:
-        interpret = False
-        if use_kernel is None:
-            use_kernel = platform in ("tpu", "axon") and len(jax.devices()) == 1
-    if use_kernel and (force == "1" or k_pages.shape[-1] % 128 == 0):
+        path, _ = paged_dispatch(
+            num_kv_heads, q.shape[1], k_pages.shape[-1], tp=tp,
+            platform=platform, n_devices=len(jax.devices()), force=force)
+    if path == "kernel_sharded":
+        return paged_attention_sharded(q, k_pages, v_pages, page_table, lengths,
+                                       num_kv_heads, mesh, window=window)
+    if path == "kernel":
+        interpret = force is not None and platform not in ("tpu", "axon")
         return paged_attention_tpu(q, k_pages, v_pages, page_table, lengths, num_kv_heads,
                                    window=window, interpret=interpret)
     return paged_attention_jax(q, k_pages, v_pages, page_table, lengths, num_kv_heads,

@@ -160,6 +160,69 @@ def quantize_llama_params(params: dict, mode: str = "int8", group: int = 128) ->
     return out
 
 
+def init_quantized_llama_params(rng: jax.Array, cfg, mode: str = "int8",
+                                group: int = 128, dtype=jnp.bfloat16) -> dict:
+    """Random-init + quantize a llama tree WITHOUT materializing the
+    full-precision weights.
+
+    Each stacked matmul leaf is initialized and quantized one LAYER at
+    a time (init→quantize fused in one jit, so the bf16 transient is a
+    single 2-D matrix ≈100 MiB at 8B scale) and the per-layer results
+    are restacked. Full bf16 init of Llama-3-8B needs ~16 GiB — more
+    than a whole v5e chip — before quantization even starts; this path
+    peaks at int4 weights (~4.7 GiB) + one layer's transient, which is
+    what lets the committed single-chip profile `v5e-1-llama-3-8b-int4`
+    (serving/profiles.py) build with random weights on one chip.
+
+    Tree structure/dtypes exactly match quantize_llama_params(
+    llama.init_params(...)); the random values differ (keys are
+    folded per layer), which is irrelevant for perf benches.
+    """
+    from functools import partial as _partial
+
+    L, H, I, V = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    keys = jax.random.split(rng, 8)
+    quant = quantize_tensor if mode == "int8" else (
+        lambda w: quantize_tensor_int4(w, group))
+
+    def norm(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+
+    @_partial(jax.jit, static_argnums=(1,))
+    def qinit(key, shape):
+        return quant(norm(key, shape))
+
+    def qstack(key, shape):
+        per = [qinit(jax.random.fold_in(key, layer), shape[1:]) for layer in range(L)]
+        q = jnp.stack([p.q for p in per])
+        scale = jnp.stack([p.scale for p in per])
+        return type(per[0])(q, scale)
+
+    params = {
+        "embed": norm(keys[0], (V, H)),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": qstack(keys[1], (L, H, Hq * D)),
+            "wk": qstack(keys[2], (L, H, Hkv * D)),
+            "wv": qstack(keys[3], (L, H, Hkv * D)),
+            "wo": qstack(keys[4], (L, Hq * D, H)),
+            "mlp_norm": jnp.ones((L, H), dtype),
+            "wg": qstack(keys[5], (L, H, I)),
+            "wu": qstack(keys[6], (L, H, I)),
+            "wd": qstack(keys[7], (L, I, H)),
+        },
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if cfg.qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((L, Hq * D), dtype)
+        params["layers"]["bk"] = jnp.zeros((L, Hkv * D), dtype)
+        params["layers"]["bv"] = jnp.zeros((L, Hkv * D), dtype)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = qinit(jax.random.fold_in(rng, 99), (H, V))
+    return params
+
+
 def dequantize_error(w: jnp.ndarray, mode: str = "int8", group: int = 128) -> float:
     """Max relative reconstruction error (diagnostics)."""
     if mode == "int8":

@@ -24,6 +24,7 @@ from jax.sharding import Mesh
 
 AXES = ("dp", "sp", "tp")
 MOE_AXES = ("dp", "sp", "ep", "tp")
+PP_AXES = ("dp", "pp", "tp")
 
 
 def create_mesh(
@@ -42,6 +43,21 @@ def create_mesh(
         raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
     dev_array = mesh_utils.create_device_mesh(shape, devices=devices, allow_split_physical_axes=True)
     return Mesh(dev_array, axis_names)
+
+
+def create_pp_mesh(dp: int = 1, pp: int = 2, tp: int = 1, devices=None) -> Mesh:
+    """(dp, pp, tp) mesh for pipeline-parallel serving (SURVEY §2.4 PP
+    row): ``pp`` stages hold contiguous layer blocks (weights + KV), so
+    the per-step activation hop between stages rides ICI neighbours;
+    ``tp`` shards heads/ffn within each stage."""
+    shape = (dp, pp, tp)
+    n = math.prod(shape)
+    if devices is None:
+        devices = jax.devices()[:n]
+    if len(devices) != n:
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    dev_array = mesh_utils.create_device_mesh(shape, devices=devices, allow_split_physical_axes=True)
+    return Mesh(dev_array, PP_AXES)
 
 
 def create_moe_mesh(dp: int = 1, sp: int = 1, ep: int = 1, tp: int = 1, devices=None) -> Mesh:

@@ -75,6 +75,42 @@ def quantized_specs(specs: dict, mode: str = "int8") -> dict:
     return out
 
 
+def pp_layer_specs(cfg: LlamaConfig, quantized: str | None = None) -> dict:
+    """Spec tree for params["layers"] with the LAYER axis sharded over
+    ``pp`` on top of the Megatron tp layout — the stage-sharded layout
+    models/llama.py::forward_pp consumes via shard_map (each device gets
+    its (L/pp, .../tp) block). ``quantized`` wraps quantizable leaves in
+    QTensor/Q4Tensor spec nodes exactly like quantized_specs."""
+    base = llama_param_specs(cfg)
+    if quantized:
+        base = quantized_specs(base, mode=quantized)
+
+    from inference_gateway_tpu.ops.quant import Q4Tensor, QTensor
+
+    def add_pp(p):
+        return P("pp", *tuple(p)[1:])
+
+    def walk(node):
+        if isinstance(node, (QTensor, Q4Tensor)):
+            return type(node)(add_pp(node.q), add_pp(node.scale))
+        return add_pp(node)
+
+    return {
+        name: walk(spec) for name, spec in base["layers"].items()
+    }
+
+
+def pp_param_specs(cfg: LlamaConfig, quantized: str | None = None) -> dict:
+    """Full-tree specs for pp×tp serving: layers stage-sharded (above),
+    embed/lm_head/norms as in the tp-only layout (pp-replicated)."""
+    base = llama_param_specs(cfg)
+    if quantized:
+        base = quantized_specs(base, mode=quantized)
+    out = dict(base)
+    out["layers"] = pp_layer_specs(cfg, quantized=quantized)
+    return out
+
+
 def llama_cache_specs() -> dict:
     """KV cache (L, B, S, Hkv, D): batch on dp, kv heads on tp."""
     return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}

@@ -62,6 +62,7 @@ class ServingProfile:
             attention=self.attention, page_size=self.page_size, num_pages=self.num_pages,
             decode_chunk=self.decode_chunk, quantize=self.quantize,
             use_mesh=self.n_chips > 1,
+            mesh_shape=dict(self.mesh) if self.mesh else None,
         )
 
 
@@ -121,7 +122,8 @@ def hbm_plan(profile: ServingProfile) -> dict:
     tp = profile.mesh.get("tp", 1)
     ep = profile.mesh.get("ep", 1)
     dp = profile.mesh.get("dp", 1)
-    assert dp * tp * ep * profile.mesh.get("sp", 1) == profile.n_chips or profile.n_chips == 1
+    pp = profile.mesh.get("pp", 1)
+    assert dp * tp * ep * pp * profile.mesh.get("sp", 1) == profile.n_chips or profile.n_chips == 1
 
     # Quantization only touches the matmul weights (ops/quant.py
     # QUANTIZABLE + lm_head); the embedding table always stays at the
@@ -138,8 +140,15 @@ def hbm_plan(profile: ServingProfile) -> dict:
             + expert_params * wbytes // (ep * tp))
     else:
         n_params = llama_param_count(cfg)
+        # Under pp the stacked decoder layers shard by stage; the embed
+        # (vocab-sharded over tp) and lm_head (output-sharded over tp)
+        # are pp-REPLICATED — they run outside the stage loop
+        # (models/llama.py forward_pp), so only layer params divide by pp.
+        head_params = 0 if cfg.tie_word_embeddings else cfg.vocab_size * cfg.hidden_size
+        layer_params = n_params - embed_params - head_params
         weights_per_chip = int(
-            embed_params * 2 // tp + (n_params - embed_params) * wbytes // tp)
+            embed_params * 2 // tp + head_params * wbytes // tp
+            + layer_params * wbytes // (tp * pp))
     # Scale rows: int8 per-channel ~1/(min matrix dim) of weight bytes
     # (budget 2%); int4 group-128 scales are 4B per 128 nibbles (~6%).
     if profile.quantize == "int8":
@@ -150,7 +159,8 @@ def hbm_plan(profile: ServingProfile) -> dict:
     tokens = profile.num_pages * profile.page_size if profile.num_pages else (
         profile.max_slots * profile.max_seq_len
     )
-    kv_per_chip = tokens * kv_bytes_per_token(cfg) // tp
+    # KV: heads shard over tp; under pp the layer axis shards by stage.
+    kv_per_chip = tokens * kv_bytes_per_token(cfg) // (tp * pp)
 
     # Activation high-water mark: the biggest prefill bucket's residual
     # stream + attention workspace, bf16, plus the lm_head logits row.
@@ -257,6 +267,27 @@ PROFILES: dict[str, ServingProfile] = {
         decode_chunk=16,
         quantize="int8",
         mesh={"ep": 8, "tp": 2},
+    ),
+    # 70B-class on v5e-16 via PIPELINE stages (SURVEY §2.4 PP row): tp
+    # is capped at 8 by the model's 8 kv heads, and tp=8 alone leaves
+    # 17.5 GiB/chip of bf16 weights — over the 16 GiB HBM
+    # (tests/test_pp_serving.py proves the tp-only plan does NOT fit).
+    # pp=2 shards the 80 decoder layers (weights AND the KV cache's
+    # layer axis) into two stages: ~8.75 GiB weights + ~1.3 GiB KV per
+    # chip, serving bf16 with no quantization required. Dense cache —
+    # the engine's pp path is dense-only (engine.py pp gate).
+    "v5e-16-llama-3-70b": ServingProfile(
+        name="v5e-16-llama-3-70b",
+        model="llama-3-70b",
+        n_chips=16,
+        max_slots=16,
+        max_seq_len=4096,
+        prefill_buckets=(512, 1024, 2048, 4096),
+        max_prefill_batch=2,
+        page_size=128,
+        decode_chunk=16,
+        attention="dense",
+        mesh={"pp": 2, "tp": 8},
     ),
     # Single-chip bench profile (what bench.py builds on the one real
     # chip the driver exposes): TinyLlama shapes, 64 slots — the

@@ -1,0 +1,98 @@
+"""Paged-attention dispatch audit (round-4 verdict next #10).
+
+The GSPMD gather fallback measured ~10.6× slower than the Pallas kernel
+at serving shape on a live v5e (BENCH_r03.json extra.kernels_tpu:
+25,856 µs vs 2,448 µs). These tests make the dispatch an assertion, not
+an accident: every committed serving profile's layout must land on a
+kernel path on TPU, and the decision function must agree with the live
+dispatcher's observable behavior.
+"""
+
+import numpy as np
+import pytest
+
+from inference_gateway_tpu.ops.paged_attention import paged_dispatch
+from inference_gateway_tpu.serving.profiles import PROFILES, resolve_model_cfg
+
+
+@pytest.mark.parametrize("name", [n for n, p in PROFILES.items() if p.attention == "paged"])
+def test_committed_profiles_dispatch_to_kernel(name):
+    """No committed profile may silently serve at 10.6× the attention
+    cost. If a profile legitimately needs the gather path one day, it
+    must say so here explicitly."""
+    p = PROFILES[name]
+    cfg = resolve_model_cfg(p.model)
+    tp = p.mesh.get("tp", 1)
+    path, reason = paged_dispatch(
+        num_kv_heads=cfg.num_kv_heads,
+        num_q_heads=cfg.num_heads,
+        folded_dim=cfg.num_kv_heads * cfg.hd,
+        tp=tp,
+        platform="tpu",
+        n_devices=p.n_chips,
+    )
+    if tp > 1:
+        assert path == "kernel_sharded", (name, path, reason)
+    elif p.n_chips == 1:
+        assert path == "kernel", (name, path, reason)
+    else:
+        # tp=1 multi-chip paged profiles would gather — none may exist
+        # without an explicit exemption recorded here.
+        pytest.fail(f"{name}: tp=1 multi-chip paged layout hits the "
+                    f"gather fallback ({reason}); add tp or an exemption")
+
+
+def test_gather_conditions_reported():
+    """The documented fallback conditions are the ones the function
+    enforces (misaligned folded axis, non-divisible heads, tp=1
+    multi-device, non-TPU platform)."""
+    # tinyllama-like: Hkv*D = 256, aligned → kernel single-chip.
+    assert paged_dispatch(4, 32, 256)[0] == "kernel"
+    # Misaligned folded axis (Hkv*D = 192).
+    assert paged_dispatch(3, 24, 192)[0] == "gather"
+    # Multi-device mesh with tp=1 always gathers.
+    assert paged_dispatch(8, 32, 1024, tp=1, n_devices=8)[0] == "gather"
+    # kv heads not divisible by tp.
+    assert paged_dispatch(6, 24, 768, tp=4, n_devices=4)[0] == "gather"
+    # per-shard folded axis off the lane grid: 8 heads * 80 dim / 8 = 80.
+    assert paged_dispatch(8, 32, 640, tp=8, n_devices=8)[0] == "gather"
+    # CPU platform never takes the kernel without the force flag.
+    assert paged_dispatch(8, 32, 1024, platform="cpu")[0] == "gather"
+    # Proper tp-sharded flagship layout rides the shard_mapped kernel.
+    assert paged_dispatch(8, 32, 1024, tp=8, n_devices=8)[0] == "kernel_sharded"
+
+
+def test_force_flag_precedence():
+    assert paged_dispatch(4, 32, 192, force="1")[0] == "kernel"
+    assert paged_dispatch(4, 32, 256, force="0")[0] == "gather"
+    assert paged_dispatch(8, 32, 1024, tp=8, force="1")[0] == "kernel_sharded"
+    # Forced on but heads not shardable: falls back rather than crashing
+    # inside shard_map.
+    assert paged_dispatch(6, 24, 768, tp=4, force="1")[0] == "gather"
+
+
+def test_dispatch_matches_live_path_on_cpu():
+    """The pure decision function and the real dispatcher agree: on this
+    CPU test platform every layout gathers (and still computes the right
+    numbers vs the reference oracle)."""
+    import jax
+    import jax.numpy as jnp
+
+    from inference_gateway_tpu.ops.paged_attention import (
+        paged_attention, paged_attention_jax)
+
+    platform = jax.devices()[0].platform
+    path, _ = paged_dispatch(4, 8, 256, platform=platform,
+                             n_devices=len(jax.devices()))
+    assert path == "gather"
+
+    rng = np.random.default_rng(0)
+    B, Hq, Hkv, D, ps, P, mp = 2, 8, 4, 64, 16, 8, 2
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)), jnp.float32)
+    pt = jnp.asarray(rng.integers(0, P, (B, mp)), jnp.int32)
+    lengths = jnp.asarray([ps * mp, ps], jnp.int32)
+    got = paged_attention(q, k, v, pt, lengths, Hkv)
+    want = paged_attention_jax(q, k, v, pt, lengths, Hkv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)

@@ -36,8 +36,9 @@ def _solo_reference(cfg_kwargs, prompt, *, seed=None, temperature=0.0, max_token
 
 def test_pipelined_streams_match_solo_references():
     """Staggered submissions force the full pipeline lifecycle — fresh
-    submit, chained submits, drain-for-admission, resubmit — and every
-    request's stream must equal its solo (batch-independent) reference."""
+    submit, chained submits, async admission scatter, slot reuse — and
+    every request's stream must equal its solo (batch-independent)
+    reference."""
     for attention in ("dense", "paged"):
         cfg_kwargs = dict(model="test-tiny", max_slots=4, max_seq_len=96, dtype="float32",
                           max_prefill_batch=2, use_mesh=False, attention=attention,
@@ -110,13 +111,19 @@ def test_top_k_disabled_and_oversized_still_decode():
             s.stop()
 
 
-def test_chained_submit_requires_valid_carry():
-    """chain=True after a prefill (which invalidates the device carry)
-    must raise instead of silently decoding stale tokens."""
-    cfg = EngineConfig(model="test-tiny", max_slots=2, max_seq_len=64, dtype="float32",
-                       max_prefill_batch=2, use_mesh=False, attention="dense",
-                       decode_chunk=2, prefill_buckets=(16, 32))
-    eng = Engine(cfg)
+def test_chained_submit_carry_and_admission_scatter():
+    """chain=True with no carry ever established must raise; once a
+    carry exists, a prefill no longer invalidates it — the admitted
+    slot's (first token, position, sampling params) are scattered into
+    the device-resident state (engine._admit_scatter_fn), so chained
+    decoding continues across admissions with no host sync AND the
+    admitted slot's chained tokens match an unchained reference."""
+    mk = lambda: Engine(EngineConfig(
+        model="test-tiny", max_slots=2, max_seq_len=64, dtype="float32",
+        max_prefill_batch=2, use_mesh=False, attention="dense",
+        decode_chunk=2, prefill_buckets=(16, 32)))
+    eng = mk()
+    cfg = eng.config
     S = cfg.max_slots
     z = np.zeros((S,), np.int32)
     act = np.zeros((S,), bool)
@@ -139,10 +146,29 @@ def test_chained_submit_requires_valid_carry():
     toks2, _ = eng.decode_chunk_fetch(h2)
     assert toks1.shape == toks2.shape == (cfg.decode_chunk, S)
 
-    # A prefill invalidates the carry again.
-    eng.prefill([[4, 5]], [1], [0.0], [1.0])
-    with pytest.raises(RuntimeError, match="chain"):
-        eng.decode_chunk_submit(z, z, act, f, ones, chain=True)
+    # Async admission: a prefill with a live carry SCATTERS the new
+    # slot's state into it; a chained submit then decodes the admitted
+    # slot from its first token with no host round-trip.
+    res = eng.prefill([[4, 5]], [1], [0.0], [1.0])[0]
+    act2 = act.copy()
+    act2[1] = True
+    pos_pred = np.asarray([3 + 2 * cfg.decode_chunk, 2], np.int32)
+    h3 = eng.decode_chunk_submit(z, pos_pred, act2, f, ones, chain=True)
+    toks3, _ = eng.decode_chunk_fetch(h3)
+
+    # Reference: same prompt alone on a fresh engine, unchained chunk
+    # from (first_token, pos=2). Greedy + per-row dense cache rows make
+    # the stream batch-independent.
+    ref = mk()
+    rres = ref.prefill([[4, 5]], [1], [0.0], [1.0])[0]
+    assert rres.first_token == res.first_token
+    rtok = np.zeros((S,), np.int32)
+    rpos = np.zeros((S,), np.int32)
+    ract = np.zeros((S,), bool)
+    rtok[1], rpos[1], ract[1] = rres.first_token, 2, True
+    rh = ref.decode_chunk_submit(rtok, rpos, ract, f, ones)
+    rtoks, _ = ref.decode_chunk_fetch(rh)
+    assert [int(t) for t in toks3[:, 1]] == [int(t) for t in rtoks[:, 1]]
 
 
 def test_chained_chunks_equal_one_big_chunk():
