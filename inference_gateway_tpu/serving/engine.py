@@ -253,11 +253,19 @@ class Engine:
                     if not group_ok(group):
                         raise ValueError(
                             f"no int4 group size tiles model dims {cins} under tp={tp}")
-            if params is None and not self.is_moe:
-                # Random-weight quantized build: init + quantize one
-                # layer at a time so the full-precision tree is never
+            fp_shapes = jax.eval_shape(
+                partial(self._model.init_params, cfg=self.model_cfg, dtype=self.dtype),
+                jax.random.PRNGKey(config.seed))
+            fp_bytes = sum(s.size * s.dtype.itemsize for s in jax.tree.leaves(fp_shapes))
+            if params is None and not self.is_moe and fp_bytes > 2 << 30:
+                # Random-weight quantized build at scale: init + quantize
+                # one layer at a time so the full-precision tree is never
                 # resident — Llama-3-8B-int4 then fits ONE 16 GiB chip
-                # (full bf16 init alone would need ~16 GiB).
+                # (full bf16 init alone would need ~16 GiB). The per-layer
+                # key folding makes the values differ from init_params, so
+                # small models take the quantize-after-init path below and
+                # stay weight-identical to an unquantized engine with the
+                # same seed (tests/test_quant.py relies on this).
                 params = init_quantized_llama_params(
                     jax.random.PRNGKey(config.seed), self.model_cfg,
                     mode=config.quantize, group=group, dtype=self.dtype)

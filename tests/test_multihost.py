@@ -28,6 +28,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
 import jax
+
+# The container's sitecustomize imports jax at interpreter startup, so the
+# env vars above are too late for jax's import-time config snapshot — without
+# this, platform resolution can try the axon TPU plugin, which blocks
+# indefinitely when the device tunnel is down (the exact 420 s worker
+# timeout round 4 shipped with). The parent also exports JAX_PLATFORMS=cpu
+# in our env before exec as belt and braces.
+jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 sys.path.insert(0, os.environ["REPO_ROOT"])
@@ -45,7 +54,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from inference_gateway_tpu.models import llama
 from inference_gateway_tpu.parallel.sharding import llama_param_specs, named
 
-cfg = llama.PRESETS["test-tiny"]
+# tp=4 shards the KV-head axis 4 ways; test-tiny is GQA with 2 kv heads,
+# so widen to MHA (4 kv heads) for this geometry.
+import dataclasses
+cfg = dataclasses.replace(llama.PRESETS["test-tiny"], num_kv_heads=4)
 mesh = global_mesh(dp=1, sp=1, tp=4)
 
 params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
@@ -66,18 +78,21 @@ tokens = jnp.asarray([prompt], jnp.int32)
 positions = jnp.arange(T, dtype=jnp.int32)[None, :]
 lengths = jnp.asarray([T], jnp.int32)
 
-with jax.sharding.use_mesh(mesh):
+with jax.sharding.set_mesh(mesh):
     logits, cache = llama.forward(params, cfg, tokens, positions, lengths, cache,
                                   mode="prefill", last_only=True)
-    tok1 = int(np.asarray(jax.device_get(logits.addressable_shards[0].data)).argmax())
+    # argmax/abs-sum as jitted GLOBAL reductions: the outputs are fully
+    # replicated scalars addressable on every process (reading a raw
+    # addressable shard would give each process a different tp slice).
+    tok1 = int(jax.jit(lambda l: jnp.argmax(l.reshape(-1)))(logits))
     step_logits, cache = llama.forward(
         params, cfg, jnp.asarray([[tok1]], jnp.int32), jnp.asarray([[T]], jnp.int32),
         jnp.asarray([T + 1]), cache, mode="decode")
-    l2 = np.asarray(jax.device_get(step_logits.addressable_shards[0].data))
-    tok2 = int(l2[0, 0].argmax())
+    tok2 = int(jax.jit(lambda l: jnp.argmax(l.reshape(-1)))(step_logits))
+    checksum = float(jax.jit(lambda l: jnp.abs(l).sum())(step_logits))
 
 out = {"pid": info["process_index"], "tok1": tok1, "tok2": tok2,
-       "checksum": float(np.abs(l2).sum())}
+       "checksum": checksum}
 with open(os.environ["OUT_PATH"] + f".{info['process_index']}", "w") as f:
     json.dump(out, f)
 print("WORKER_OK", out, flush=True)
@@ -101,7 +116,9 @@ def test_two_process_sharded_prefill_decode(tmp_path):
         env = dict(os.environ,
                    COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
                    NUM_PROCESSES="2", PROCESS_ID=str(pid),
-                   REPO_ROOT=repo, OUT_PATH=out_path)
+                   REPO_ROOT=repo, OUT_PATH=out_path,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2")
         env.pop("PYTEST_CURRENT_TEST", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _WORKER], env=env,
@@ -129,12 +146,14 @@ def test_two_process_sharded_prefill_decode(tmp_path):
     np.testing.assert_allclose(results[0]["checksum"], results[1]["checksum"], rtol=1e-5)
 
     # And it matches the single-process unsharded reference.
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
     from inference_gateway_tpu.models import llama
 
-    cfg = llama.PRESETS["test-tiny"]
+    cfg = dataclasses.replace(llama.PRESETS["test-tiny"], num_kv_heads=4)
     params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
     cache = llama.init_cache(cfg, 1, 32, dtype=jnp.float32)
     prompt = [1, 2, 3, 4, 5]
