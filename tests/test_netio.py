@@ -46,3 +46,67 @@ def test_sse_helpers():
     assert parse_data_line(b"event: foo") is None
     body = frame + format_event("raw") + DONE_FRAME
     assert list(split_sse_payloads(body)) == [b'{"a":1}', b"raw"]
+
+
+# -- chunked-stream parser (client) -----------------------------------------
+import asyncio
+
+from inference_gateway_tpu.netio.client import ClientResponse
+from inference_gateway_tpu.netio.server import Headers as _H
+
+
+def _chunked_response(feeds: list[bytes], eof: bool = True) -> ClientResponse:
+    reader = asyncio.StreamReader()
+    for blob in feeds:
+        reader.feed_data(blob)
+    if eof:
+        reader.feed_eof()
+    h = _H()
+    h.set("Transfer-Encoding", "chunked")
+    return ClientResponse(status=200, headers=h, _reader=reader)
+
+
+async def _collect(resp, timeout=2.0):
+    out = []
+    async def run():
+        async for block in resp.iter_raw():
+            out.append(block)
+    await asyncio.wait_for(run(), timeout)
+    return out
+
+
+async def test_iter_raw_coalesces_buffered_chunks():
+    resp = _chunked_response([b"2\r\nab\r\n2\r\ncd\r\n0\r\n\r\n"])
+    out = await _collect(resp)
+    assert b"".join(out) == b"abcd"
+    assert len(out) == 1  # both chunks left in ONE coalesced yield
+    assert resp._drained
+
+
+async def test_iter_raw_terminal_crlf_split_across_reads():
+    """The final CRLF may arrive one byte at a time (code-review round 5:
+    a lone trailing '\\r' hung the stream and held parsed payloads)."""
+    resp = _chunked_response([b"2\r\nhi\r\n0\r\n\r", b"\n"])
+    out = await _collect(resp)
+    assert b"".join(out) == b"hi"
+    assert resp._drained
+
+
+async def test_iter_raw_mid_chunk_eof_raises():
+    """A connection dropped mid-chunk must surface as an error, not a
+    silently truncated-but-clean stream."""
+    resp = _chunked_response([b"10\r\nonly-half"])
+    try:
+        await _collect(resp)
+    except asyncio.IncompleteReadError:
+        pass
+    else:
+        raise AssertionError("expected IncompleteReadError")
+    assert not resp._drained
+
+
+async def test_iter_raw_eof_at_chunk_boundary_tolerated():
+    resp = _chunked_response([b"2\r\nok\r\n"])  # no terminal chunk, then EOF
+    out = await _collect(resp)
+    assert b"".join(out) == b"ok"
+    assert not resp._drained  # unclean close → not poolable
