@@ -49,12 +49,39 @@ class MCPClient:
         self._session_ids: dict[str, str] = {}
         self._tools: dict[str, list[dict[str, Any]]] = {}
         self._status: dict[str, bool] = {u: False for u in self.servers}
+        # Per-server protocol-schema violations from the last discovery
+        # (tool dropped) or tools/call (result rejected) — surfaced in
+        # health status the way the reference's typed decode failures are.
+        self._schema_errors: dict[str, list[str]] = {}
         self._initialized = False
         self._ids = itertools.count(1)
         self._lock = asyncio.Lock()
         self._reconnecting: set[str] = set()
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
+
+    def _validated_tools(self, server: str, tools: list[Any]) -> list[dict[str, Any]]:
+        """Gate discovered tools through the GENERATED MCP protocol schema
+        (mcp/types_gen.py) — the runtime analog of the reference's typed
+        tools/list decode (tools.go:92-152): a tool that doesn't satisfy
+        the protocol's Tool shape is dropped (it could not be converted
+        to a chat tool safely) and the violation is recorded for health.
+        """
+        from inference_gateway_tpu.api.validation import validate_mcp
+
+        good: list[dict[str, Any]] = []
+        errors: list[str] = []
+        for tool in tools:
+            errs = validate_mcp(tool, "Tool", max_errors=2)
+            if errs:
+                name = tool.get("name") if isinstance(tool, dict) else None
+                errors.append(f"tool {name!r}: {'; '.join(errs)}")
+                self.logger.warn("mcp tool failed protocol validation — dropped",
+                                 "server", server, "tool", name, "errors", "; ".join(errs))
+            else:
+                good.append(tool)
+        self._schema_errors[server] = errors
+        return good
 
     # -- rpc transport -------------------------------------------------
     async def _post_rpc(self, url: str, server: str, method: str, params: dict[str, Any],
@@ -161,8 +188,9 @@ class MCPClient:
                 await self._post_rpc(url, server, "initialize", params, self.cfg.request_timeout)
                 self._effective_url[server] = url
                 result = await self._post_rpc(url, server, "tools/list", {}, self.cfg.request_timeout)
+                tools = self._validated_tools(server, result.get("tools") or [])
                 async with self._lock:
-                    self._tools[server] = result.get("tools") or []
+                    self._tools[server] = tools
                     self._status[server] = True
                 self.logger.info("mcp server initialized", "server", server,
                                  "tools", len(self._tools[server]), "transport", url)
@@ -212,8 +240,14 @@ class MCPClient:
     async def _check_server_health(self, server: str) -> bool:
         try:
             result = await self._rpc(server, "tools/list", {}, timeout=self.cfg.polling_timeout)
+            raw = result.get("tools") or []
+            tools = self._validated_tools(server, raw)
             async with self._lock:
-                self._tools[server] = result.get("tools") or self._tools.get(server, [])
+                # An empty tools/list keeps the last-known set (transient
+                # empty responses shouldn't withdraw tools), but tools
+                # REJECTED by validation are withdrawn — offering the
+                # model a tool the gate just refused is worse than none.
+                self._tools[server] = tools if raw else self._tools.get(server, [])
             if not self.cfg.disable_healthcheck_logs:
                 self.logger.info("mcp healthcheck ok", "server", server)
             return True
@@ -238,6 +272,11 @@ class MCPClient:
 
     def get_server_statuses(self) -> dict[str, bool]:
         return dict(self._status)
+
+    def get_server_schema_errors(self) -> dict[str, list[str]]:
+        """Protocol-validation failures per server from the last
+        discovery/call — [] means the wire payloads were all well-typed."""
+        return {s: list(v) for s, v in self._schema_errors.items() if v}
 
     def has_available_servers(self) -> bool:
         return any(self._status.values())
@@ -274,4 +313,21 @@ class MCPClient:
         if server is None:
             raise MCPError(f"no MCP server provides tool {name!r}")
         bare = name.removeprefix(TOOL_PREFIX)
-        return await self._rpc(server, "tools/call", {"name": bare, "arguments": arguments})
+        result = await self._rpc(server, "tools/call", {"name": bare, "arguments": arguments})
+        # Typed result gate (agent.go:299-336's CallToolResult decode):
+        # a result that violates the protocol schema is an error, not a
+        # payload to hand the model.
+        from inference_gateway_tpu.api.validation import validate_mcp
+
+        if isinstance(result, dict):
+            # The schema revision requires resultType, but mandates that
+            # clients treat its absence (pre-revision servers, e.g.
+            # protocol 2024-11-05) as "complete".
+            result.setdefault("resultType", "complete")
+        errs = validate_mcp(result, "CallToolResult", max_errors=2)
+        if errs:
+            detail = "; ".join(errs)
+            self._schema_errors.setdefault(server, []).append(
+                f"tools/call {bare!r}: {detail}")
+            raise MCPError(f"malformed tools/call result for {bare!r}: {detail}")
+        return result

@@ -200,6 +200,52 @@ async def test_client_sse_transport_fallback():
     await mcp_srv.server.shutdown()
 
 
+async def test_client_rejects_malformed_server_payloads():
+    """Live-path protocol typing (round-4 verdict next #7): a tool that
+    violates the generated MCP Tool schema is dropped at discovery, a
+    tools/call result that violates CallToolResult is an error, and both
+    violations surface in the server's schema-error health detail."""
+    mcp_srv = FakeMCPServer(tools=[
+        {"name": "good_tool", "description": "ok", "inputSchema": {"type": "object"}},
+        {"description": "no name field", "inputSchema": {"type": "object"}},
+        {"name": "bad_schema", "inputSchema": "not-an-object"},
+    ])
+
+    async def bad_call(req):
+        payload = req.json()
+        method = payload.get("method")
+        if method == "initialize":
+            result = {"protocolVersion": "2024-11-05", "serverInfo": {"name": "fake"}}
+        elif method == "tools/list":
+            result = {"tools": mcp_srv.tools}
+        else:  # tools/call → content must be an array of blocks
+            result = {"content": "just a string", "isError": False}
+        return Response.json({"jsonrpc": "2.0", "id": payload.get("id"), "result": result})
+
+    router = Router()
+    router.post("/mcp", bad_call)
+    router.post("/sse", bad_call)
+    mcp_srv.server = HTTPServer(router)
+    port = await mcp_srv.start()
+    url = f"http://127.0.0.1:{port}/mcp"
+    cfg = MCPConfig(enable=True, servers=url, max_retries=1, initial_backoff=0.01)
+    client = MCPClient(cfg, HTTPClient())
+    await client.initialize_all()
+    assert client.has_available_servers()
+    # Only the well-typed tool survived discovery.
+    names = [t["function"]["name"] for t in client.get_all_chat_completion_tools()]
+    assert names == ["mcp_good_tool"]
+    errors = client.get_server_schema_errors()
+    assert len(errors[url]) == 2
+
+    from inference_gateway_tpu.mcp.client import MCPError
+    with pytest.raises(MCPError, match="malformed tools/call result"):
+        await client.execute_tool("mcp_good_tool", {})
+    assert any("tools/call" in e for e in client.get_server_schema_errors()[url])
+    await client.shutdown()
+    await mcp_srv.server.shutdown()
+
+
 async def test_client_unreachable_server_degrades():
     cfg = MCPConfig(enable=True, servers="http://127.0.0.1:1/mcp",
                     max_retries=1, initial_backoff=0.01, enable_reconnect=True,

@@ -37,7 +37,7 @@ class FlakyMCPServer:
             self.initialize_count += 1
             result = {"protocolVersion": "2024-11-05"}
         elif method == "tools/list":
-            result = {"tools": [{"name": "ping", "description": "pong", "inputSchema": {}}]}
+            result = {"tools": [{"name": "ping", "description": "pong", "inputSchema": {"type": "object"}}]}
         elif method == "tools/call":
             result = {"content": [{"type": "text", "text": "pong"}], "isError": False}
         else:
