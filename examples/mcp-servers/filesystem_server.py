@@ -1,0 +1,136 @@
+"""Sample MCP server: sandboxed filesystem operations.
+
+Reference parity: examples/docker-compose/mcp/filesystem-server/main.go —
+the fixture BASELINE.md config 3 names. Exposes the same seven tools
+(write_file, read_file, delete_file, list_directory, create_directory,
+file_exists, file_info), every path confined to --base-dir exactly like
+the reference's validatePath (main.go:533-547). Built on the framework's
+own netio stack; run with
+``python examples/mcp-servers/filesystem_server.py --port 3002 --base-dir /tmp/fsdata``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import datetime
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router
+
+BASE_DIR = Path(os.environ.get("BASE_DIR", "/tmp/mcp-filesystem-data"))
+
+_PATH_PROP = {"path": {"type": "string", "description": "path relative to the served root"}}
+
+TOOLS = [
+    {"name": "write_file", "description": "Write content to a file",
+     "inputSchema": {"type": "object",
+                     "properties": {**_PATH_PROP, "content": {"type": "string"}},
+                     "required": ["path", "content"]}},
+    {"name": "read_file", "description": "Read content from a file",
+     "inputSchema": {"type": "object", "properties": _PATH_PROP, "required": ["path"]}},
+    {"name": "delete_file", "description": "Delete a file",
+     "inputSchema": {"type": "object", "properties": _PATH_PROP, "required": ["path"]}},
+    {"name": "list_directory", "description": "List the contents of a directory",
+     "inputSchema": {"type": "object", "properties": _PATH_PROP, "required": ["path"]}},
+    {"name": "create_directory", "description": "Create a directory",
+     "inputSchema": {"type": "object", "properties": _PATH_PROP, "required": ["path"]}},
+    {"name": "file_exists", "description": "Check if a file or directory exists",
+     "inputSchema": {"type": "object", "properties": _PATH_PROP, "required": ["path"]}},
+    {"name": "file_info", "description": "Get detailed information about a file or directory",
+     "inputSchema": {"type": "object", "properties": _PATH_PROP, "required": ["path"]}},
+]
+
+
+def _resolve(path: str) -> Path:
+    """Confine ``path`` to BASE_DIR (reference validatePath): normalize,
+    join under the root, and refuse anything that escapes it."""
+    joined = (BASE_DIR / path.lstrip("/")).resolve()
+    root = BASE_DIR.resolve()
+    if joined != root and root not in joined.parents:
+        raise PermissionError("path is outside allowed directory")
+    return joined
+
+
+def call_tool(name: str, args: dict) -> str:
+    p = _resolve(str(args.get("path", "")))
+    if name == "write_file":
+        p.parent.mkdir(parents=True, exist_ok=True)
+        content = str(args.get("content", ""))
+        p.write_text(content)
+        return json.dumps({"path": str(p.relative_to(BASE_DIR.resolve())), "bytes": len(content)})
+    if name == "read_file":
+        return p.read_text()
+    if name == "delete_file":
+        p.unlink()
+        return json.dumps({"deleted": True})
+    if name == "list_directory":
+        return json.dumps(sorted(
+            e.name + ("/" if e.is_dir() else "") for e in p.iterdir()))
+    if name == "create_directory":
+        p.mkdir(parents=True, exist_ok=True)
+        return json.dumps({"created": True})
+    if name == "file_exists":
+        return json.dumps({"exists": p.exists(),
+                           "is_dir": p.is_dir(), "is_file": p.is_file()})
+    if name == "file_info":
+        st = p.stat()
+        return json.dumps({
+            "size": st.st_size,
+            "is_dir": p.is_dir(),
+            "modified": datetime.datetime.fromtimestamp(
+                st.st_mtime, datetime.timezone.utc).isoformat(),
+        })
+    raise ValueError(f"unknown tool {name}")
+
+
+async def handle(req: Request) -> Response:
+    payload = req.json()
+    method = payload.get("method")
+    if method == "initialize":
+        result = {
+            "protocolVersion": "2024-11-05",
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "filesystem-server", "version": "1.0.0"},
+        }
+    elif method == "tools/list":
+        result = {"tools": TOOLS}
+    elif method == "tools/call":
+        params = payload.get("params") or {}
+        try:
+            text = call_tool(params.get("name", ""), params.get("arguments") or {})
+            result = {"content": [{"type": "text", "text": text}], "isError": False}
+        except Exception as e:
+            result = {"content": [{"type": "text", "text": str(e)}], "isError": True}
+    else:
+        return Response.json({"jsonrpc": "2.0", "id": payload.get("id"),
+                              "error": {"code": -32601, "message": f"unknown method {method}"}})
+    return Response.json({"jsonrpc": "2.0", "id": payload.get("id"), "result": result})
+
+
+async def main() -> None:
+    global BASE_DIR
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=3002)
+    p.add_argument("--base-dir", default=str(BASE_DIR))
+    args = p.parse_args()
+    BASE_DIR = Path(args.base_dir)
+    BASE_DIR.mkdir(parents=True, exist_ok=True)
+    router = Router()
+    router.post("/mcp", handle)
+    router.post("/sse", handle)
+    server = HTTPServer(router)
+    port = await server.start(args.host, args.port)
+    print(json.dumps({"msg": "filesystem mcp server listening", "port": port,
+                      "base_dir": str(BASE_DIR)}), flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

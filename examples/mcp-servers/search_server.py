@@ -1,0 +1,99 @@
+"""Sample MCP server: mock web search.
+
+Reference parity: examples/docker-compose/mcp/search-server/main.go — a
+single ``search`` tool returning deterministic mock results (the fixture
+needs no network; the reference's performMockSearch is equally canned,
+main.go:255). Built on the framework's own netio stack; run with
+``python examples/mcp-servers/search_server.py --port 3003``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router
+
+TOOLS = [
+    {
+        "name": "search",
+        "description": "Performs a web search with the given query",
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "query": {"type": "string", "description": "search query"},
+                "limit": {"type": "integer", "description": "max results (default 5)"},
+            },
+            "required": ["query"],
+        },
+    },
+]
+
+
+def mock_search(query: str, limit: int = 5) -> dict:
+    """Deterministic canned results keyed off the query hash."""
+    limit = max(1, min(int(limit or 5), 10))
+    seed = hashlib.sha256(query.encode()).hexdigest()[:8]
+    results = [
+        {
+            "title": f"Result {i + 1} for {query!r}",
+            "url": f"https://example.com/{seed}/{i + 1}",
+            "snippet": f"Mock snippet {i + 1} matching '{query}'.",
+        }
+        for i in range(limit)
+    ]
+    return {"query": query, "total": limit, "results": results}
+
+
+def call_tool(name: str, args: dict) -> str:
+    if name == "search":
+        return json.dumps(mock_search(str(args.get("query", "")), args.get("limit") or 5))
+    raise ValueError(f"unknown tool {name}")
+
+
+async def handle(req: Request) -> Response:
+    payload = req.json()
+    method = payload.get("method")
+    if method == "initialize":
+        result = {
+            "protocolVersion": "2024-11-05",
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "search-server", "version": "1.0.0"},
+        }
+    elif method == "tools/list":
+        result = {"tools": TOOLS}
+    elif method == "tools/call":
+        params = payload.get("params") or {}
+        try:
+            text = call_tool(params.get("name", ""), params.get("arguments") or {})
+            result = {"content": [{"type": "text", "text": text}], "isError": False}
+        except Exception as e:
+            result = {"content": [{"type": "text", "text": str(e)}], "isError": True}
+    else:
+        return Response.json({"jsonrpc": "2.0", "id": payload.get("id"),
+                              "error": {"code": -32601, "message": f"unknown method {method}"}})
+    return Response.json({"jsonrpc": "2.0", "id": payload.get("id"), "result": result})
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=3003)
+    args = p.parse_args()
+    router = Router()
+    router.post("/mcp", handle)
+    router.post("/sse", handle)
+    server = HTTPServer(router)
+    port = await server.start(args.host, args.port)
+    print(json.dumps({"msg": "search mcp server listening", "port": port}), flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
