@@ -456,7 +456,7 @@ def stamp_measured_artifact(result: dict) -> None:
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "note": "live on-chip measurement stamped by bench.py at success time",
     }
-    path = os.path.join(_measured_dir(), "TPU_MEASURED_r04.json")
+    path = os.path.join(_measured_dir(), "TPU_MEASURED_r05.json")
     try:
         with open(path, "w") as f:
             json.dump(out, f, indent=1)

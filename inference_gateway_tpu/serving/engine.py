@@ -253,11 +253,13 @@ class Engine:
                     if not group_ok(group):
                         raise ValueError(
                             f"no int4 group size tiles model dims {cins} under tp={tp}")
-            fp_shapes = jax.eval_shape(
-                partial(self._model.init_params, cfg=self.model_cfg, dtype=self.dtype),
-                jax.random.PRNGKey(config.seed))
-            fp_bytes = sum(s.size * s.dtype.itemsize for s in jax.tree.leaves(fp_shapes))
-            if params is None and not self.is_moe and fp_bytes > 2 << 30:
+            def _fp_bytes() -> int:
+                shapes = jax.eval_shape(
+                    partial(self._model.init_params, cfg=self.model_cfg, dtype=self.dtype),
+                    jax.random.PRNGKey(config.seed))
+                return sum(s.size * s.dtype.itemsize for s in jax.tree.leaves(shapes))
+
+            if params is None and not self.is_moe and _fp_bytes() > 2 << 30:
                 # Random-weight quantized build at scale: init + quantize
                 # one layer at a time so the full-precision tree is never
                 # resident — Llama-3-8B-int4 then fits ONE 16 GiB chip
