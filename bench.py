@@ -391,6 +391,140 @@ def analytic_model() -> dict:
     return out
 
 
+def spec_ngram_bench(model: str = "test-tiny", dtype: str = "float32",
+                     n_prompts: int = 4, max_tokens: int = 48,
+                     max_slots: int = 4, max_seq_len: int = 512) -> dict:
+    """Speculative decoding measured (round-4 verdict next #6): n-gram
+    prompt-lookup spec-on vs spec-off tok/s on the SAME model, plus
+    acceptance stats from the scheduler's round counters. Prompts carry
+    a repeated pattern and greedy decode on a fixed model settles into
+    repetition, which prompt-lookup then accepts — exercising the real
+    accept path with no trained weights."""
+    import jax as _jax
+
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.scheduler import Scheduler, generate_sync
+
+    common = dict(model=model, max_slots=max_slots, max_seq_len=max_seq_len,
+                  dtype=dtype, max_prefill_batch=max_slots, use_mesh=False)
+    pattern = [11, 23, 7, 151, 42, 9]
+    prompts = [(pattern * 8)[: 24 + i] for i in range(n_prompts)]
+    out: dict = {}
+    for label, extra in (("off", {}), ("ngram", {"spec_draft": "ngram", "spec_k": 4})):
+        eng = Engine(EngineConfig(**common, **extra))
+        sched = Scheduler(eng)
+        sched.start()
+        try:
+            # Warm (compile) once, then measure — resetting the spec
+            # counters so acceptance stats cover ONLY the timed runs.
+            generate_sync(sched, prompts[0], max_tokens=4, temperature=0.0)
+            sched.spec_rounds = sched.spec_emitted = sched.spec_slot_rounds = 0
+            t0 = time.perf_counter()
+            toks = 0
+            for pr in prompts:
+                got, _ = generate_sync(sched, pr, max_tokens=max_tokens, temperature=0.0)
+                toks += len(got)
+            wall = time.perf_counter() - t0
+            out[label] = {"tok_s": round(toks / wall, 1), "tokens": toks,
+                          "wall_s": round(wall, 2)}
+            if extra:
+                out["acceptance"] = {
+                    "rounds": sched.spec_rounds,
+                    "emitted": sched.spec_emitted,
+                    "tokens_per_slot_round": round(
+                        sched.spec_emitted / max(sched.spec_slot_rounds, 1), 3),
+                    "mean_accepted_draft_tokens": round(
+                        sched.spec_emitted / max(sched.spec_slot_rounds, 1) - 1.0, 3),
+                }
+        finally:
+            sched.stop()
+        del eng
+    if "off" in out and "ngram" in out:
+        out["speedup"] = round(out["ngram"]["tok_s"] / max(out["off"]["tok_s"], 1e-9), 2)
+    out["platform"] = _jax.devices()[0].platform
+    return out
+
+
+def tokens_per_dollar() -> dict:
+    """Evaluate the BASELINE north-star claim (≥2× tokens/sec/$ vs
+    Ollama-CUDA, Llama-3-8B, high-concurrency serving) — ANALYTIC where
+    hardware is missing, and labeled as such (round-4 verdict next #5).
+
+    Method: decode at scale is HBM-weight-stream-bound on BOTH sides, so
+    each platform's ceiling is batch / ((weight_bytes + kv_stream) / BW).
+    The TPU side uses the committed v5e-1-llama-3-8b-int4 profile
+    (int4 weights) at the public GCP on-demand chip-hour price; GPU
+    baselines use the same int4 (Q4) weight stream at public card specs
+    and on-demand prices. Two GPU postures are scored: the card's own
+    roofline at full continuous batching (what a vLLM-class server could
+    do — PESSIMISTIC for us), and Ollama's actual serving posture
+    (llama.cpp with OLLAMA_NUM_PARALLEL=8; its default is 4). All
+    prices USD/hr, on-demand, us-central1-class, mid-2025 public lists.
+    """
+    from inference_gateway_tpu.serving.profiles import (
+        PROFILES, V5E_HBM_BW, hbm_plan, kv_bytes_per_token, resolve_model_cfg,
+    )
+
+    V5E_USD_HR = 1.20  # public GCP on-demand, per v5e chip-hour
+    GPUS = {
+        # name: (HBM BW bytes/s, USD/hr on-demand incl. host VM)
+        "L4": (300e9, 0.71),
+        "A100-40G": (1555e9, 3.67),
+        "T4": (320e9, 0.55),
+    }
+    p = PROFILES["v5e-1-llama-3-8b-int4"]
+    cfg = resolve_model_cfg(p.model)
+    wbytes = hbm_plan(p)["weights_per_chip"]
+    avg_live = p.max_seq_len // 4
+    kv_tok = kv_bytes_per_token(cfg)
+
+    def tps(bw: float, batch: int) -> float:
+        kv_stream = batch * avg_live * kv_tok
+        return batch / ((wbytes + kv_stream) / bw)
+
+    tpu_roofline = tps(V5E_HBM_BW, p.max_slots)
+    # Only an 8B measurement may stand in for the 8B claim; the TinyLlama
+    # artifacts from earlier rounds measure a different model. The model
+    # is identified by the artifact's metric/profile fields (the
+    # filename never carries it).
+    tpu_measured = None
+    found = newest_measured_artifact()
+    if found:
+        d, _name = found
+        ident = (str(d.get("metric", "")) + " "
+                 + str((d.get("extra") or {}).get("profile", ""))).lower()
+        if "llama-3-8b" in ident:
+            tpu_measured = d.get("value")
+    tpu_tps = tpu_measured or tpu_roofline
+
+    rows = {}
+    for name, (bw, usd) in GPUS.items():
+        rows[name] = {
+            "usd_hr": usd,
+            "roofline_tok_s": round(tps(bw, p.max_slots), 0),
+            "roofline_tok_s_per_usd_hr": round(tps(bw, p.max_slots) / usd, 0),
+            "ollama_np8_tok_s": round(tps(bw, 8), 0),
+            "ollama_np8_tok_s_per_usd_hr": round(tps(bw, 8) / usd, 0),
+        }
+    tpu_per_usd = tpu_tps / V5E_USD_HR
+    best_ollama = max(r["ollama_np8_tok_s_per_usd_hr"] for r in rows.values())
+    best_roofline = max(r["roofline_tok_s_per_usd_hr"] for r in rows.values())
+    return {
+        "model": p.model,
+        "note": ("analytic (HBM-bound decode ceilings at public on-demand prices); "
+                 + ("TPU side uses the LIVE on-chip 8B measurement"
+                    if tpu_measured else
+                    "TPU side is the roofline — no live 8B measurement this round")),
+        "v5e_usd_per_chip_hr": V5E_USD_HR,
+        "tpu_tok_s_per_chip": round(tpu_tps, 0),
+        "tpu_tok_s_per_usd_hr": round(tpu_per_usd, 0),
+        "gpu_baselines": rows,
+        "vs_ollama_num_parallel_8": round(tpu_per_usd / best_ollama, 2),
+        "vs_gpu_ideal_roofline": round(tpu_per_usd / best_roofline, 2),
+        "baseline_claim_2x_vs_ollama": tpu_per_usd / best_ollama >= 2.0,
+    }
+
+
 def relay_numbers() -> dict:
     """Gateway relay throughput from benchmarks/RESULTS.md (measured on
     the build container; regenerate with benchmarks/gateway_bench.py)."""
@@ -480,6 +614,10 @@ def baseline_extras() -> dict:
     extras["relay"] = relay_numbers()
     extras["last_measured_on_chip"] = last_measured_on_chip()
     try:
+        extras["tokens_per_dollar"] = tokens_per_dollar()
+    except Exception as e:
+        extras["tokens_per_dollar_error"] = f"{type(e).__name__}: {e}"
+    try:
         _progress("CPU interpret-mode kernel parity microbench (subprocess)")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         r = subprocess.run(
@@ -498,6 +636,35 @@ def baseline_extras() -> dict:
     except Exception as e:
         extras["kernels_cpu_error"] = f"{type(e).__name__}: {e}"
     return extras
+
+
+def spec_cpu_extra(extras: dict) -> None:
+    """CPU spec-ngram on/off microbench in a subprocess. Runs AFTER the
+    on-chip stages (or in the no-chip fallback), never before device
+    acquisition — it must not eat the chip window's budget."""
+    budget = min(300.0, max(_remaining() - 30.0, 0.0))
+    if budget < 60:
+        extras["spec_cpu_error"] = f"skipped: only {budget:.0f}s left"
+        return
+    try:
+        _progress("CPU spec-ngram on/off microbench (subprocess)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu');"
+             "import json; from bench import spec_ngram_bench; "
+             "print('RESULT=' + json.dumps(spec_ngram_bench()))"],
+            capture_output=True, text=True, timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT="):
+                extras["spec_cpu"] = json.loads(line[len("RESULT="):])
+                break
+        else:
+            extras["spec_cpu_error"] = (r.stderr or r.stdout)[-300:]
+    except Exception as e:
+        extras["spec_cpu_error"] = f"{type(e).__name__}: {e}"
 
 
 # ---------------------------------------------------------------------------
@@ -547,6 +714,7 @@ def main() -> None:
 
     ok, detail = acquire_device()
     if not ok:
+        spec_cpu_extra(_PARTIAL["extra"])
         _fallback(f"device_unresponsive: {detail}")
         return
 
@@ -651,6 +819,17 @@ def main() -> None:
         except Exception as e:
             _PARTIAL["extra"]["secondary_error"] = f"{type(e).__name__}: {e}"
 
+    if _remaining() > 300:
+        try:
+            _progress("on-chip spec-ngram on/off (tinyllama)")
+            _PARTIAL["extra"]["spec_tpu"] = spec_ngram_bench(
+                model="tinyllama-1.1b", dtype="bfloat16", n_prompts=4,
+                max_tokens=64, max_slots=4, max_seq_len=1024)
+            _progress(f"spec: {_PARTIAL['extra']['spec_tpu']}")
+            stamp_measured_artifact(_PARTIAL)
+        except Exception as e:
+            _PARTIAL["extra"]["spec_tpu_error"] = f"{type(e).__name__}: {e}"
+
     if _remaining() > 120:
         try:
             _progress("TPU kernel microbenches")
@@ -659,6 +838,7 @@ def main() -> None:
         except Exception as e:  # microbenches are best-effort garnish
             _progress(f"microbench failed: {type(e).__name__}: {e}")
 
+    spec_cpu_extra(_PARTIAL["extra"])
     _emit(_PARTIAL)
 
 

@@ -131,6 +131,13 @@ class Scheduler:
         # most one _Inflight decode chunk (the pipeline).
         self._handles: deque = deque()
         self.queue_depth = 0  # exported metric
+        # Speculative-decoding acceptance telemetry (exported via the
+        # sidecar /metrics and read by bench.py's spec stage): rounds =
+        # draft+verify passes, emitted = tokens they produced (1..K+1
+        # each), slot_rounds = per-slot round participations.
+        self.spec_rounds = 0
+        self.spec_emitted = 0
+        self.spec_slot_rounds = 0
         # Liveness: wall-clock of the last completed engine step. The
         # sidecar /health endpoint flags "degraded" when requests are
         # active but no step has completed recently (wedged device).
@@ -468,6 +475,8 @@ class Scheduler:
             catchup, catchup_len, catchup_pos, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
         self.last_step_time = time.monotonic()
+        self.spec_rounds += 1
+        self.spec_slot_rounds += len(self._slots)
 
         for slot in list(self._slots):
             st = self._slots[slot]
@@ -479,6 +488,10 @@ class Scheduler:
                 st.pending_token = int(out[slot, j])
                 st.pending_logprob = float(logprobs[slot, j])
                 st.generated += 1
+                # Counted per token actually DELIVERED (a finished
+                # request's trailing accepted tokens are discarded and
+                # must not inflate the acceptance telemetry).
+                self.spec_emitted += 1
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:
                     del self._slots[slot]
@@ -521,6 +534,8 @@ class Scheduler:
             pending, positions, draft, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
         self.last_step_time = time.monotonic()
+        self.spec_rounds += 1
+        self.spec_slot_rounds += len(self._slots)
 
         for slot in list(self._slots):
             st = self._slots[slot]
@@ -530,6 +545,7 @@ class Scheduler:
                 st.pending_token = int(out[slot, j])
                 st.pending_logprob = float(logprobs[slot, j])
                 st.generated += 1
+                self.spec_emitted += 1
                 st.history.append(st.pending_token)
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:

@@ -190,6 +190,14 @@ class SidecarServer:
         m = dict(self.engine.metrics)
         m["queue_depth"] = self.scheduler.queue_depth
         m["active_requests"] = self.scheduler.active_requests()
+        if self.engine.spec:
+            # Mean tokens per draft+verify round per slot = 1 + mean
+            # accepted draft tokens (the speculative speedup upper bound).
+            m["spec_rounds"] = self.scheduler.spec_rounds
+            m["spec_emitted_tokens"] = self.scheduler.spec_emitted
+            if self.scheduler.spec_slot_rounds:
+                m["spec_tokens_per_slot_round"] = round(
+                    self.scheduler.spec_emitted / self.scheduler.spec_slot_rounds, 3)
         m["uptime_seconds"] = round(time.monotonic() - self._started, 3)
         if self.engine.allocator is not None:
             m["kv_pages_total"] = self.engine.allocator.num_pages
