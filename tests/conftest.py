@@ -83,3 +83,48 @@ def pytest_sessionfinish(session, exitstatus):
         _RUNNER.stop()
         _RUNNER = None
 
+
+
+# ---------------------------------------------------------------------------
+# Resource trajectory logging (enable with IG_TPU_RESLOG=/path): appends
+# one line per test with RSS, open fds, threads, and mmap-region count —
+# the instrument that located the full-suite XLA segfault (a process
+# approaching vm.max_map_count crashes inside backend_compile_and_load).
+# ---------------------------------------------------------------------------
+if os.environ.get("IG_TPU_RESLOG"):
+    @pytest.hookimpl(hookwrapper=True)
+    def pytest_runtest_teardown(item):
+        yield
+        try:
+            with open("/proc/self/maps") as f:
+                n_maps = sum(1 for _ in f)
+            with open("/proc/self/status") as f:
+                rss = next((l.split()[1] for l in f if l.startswith("VmRSS")), "?")
+            n_fds = len(os.listdir("/proc/self/fd"))
+            with open(os.environ["IG_TPU_RESLOG"], "a") as out:
+                out.write(f"{item.nodeid}\tmaps={n_maps}\trss_kb={rss}\t"
+                          f"fds={n_fds}\tthreads={threading.active_count()}\n")
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# JIT-executable release between modules. Every compiled XLA:CPU
+# executable holds ~3 anonymous mmap regions (code/rodata/data); the
+# full suite compiles tens of thousands of programs, and with jax's
+# global jit caches pinning all of them the process crosses Linux's
+# vm.max_map_count (65,530) at ~92% of the run — the next compile's
+# mmap fails inside backend_compile_and_load and segfaults the
+# interpreter (the round-3/4 "full-suite segfault"). Dropping the caches
+# after each module caps live executables at one module's worth;
+# modules recompile what they reuse (their fixtures are module-scoped
+# anyway).
+# ---------------------------------------------------------------------------
+import gc
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_jit_executables():
+    yield
+    jax.clear_caches()
+    gc.collect()
