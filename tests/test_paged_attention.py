@@ -31,6 +31,26 @@ def test_kernel_interpret_matches_reference():
     np.testing.assert_allclose(np.asarray(out[:2]), np.asarray(ref[:2]), rtol=1e-5, atol=1e-5)
 
 
+def test_kernel_multi_slot_block_matches_reference():
+    """B=8 takes the SB=8 multi-slot-per-instance path: the DMA pipeline
+    crosses slot boundaries and inactive slots ride as masked pages —
+    every active row must still match the reference exactly."""
+    rng = np.random.default_rng(7)
+    B, Hq, Hkv, D, ps, P, mp = 8, 8, 4, 64, 16, 64, 8
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)).astype(np.float32))
+    pt = jnp.asarray(rng.permutation(P)[: B * mp].reshape(B, mp).astype(np.int32))
+    # Mixed occupancy: full, mid, page-boundary, 1-token, empty...
+    lengths = jnp.asarray([128, 37, 32, 1, 0, 97, 16, 0], dtype=jnp.int32)
+
+    ref = paged_attention_jax(q, k, v, pt, lengths, Hkv)
+    out = paged_attention_tpu(q, k, v, pt, lengths, Hkv, interpret=True)
+    active = [i for i, n in enumerate([128, 37, 32, 1, 0, 97, 16, 0]) if n]
+    np.testing.assert_allclose(np.asarray(out)[active], np.asarray(ref)[active],
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_page_allocator():
     cfg = PagedCacheConfig(page_size=16, max_slots=4, max_seq_len=64)
     alloc = PageAllocator(cfg)
