@@ -1,0 +1,94 @@
+"""Sample MCP server: pizza demo.
+
+Reference parity: examples/docker-compose/mcp/pizza-server (a TS
+streamable-HTTP demo exposing one ``get-top-pizzas`` tool over a canned
+top-5 list, src/index.ts:249-262). Fourth fixture of the sample-server
+set (time, filesystem, search, pizza). Run with
+``python examples/mcp-servers/pizza_server.py --port 3004``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router
+
+PIZZAS = [
+    {"rank": 1, "name": "Margherita", "origin": "Naples, Italy",
+     "toppings": ["tomato", "mozzarella", "basil"],
+     "description": "The classic: simplicity that proves the rule."},
+    {"rank": 2, "name": "Neapolitan", "origin": "Naples, Italy",
+     "toppings": ["tomato", "mozzarella", "oregano", "anchovies"],
+     "description": "Wood-fired with a soft, charred cornicione."},
+    {"rank": 3, "name": "Pepperoni", "origin": "United States",
+     "toppings": ["tomato", "mozzarella", "pepperoni"],
+     "description": "An American classic with cupped, crispy pepperoni."},
+    {"rank": 4, "name": "Quattro Formaggi", "origin": "Italy",
+     "toppings": ["mozzarella", "gorgonzola", "parmesan", "fontina"],
+     "description": "Four cheeses, zero regrets."},
+    {"rank": 5, "name": "Hawaiian", "origin": "Canada",
+     "toppings": ["tomato", "mozzarella", "ham", "pineapple"],
+     "description": "Controversial but beloved; invented in Ontario."},
+]
+
+TOOLS = [
+    {
+        "name": "get-top-pizzas",
+        "description": "Get the top 5 pizzas in the world with details",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+]
+
+
+def call_tool(name: str, args: dict) -> str:
+    if name == "get-top-pizzas":
+        return json.dumps({"pizzas": PIZZAS})
+    raise ValueError(f"unknown tool {name}")
+
+
+async def handle(req: Request) -> Response:
+    payload = req.json()
+    method = payload.get("method")
+    if method == "initialize":
+        result = {
+            "protocolVersion": "2024-11-05",
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "pizza-server", "version": "1.0.0"},
+        }
+    elif method == "tools/list":
+        result = {"tools": TOOLS}
+    elif method == "tools/call":
+        params = payload.get("params") or {}
+        try:
+            text = call_tool(params.get("name", ""), params.get("arguments") or {})
+            result = {"content": [{"type": "text", "text": text}], "isError": False}
+        except Exception as e:
+            result = {"content": [{"type": "text", "text": str(e)}], "isError": True}
+    else:
+        return Response.json({"jsonrpc": "2.0", "id": payload.get("id"),
+                              "error": {"code": -32601, "message": f"unknown method {method}"}})
+    return Response.json({"jsonrpc": "2.0", "id": payload.get("id"), "result": result})
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=3004)
+    args = p.parse_args()
+    router = Router()
+    router.post("/mcp", handle)
+    router.post("/sse", handle)
+    server = HTTPServer(router)
+    port = await server.start(args.host, args.port)
+    print(json.dumps({"msg": "pizza mcp server listening", "port": port}), flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

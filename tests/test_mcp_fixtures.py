@@ -108,6 +108,13 @@ async def test_search_fixture_direct():
     assert out == json.loads(mod.call_tool("search", {"query": "tpu", "limit": 3}))
 
 
+async def test_pizza_fixture_direct():
+    mod = _load_fixture("pizza_server")
+    out = json.loads(mod.call_tool("get-top-pizzas", {}))
+    assert len(out["pizzas"]) == 5
+    assert out["pizzas"][0]["name"] == "Margherita"
+
+
 async def test_config3_agent_loop_against_filesystem_fixture(fs_fixture):
     fs_router = Router()
     fs_router.post("/mcp", fs_fixture.handle)
