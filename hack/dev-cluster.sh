@@ -4,7 +4,7 @@
 # falls back to the JAX CPU backend with the same serving stack.
 set -e
 
-CLUSTER=${CLUSTER:-inference-gateway-dev}
+CLUSTER=${CLUSTER:-inference-gateway-tpu-dev}
 
 case "${1:-up}" in
   up)

@@ -177,6 +177,10 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         idle_timeout=cfg.server.idle_timeout,
         logger=logger,
     )
+    # Self-addressed (relative-URL) requests — the provider layer's
+    # /proxy/ double hop — dispatch in-process through this server's
+    # router + middleware chain instead of a loopback TCP round trip.
+    client.inprocess_server = api_server
 
     return Gateway(
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
