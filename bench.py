@@ -620,8 +620,14 @@ def baseline_extras() -> dict:
     try:
         _progress("CPU interpret-mode kernel parity microbench (subprocess)")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # jax.config.update is REQUIRED: the container's sitecustomize
+        # imports jax before env vars apply, so JAX_PLATFORMS=cpu alone
+        # leaves the subprocess probing the (possibly wedged) TPU tunnel
+        # — the exact 240 s TimeoutExpired rounds 3-4 recorded here
+        # (measured runtime once actually on CPU: ~6 s).
         r = subprocess.run(
             [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu'); "
              "import json; from bench import kernel_microbench; "
              "print('RESULT=' + json.dumps(kernel_microbench(interpret=True)))"],
             capture_output=True, text=True, timeout=240,

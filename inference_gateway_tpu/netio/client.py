@@ -15,9 +15,11 @@ import asyncio
 import ssl
 from dataclasses import dataclass
 from typing import AsyncIterator
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from inference_gateway_tpu.netio.server import Headers
+from inference_gateway_tpu.netio.server import Request as ServerRequest
+from inference_gateway_tpu.netio.server import StreamingResponse
 
 DEFAULT_TIMEOUT = 30.0
 
@@ -244,11 +246,6 @@ class HTTPClient:
                                  stream: bool) -> ClientResponse:
         """Dispatch a self-addressed request straight through the wired
         server's router + middleware chain — no socket, no HTTP framing."""
-        from urllib.parse import parse_qs, unquote
-
-        from inference_gateway_tpu.netio.server import Request as ServerRequest
-        from inference_gateway_tpu.netio.server import StreamingResponse
-
         hdrs = self._normalize_headers(headers, self.self_host, self.self_port)
         req = ServerRequest(
             method=method.upper(),
@@ -388,6 +385,12 @@ class HTTPClient:
         except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
             writer.close()
             raise HTTPClientError(f"{type(e).__name__} reading from {host}:{port}") from e
+        except BaseException:
+            # Cancellation safety: an in-process caller timing out
+            # cancels this coroutine mid-read (wait_for semantics); the
+            # half-read connection must be closed, never pooled/leaked.
+            writer.close()
+            raise
 
         await self._release(scheme, host, port, reader, writer, reusable=keep)
         return resp
