@@ -1,6 +1,6 @@
 # Developer entry points (reference parity: Taskfile.yml).
 
-.PHONY: generate check test bench bench-gateway serve gateway lint
+.PHONY: generate check test test-fast bench bench-gateway serve gateway lint
 
 generate:  ## regenerate docs/env examples from openapi.yaml + drift check
 	python -m inference_gateway_tpu.codegen
@@ -10,6 +10,23 @@ check:     ## spec<->code drift guards only
 
 test:      ## full suite on a virtual 8-device CPU mesh
 	python -m pytest tests/ -q
+
+# Exclusion list, not inclusion: a NEW test file runs in the fast tier
+# by default (coverage can't silently drop); add it here only if it
+# builds engines/models.
+SLOW_TESTS := test_checkpoint test_chunked_prefill test_distributed \
+  test_engine test_flash_attention test_gemma test_graft_entry \
+  test_llama_numerics test_metrics_push_loop test_mistral test_mixtral \
+  test_moe_paged_quant test_moe_serving test_multihost test_multimodal \
+  test_paged_attention test_paged_dispatch test_paged_sharded \
+  test_pipeline test_pipelined_decode test_pp_serving test_prefix_cache \
+  test_profiles test_quant test_qwen2 test_race_discipline \
+  test_ring_attention test_ring_serving test_sampling_features \
+  test_scheduler_resilience test_sharding test_sidecar_server \
+  test_spec_ngram test_speculative test_vision
+
+test-fast: ## gateway/protocol tier only (~2 min) — no engine builds
+	python -m pytest tests/ -q $(foreach t,$(SLOW_TESTS),--ignore=tests/$(t).py)
 
 bench:     ## TPU serving decode throughput (driver-tracked JSON line)
 	python bench.py

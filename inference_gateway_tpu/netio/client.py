@@ -337,6 +337,12 @@ class HTTPClient:
                 if pooled and attempt == 0 and not isinstance(e, asyncio.TimeoutError):
                     continue
                 raise HTTPClientError(f"{type(e).__name__} talking to {host}:{port}") from e
+            except BaseException:
+                # Cancellation safety (same as the body-read phase): a
+                # caller's wait_for cancelling us mid-send must not leak
+                # the half-written connection.
+                writer.close()
+                raise
 
         lines = status_blob.decode("latin-1").split("\r\n")
         try:
