@@ -15,7 +15,7 @@ test:      ## full suite on a virtual 8-device CPU mesh
 # by default (coverage can't silently drop); add it here only if it
 # builds engines/models.
 SLOW_TESTS := test_checkpoint test_chunked_prefill test_distributed \
-  test_engine test_flash_attention test_gemma test_graft_entry \
+  test_engine test_flash_attention test_gateway_e2e test_gemma test_graft_entry \
   test_llama_numerics test_metrics_push_loop test_mistral test_mixtral \
   test_moe_paged_quant test_moe_serving test_multihost test_multimodal \
   test_paged_attention test_paged_dispatch test_paged_sharded \

@@ -350,10 +350,6 @@ class Engine:
         self.draft_cache = None
         if self.spec and not self.spec_ngram:
             assert not self.is_moe, "speculative decoding: MoE targets not supported yet"
-            assert self.mesh is None, (
-                "model-draft speculative decoding is single-device for now "
-                "(draft params are unsharded); run with use_mesh=False or "
-                "spec_draft='ngram'")
             if config.spec_draft in llama.PRESETS:
                 self.draft_cfg = llama.PRESETS[config.spec_draft]
                 self.draft_params = llama.init_params(
@@ -364,6 +360,17 @@ class Engine:
                 "draft and target must share a vocabulary")
             self.draft_cache = llama.init_cache(
                 self.draft_cfg, config.max_slots, config.max_seq_len, dtype=self.dtype)
+            if self.mesh is not None:
+                # The draft is tiny relative to the target (that's the
+                # point of drafting), so under a mesh it runs REPLICATED:
+                # every device computes the same draft forward with zero
+                # collectives, and the verify forward keeps the target's
+                # tp sharding — one mixed GSPMD program per round.
+                from jax.sharding import PartitionSpec as P
+
+                rep = named(self.mesh, P())
+                self.draft_params = jax.device_put(self.draft_params, rep)
+                self.draft_cache = jax.device_put(self.draft_cache, rep)
 
         # Optional vision tower for the ENABLE_VISION multimodal path.
         self.vision_cfg = None

@@ -112,6 +112,36 @@ def test_greedy_spec_equals_greedy_decode(attention):
     assert got == refs, f"{attention}: spec diverged from greedy reference"
 
 
+def test_model_draft_spec_under_tp_mesh_matches_single_device():
+    """Model-draft speculation under a tp mesh (draft replicated, target
+    sharded — one mixed GSPMD program per round) must reproduce the
+    single-device spec engine's stream exactly (round-4 verdict next #6:
+    'shard or replicate the model-draft under tp')."""
+    import dataclasses
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    prompts = [[1, 2, 3], [9, 8, 7, 6]]
+    single = Engine(_mk_cfg("dense", spec_draft="test-tiny", spec_k=3))
+    s1 = Scheduler(single)
+    s1.start()
+    try:
+        refs = [generate_sync(s1, p, max_tokens=12) for p in prompts]
+    finally:
+        s1.stop()
+
+    cfg = dataclasses.replace(_mk_cfg("dense", spec_draft="test-tiny", spec_k=3),
+                              use_mesh=True, mesh_shape={"tp": 2})
+    meshed = Engine(cfg)
+    s2 = Scheduler(meshed)
+    s2.start()
+    try:
+        got = [generate_sync(s2, p, max_tokens=12) for p in prompts]
+    finally:
+        s2.stop()
+    assert got == refs
+
+
 def test_self_draft_accepts_everything():
     """With the draft == the target, greedy rounds accept all K drafts +
     bonus: counts == K+1 every round."""
