@@ -60,17 +60,17 @@ import dataclasses
 cfg = dataclasses.replace(llama.PRESETS["test-tiny"], num_kv_heads=4)
 mesh = global_mesh(dp=1, sp=1, tp=4)
 
-params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+host_params = llama.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
 cache = llama.init_cache(cfg, 1, 32, dtype=jnp.float32)
 
-def put(tree, spec_tree):
+def put(tree, spec_tree, m):
     def one(x, s):
-        sh = NamedSharding(mesh, s)
+        sh = NamedSharding(m, s)
         return jax.make_array_from_callback(x.shape, sh, lambda idx: np.asarray(x)[idx])
     return jax.tree.map(one, tree, spec_tree, is_leaf=lambda n: isinstance(n, P))
 
-params = put(params, llama_param_specs(cfg))
-cache = put(cache, {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)})
+params = put(host_params, llama_param_specs(cfg), mesh)
+cache = put(cache, {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}, mesh)
 
 prompt = [1, 2, 3, 4, 5]
 T = len(prompt)
@@ -91,8 +91,26 @@ with jax.sharding.set_mesh(mesh):
     tok2 = int(jax.jit(lambda l: jnp.argmax(l.reshape(-1)))(step_logits))
     checksum = float(jax.jit(lambda l: jnp.abs(l).sum())(step_logits))
 
+# Phase 2 — ring attention with the sp axis SPANNING the process
+# boundary: mesh (dp=1, sp=2, tp=2) lays sp outermost over the 4 global
+# devices, so each sp block lives on a different process and the ring's
+# lax.ppermute rotation of KV blocks is genuine cross-host (DCN)
+# traffic — the long-context analog of phase 1's tp collectives.
+mesh2 = global_mesh(dp=1, sp=2, tp=2)
+params2 = put(host_params, llama_param_specs(cfg), mesh2)
+T2 = int(os.environ["RING_T2"])
+tokens2 = jnp.asarray([list(range(1, T2 + 1))], jnp.int32)
+positions2 = jnp.arange(T2, dtype=jnp.int32)[None, :]
+lengths2 = jnp.asarray([T2], jnp.int32)
+with jax.sharding.set_mesh(mesh2):
+    ring_logits, _ = llama.forward(params2, cfg, tokens2, positions2, lengths2,
+                                   mode="prefill", ring_mesh=mesh2)
+    ring_tok = int(jax.jit(lambda l: jnp.argmax(l[:, -1]))(ring_logits))
+    ring_checksum = float(jax.jit(lambda l: jnp.abs(l).sum())(ring_logits))
+
 out = {"pid": info["process_index"], "tok1": tok1, "tok2": tok2,
-       "checksum": checksum}
+       "checksum": checksum, "ring_tok": ring_tok,
+       "ring_checksum": ring_checksum}
 with open(os.environ["OUT_PATH"] + f".{info['process_index']}", "w") as f:
     json.dump(out, f)
 print("WORKER_OK", out, flush=True)
@@ -107,6 +125,9 @@ def _free_port() -> int:
     return port
 
 
+RING_T2 = 32
+
+
 def test_two_process_sharded_prefill_decode(tmp_path):
     port = _free_port()
     out_path = str(tmp_path / "result.json")
@@ -117,7 +138,7 @@ def test_two_process_sharded_prefill_decode(tmp_path):
                    COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
                    NUM_PROCESSES="2", PROCESS_ID=str(pid),
                    REPO_ROOT=repo, OUT_PATH=out_path,
-                   JAX_PLATFORMS="cpu",
+                   JAX_PLATFORMS="cpu", RING_T2=str(RING_T2),
                    XLA_FLAGS="--xla_force_host_platform_device_count=2")
         env.pop("PYTEST_CURRENT_TEST", None)
         procs.append(subprocess.Popen(
@@ -144,6 +165,9 @@ def test_two_process_sharded_prefill_decode(tmp_path):
     assert results[0]["tok1"] == results[1]["tok1"]
     assert results[0]["tok2"] == results[1]["tok2"]
     np.testing.assert_allclose(results[0]["checksum"], results[1]["checksum"], rtol=1e-5)
+    assert results[0]["ring_tok"] == results[1]["ring_tok"]
+    np.testing.assert_allclose(results[0]["ring_checksum"], results[1]["ring_checksum"],
+                               rtol=1e-5)
 
     # And it matches the single-process unsharded reference.
     import dataclasses
@@ -169,3 +193,12 @@ def test_two_process_sharded_prefill_decode(tmp_path):
     ref2 = int(np.asarray(step_logits)[0, 0].argmax())
     assert results[0]["tok1"] == ref1
     assert results[0]["tok2"] == ref2
+
+    # Ring phase: the cross-process sp ring must reproduce the dense
+    # single-process prefill's next token.
+    T2 = RING_T2
+    ring_ref, _ = llama.forward(
+        params, cfg, jnp.asarray([list(range(1, T2 + 1))], jnp.int32),
+        jnp.arange(T2, dtype=jnp.int32)[None, :], jnp.asarray([T2]), None,
+        mode="prefill")
+    assert results[0]["ring_tok"] == int(np.asarray(ring_ref)[0, -1].argmax())
