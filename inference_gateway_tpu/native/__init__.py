@@ -1,0 +1,68 @@
+"""Native runtime components (C), self-building with graceful fallback.
+
+The compute path compiles through XLA/Mosaic; the host runtime's hot
+loops compile here. First import compiles ``framing.c`` with the
+in-image toolchain into the package directory (~1 s, once); when no
+compiler or a read-only checkout is available — or ``IG_TPU_NATIVE=0``
+— ``framing`` is None and callers use their pure-Python twins
+(netio/client.py keeps byte-identical behavior either way; the parity
+suite in tests/test_native_framing.py pins it).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import pathlib
+import shutil
+import subprocess
+import sysconfig
+
+_DIR = pathlib.Path(__file__).resolve().parent
+
+
+def _compile() -> pathlib.Path | None:
+    out = _DIR / "_framing.so"
+    if out.exists() and out.stat().st_mtime >= (_DIR / "framing.c").stat().st_mtime:
+        return out
+    cc = os.environ.get("CC") or shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None:
+        return None
+    include = sysconfig.get_paths()["include"]
+    # Compile to a per-process temp name, then atomically rename:
+    # concurrent workers on a fresh checkout must never dlopen a
+    # half-written .so (os.replace is atomic on the same filesystem;
+    # the losers just overwrite with identical bytes).
+    tmp = _DIR / f"_framing.{os.getpid()}.tmp.so"
+    try:
+        subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", f"-I{include}",
+             str(_DIR / "framing.c"), "-o", str(tmp)],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+    except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
+        return None
+    return out
+
+
+def _load():
+    if os.environ.get("IG_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        so = _compile()
+    except OSError:
+        return None
+    if so is None:
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "inference_gateway_tpu.native._framing", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+framing = _load()

@@ -24,6 +24,52 @@ from inference_gateway_tpu.netio.server import StreamingResponse
 DEFAULT_TIMEOUT = 30.0
 
 
+def _parse_chunked_py(buf: bytes, maxp: int) -> tuple[bytes, int, int]:
+    """Parse complete HTTP chunks out of ``buf`` (≈``maxp`` coalesced
+    payload bytes max). Returns (payload, consumed, done) — done=1 when
+    the terminal 0-chunk's size line was consumed (its trailing CRLF is
+    the caller's). Pure-Python twin of native/framing.c's parse_chunked;
+    tests/test_native_framing.py pins the two byte-identical."""
+    payloads = []
+    total = 0
+    pos = 0
+    consumed = 0
+    while total < maxp:
+        i = buf.find(b"\r\n", pos)
+        if i < 0:
+            break
+        field = buf[pos:i].split(b";")[0].strip()
+        # STRICT unsigned hex only — int(x, 16) also accepts '-5', '0x',
+        # '_' and exotic whitespace, which desyncs the buffer (a negative
+        # size walks `need` backwards) and diverges from the C parser.
+        if field and not all(c in b"0123456789abcdefABCDEF" for c in field):
+            raise ValueError(f"invalid chunk size {field!r}")
+        size = int(field or b"0", 16)
+        if size == 0:
+            return b"".join(payloads), i + 2, 1
+        need = i + 2 + size + 2
+        if len(buf) < need:
+            break
+        payloads.append(buf[i + 2:need - 2])
+        total += size
+        pos = need
+        consumed = need
+    return b"".join(payloads), consumed, 0
+
+
+def _load_native_parse():
+    try:
+        from inference_gateway_tpu.native import framing
+    except Exception:  # never let the native path break imports
+        return None
+    return framing.parse_chunked if framing is not None else None
+
+
+# The relay's hot loop: C when the in-image toolchain built
+# native/framing.c, the twin above otherwise.
+_parse_chunked = _load_native_parse() or _parse_chunked_py
+
+
 class HTTPClientError(Exception):
     pass
 
@@ -89,28 +135,15 @@ class ClientResponse:
                 buf = b""
                 done = False
                 while not done:
-                    payloads: list[bytes] = []
-                    plen = 0
-                    while plen < 65536:
-                        i = buf.find(b"\r\n")
-                        if i < 0:
-                            break
-                        size = int(buf[:i].split(b";")[0].strip() or b"0", 16)
-                        if size == 0:
-                            done = True
-                            buf = buf[i + 2:]
-                            break
-                        need = i + 2 + size + 2
-                        if len(buf) < need:
-                            break
-                        payloads.append(buf[i + 2:need - 2])
-                        buf = buf[need:]
-                        plen += size
-                    if payloads:
+                    payload, consumed, done_flag = _parse_chunked(buf, 65536)
+                    if consumed:
+                        buf = buf[consumed:]
+                    done = bool(done_flag)
+                    if payload:
                         # Deliver parsed payloads BEFORE any further read
                         # can block (a trailing read must never hold
                         # completed frames hostage).
-                        yield payloads[0] if len(payloads) == 1 else b"".join(payloads)
+                        yield payload
                         n += 1
                         if n % 16 == 0:
                             await asyncio.sleep(0)  # cooperative fairness
