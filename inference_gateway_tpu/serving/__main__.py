@@ -32,6 +32,9 @@ def main() -> None:
                         "path sharing the target's vocab (serving/speculative.py)")
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens proposed per speculative round")
+    p.add_argument("--spec-adaptive", action="store_true",
+                   help="n-gram spec only: fall back to the pipelined decode "
+                        "loop when acceptance is low, re-probing periodically")
     p.add_argument("--no-mesh", action="store_true", help="disable multi-device sharding")
     p.add_argument("--metrics-push-url", default=None,
                    help="gateway OTLP push endpoint (e.g. http://gateway:8080/v1/metrics)")
@@ -66,6 +69,7 @@ def main() -> None:
         vision_model=args.vision_model,
         spec_draft=args.spec_draft,
         spec_k=args.spec_k,
+        spec_adaptive=args.spec_adaptive,
     )
     asyncio.run(serve(cfg, host=args.host, port=args.port, served_model_name=args.served_model_name,
                       metrics_push_url=args.metrics_push_url))

@@ -93,6 +93,25 @@ class EngineConfig:
     # (serving/speculative.py). None = disabled.
     spec_draft: str | None = None
     spec_k: int = 4
+    # Acceptance-adaptive n-gram speculation (opt-in): when the rolling
+    # tokens-per-slot-round falls below spec_min_tokens_per_round the
+    # scheduler falls back to the pipelined non-spec decode loop (a
+    # verify forward that mostly rejects costs ~a decode step and emits
+    # ~1 token — pure overhead), then re-probes speculation every
+    # spec_probe_every engine steps for spec_probe_rounds rounds.
+    # GREEDY streams are token-identical across every switch (rejection
+    # sampling accepts exactly the target argmax; tests pin parity).
+    # Seeded temperature>0 streams stay within the request's sampling
+    # DISTRIBUTION but the sample path depends on which mode served
+    # each position (the two paths derive their seeded randomness
+    # differently), so per-seed byte-reproducibility holds only while
+    # the mode doesn't switch mid-stream — the tradeoff this flag opts
+    # into. Model-draft spec ignores these knobs (its draft cache
+    # cannot rejoin after falling arbitrarily behind).
+    spec_adaptive: bool = False
+    spec_min_tokens_per_round: float = 1.3
+    spec_probe_rounds: int = 8
+    spec_probe_every: int = 128
 
 
 @dataclass

@@ -138,6 +138,13 @@ class Scheduler:
         self.spec_rounds = 0
         self.spec_emitted = 0
         self.spec_slot_rounds = 0
+        # Acceptance-adaptive n-gram speculation (EngineConfig
+        # spec_adaptive): rolling window + probe state machine.
+        self._spec_on = True
+        self._probe_rounds_left = 0
+        self._normal_steps = 0
+        self._win_emitted = 0
+        self._win_slot_rounds = 0
         # Liveness: wall-clock of the last completed engine step. The
         # sidecar /health endpoint flags "degraded" when requests are
         # active but no step has completed recently (wedged device).
@@ -170,6 +177,57 @@ class Scheduler:
         if self._thread:
             self._thread.join(timeout=10)
 
+    # -- adaptive speculation (EngineConfig.spec_adaptive) -------------
+    def _spec_mode_active(self) -> bool:
+        """True when the CURRENT pass serves via speculative rounds."""
+        cfg = self.engine.config
+        if not self.engine.spec_ngram or not cfg.spec_adaptive:
+            return True
+        return self._spec_on
+
+    def _spec_turn(self) -> bool:
+        """Whether this loop pass runs a speculative round. Always True
+        for model-draft spec and non-adaptive n-gram; adaptive n-gram
+        disables itself on low acceptance (the normal pipelined loop
+        takes over) and re-probes every spec_probe_every normal steps."""
+        cfg = self.engine.config
+        if self._spec_mode_active():
+            return True
+        # _normal_steps advances by chunk length in _process_chunk (real
+        # engine steps, not loop passes).
+        if not self._slots or self._normal_steps < cfg.spec_probe_every:
+            return False
+        # Probe due: make host state authoritative (drain the chunk
+        # pipeline) and invalidate the device carry — the spec rounds
+        # advance positions the carried chain doesn't know about.
+        self._drain_all()
+        self.engine._dev_carry = None
+        self._spec_on = True
+        self._probe_rounds_left = cfg.spec_probe_rounds
+        self._win_emitted = self._win_slot_rounds = 0
+        return True
+
+    def _spec_adapt(self, emitted: int, slot_rounds: int) -> None:
+        cfg = self.engine.config
+        if not self.engine.spec_ngram or not cfg.spec_adaptive:
+            return
+        self._win_emitted += emitted
+        self._win_slot_rounds += slot_rounds
+        if self._probe_rounds_left > 0:
+            self._probe_rounds_left -= 1
+            if self._probe_rounds_left > 0:
+                return  # let the probe window fill before judging
+        if self._win_slot_rounds < cfg.spec_probe_rounds:
+            return
+        rate = self._win_emitted / self._win_slot_rounds
+        if rate < cfg.spec_min_tokens_per_round:
+            self._spec_on = False
+            self._normal_steps = 0
+            self.logger.info("adaptive speculation off",
+                             "tokens_per_slot_round", round(rate, 3))
+        # Sliding epochs: judge each window on fresh data.
+        self._win_emitted = self._win_slot_rounds = 0
+
     # -- core loop -----------------------------------------------------
     def run(self) -> None:
         """Pipelined serving loop: at most one decode chunk in flight,
@@ -196,7 +254,7 @@ class Scheduler:
                 if self._stop:
                     break
                 want_admit = bool(self._waiting and self._free)
-            if self.engine.spec:
+            if self.engine.spec and self._spec_turn():
                 # Speculative rounds are synchronous (draft + verify per
                 # round, 1..K+1 tokens out); no chunk pipeline.
                 if want_admit:
@@ -205,6 +263,7 @@ class Scheduler:
                     except Exception as e:
                         self.logger.error("scheduler admission error", e)
                 if self._slots:
+                    before = (self.spec_emitted, self.spec_slot_rounds)
                     try:
                         if self.engine.spec_ngram:
                             self._spec_step_ngram()
@@ -212,6 +271,9 @@ class Scheduler:
                             self._spec_step()
                     except Exception as e:
                         self._fail_after_decode_error(e)
+                        continue
+                    self._spec_adapt(self.spec_emitted - before[0],
+                                     self.spec_slot_rounds - before[1])
                 continue
             if want_admit:
                 # A single bad request (prompt over the largest bucket in
@@ -351,10 +413,12 @@ class Scheduler:
             self._slots[slot] = _SlotState(
                 req, pos=len(req.prompt_ids), pending_token=_TOKEN_PENDING,
                 pending_logprob=0.0, draft_len=len(req.prompt_ids))
-        if self.engine.spec:
+        if self.engine.spec and self._spec_mode_active():
             # Spec rounds need first tokens host-side immediately.
             self._process_prefill(_PendingPrefill(handle, list(zip(batch, slots))))
         else:
+            # Non-spec — or adaptive speculation parked in the normal
+            # loop, which keeps its async-admission overlap.
             self._handles.append(_PendingPrefill(handle, list(zip(batch, slots))))
 
     def _process_prefill(self, p: "_PendingPrefill") -> None:
@@ -563,6 +627,7 @@ class Scheduler:
         IDENTITY check — its rows in this chunk belong to the previous
         occupant's (already finished) stream.
         """
+        self._normal_steps += inf.n_steps  # engine steps, for the spec probe cadence
         try:
             toks, logprobs = self.engine.decode_chunk_fetch(inf.handle)
         except Exception as e:
@@ -584,6 +649,11 @@ class Scheduler:
                 st.pending_token = int(toks[j, slot])
                 st.pending_logprob = float(logprobs[j, slot])
                 st.generated += 1
+                if self.engine.spec_ngram:
+                    # Keep prompt-lookup history fresh while adaptive
+                    # speculation is parked in the normal loop, so a
+                    # probe's proposals see the full stream.
+                    st.history.append(st.pending_token)
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:
                     del self._slots[slot]
