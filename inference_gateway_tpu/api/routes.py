@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import gzip
 import json
+from dataclasses import dataclass
 from typing import Any
 
 from inference_gateway_tpu.api.context_window import resolve_context_windows
@@ -35,6 +36,11 @@ from inference_gateway_tpu.providers.registry import (
     ProviderRegistry,
 )
 from inference_gateway_tpu.providers.types import has_image_content, strip_image_content
+from inference_gateway_tpu.resilience import (
+    BudgetExceededError,
+    Resilience,
+    UpstreamUnavailableError,
+)
 
 MAX_BODY_SIZE = 10 << 20  # routes.go:137
 MAX_METRICS_BODY = 4 << 20  # api/metrics.go:15
@@ -52,6 +58,30 @@ def messages_error(status: int, err_type: str, message: str) -> Response:
     )
 
 
+def _failure_category(e: Exception) -> str:
+    """Client-safe summary of why a provider call failed — internal
+    detail (hosts, ports, exception classes) stays in the server log."""
+    if isinstance(e, UpstreamUnavailableError):
+        return "unavailable"
+    if isinstance(e, (BudgetExceededError, asyncio.TimeoutError)):
+        return "timeout"
+    if isinstance(e, HTTPError):
+        return f"upstream_error_{e.status_code}"
+    if isinstance(e, HTTPClientError):
+        return "unreachable"
+    return "error"
+
+
+@dataclass
+class _Candidate:
+    """One failover target: a built provider plus its (provider, model)
+    breaker key — Deployment-shaped for Resilience.execute."""
+
+    provider_obj: Any
+    provider: str
+    model: str
+
+
 class RouterImpl:
     """All gateway endpoints (routes.go:52-67 constructor wiring)."""
 
@@ -65,6 +95,7 @@ class RouterImpl:
         mcp_client=None,
         mcp_agent=None,
         selector: routing.Selector | None = None,
+        resilience: Resilience | None = None,
     ) -> None:
         self.cfg = cfg
         self.registry = registry
@@ -74,6 +105,9 @@ class RouterImpl:
         self.mcp_client = mcp_client
         self.mcp_agent = mcp_agent
         self.selector = selector
+        self.resilience = resilience or Resilience(
+            getattr(cfg, "resilience", None), otel=otel, logger=self.logger
+        )
 
     # -- wiring --------------------------------------------------------
     def build_router(self) -> Router:
@@ -126,6 +160,20 @@ class RouterImpl:
                     include_keys.append(key)
 
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
+
+        # list-models is idempotent — retried with jittered backoff inside
+        # the read-timeout budget (ISSUE 1 tentpole (c)).
+        async def list_with_retry(provider, pid: str) -> dict[str, Any]:
+            async def call(cand: _Candidate, b) -> Any:
+                return await cand.provider_obj.list_models(ctx, timeout=b.timeout())
+
+            result, _ = await self.resilience.execute(
+                [_Candidate(provider, pid, "")], call,
+                budget=self.resilience.new_budget(self.cfg.server.read_timeout),
+                idempotent=True,
+            )
+            return result
+
         provider_id = req.query_get("provider")
         if provider_id:
             try:
@@ -133,10 +181,10 @@ class RouterImpl:
             except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
                 return self._provider_error(e, provider_id)
             try:
-                response = await asyncio.wait_for(
-                    provider.list_models(ctx), timeout=self.cfg.server.read_timeout
-                )
-            except asyncio.TimeoutError:
+                response = await list_with_retry(provider, provider_id)
+            except UpstreamUnavailableError:
+                return error_json("Provider temporarily unavailable", 503)
+            except (BudgetExceededError, asyncio.TimeoutError):
                 return error_json("Request timed out", 504)
             except (HTTPError, HTTPClientError) as e:
                 self.logger.error("failed to list models", e, "provider", provider_id)
@@ -147,25 +195,36 @@ class RouterImpl:
             response["data"] = models
         else:
             # Parallel fan-out across all configured providers
-            # (routes.go:480-517); per-provider failures are skipped.
-            async def fetch(pid: str) -> list[dict[str, Any]]:
+            # (routes.go:480-517). Unconfigured providers are skipped
+            # silently; CALL failures are logged with the provider id and
+            # surfaced in a ``failed_providers`` annotation instead of
+            # being dropped without a trace.
+            async def fetch(pid: str) -> tuple[str, list[dict[str, Any]], str | None]:
                 try:
                     provider = self._build_provider(pid)
-                    result = await provider.list_models(ctx)
-                    return result["data"]
+                except (ProviderNotFoundError, ProviderNotConfiguredError):
+                    return pid, [], None
+                try:
+                    result = await list_with_retry(provider, pid)
+                    return pid, result["data"], None
                 except Exception as e:
+                    # Full detail goes to the log; clients get a sanitized
+                    # category (no internal hosts/ports/class names).
                     self.logger.error("failed to list models", e, "provider", pid)
-                    return []
+                    return pid, [], _failure_category(e)
 
+            # No outer wait_for: each fetch is individually bounded by its
+            # read-timeout budget (connect/read timeouts derive from it),
+            # so a hanging provider becomes a failed_providers entry
+            # instead of erroring the whole fan-out.
             provider_ids = list(self.registry.get_providers())
-            results = await asyncio.wait_for(
-                asyncio.gather(*(fetch(pid) for pid in provider_ids)),
-                timeout=self.cfg.server.read_timeout,
-            )
-            models = [m for sub in results for m in sub]
+            results = await asyncio.gather(*(fetch(pid) for pid in provider_ids))
+            models = [m for _, sub, _ in results for m in sub]
             models = routing.filter_models(models, self.cfg.allowed_models, self.cfg.disallowed_models)
             response = {"object": "list", "data": models}
-            response.pop("provider", None)
+            failed = [{"provider": pid, "error": err} for pid, _, err in results if err]
+            if failed:
+                response["failed_providers"] = failed
 
         if "context_window" in include_keys:
             await resolve_context_windows(
@@ -210,43 +269,64 @@ class RouterImpl:
         route = self._resolve_route(req, original_model)
         if isinstance(route, Response):
             return route
-        provider, provider_id, model, routed = route
-
-        body = dict(body)
-        body["model"] = model
-        body["messages"] = self._vision_gate(
-            provider, provider_id, model, body.get("messages") or [])
+        candidates, alias = route
 
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
-        headers_extra = {}
-        if routed is not None:
-            headers_extra = {"X-Selected-Provider": routed.provider, "X-Selected-Model": routed.model}
+        budget = self.resilience.new_budget()
+
+        def request_for(cand: _Candidate) -> dict[str, Any]:
+            out = dict(body)
+            out["model"] = cand.model
+            out["messages"] = self._vision_gate(
+                cand.provider_obj, cand.provider, cand.model, body.get("messages") or [])
+            return out
 
         if body.get("stream"):
+            # Streaming is NOT idempotent once bytes flow: fail over only
+            # before the first byte (stream establishment), never retry.
+            async def call(cand: _Candidate, b) -> Any:
+                return await cand.provider_obj.stream_chat_completions(
+                    request_for(cand), ctx, timeout=b.timeout())
+
             try:
-                stream = await provider.stream_chat_completions(body, ctx)
+                stream, served = await self.resilience.execute(
+                    candidates, call, budget=budget, idempotent=False, alias=alias)
+            except UpstreamUnavailableError as e:
+                return error_json(str(e), 503)
+            except BudgetExceededError:
+                return error_json("Request timed out", 504)
             except HTTPError as e:
                 return error_json(e.message, e.status_code)
             except HTTPClientError as e:
                 return error_json(str(e), 502)
-            resp = StreamingResponse.sse(stream)
-            for k, v in headers_extra.items():
-                resp.headers.set(k, v)
+            resp = StreamingResponse.sse(self.resilience.guard_stream(stream))
+            if alias:
+                resp.headers.set("X-Selected-Provider", served.provider)
+                resp.headers.set("X-Selected-Model", served.model)
             return resp
 
+        # Non-streamed completions buffer the whole upstream response, so
+        # a failed attempt delivered nothing — safe to retry before the
+        # first byte reaches the client (idempotent from its viewpoint).
+        async def call(cand: _Candidate, b) -> Any:
+            return await cand.provider_obj.chat_completions(
+                request_for(cand), ctx, timeout=b.timeout())
+
         try:
-            result = await asyncio.wait_for(
-                provider.chat_completions(body, ctx), timeout=self.cfg.server.read_timeout
-            )
-        except asyncio.TimeoutError:
+            result, served = await self.resilience.execute(
+                candidates, call, budget=budget, idempotent=True, alias=alias)
+        except UpstreamUnavailableError as e:
+            return error_json(str(e), 503)
+        except (BudgetExceededError, asyncio.TimeoutError):
             return error_json("Request timed out", 504)
         except HTTPError as e:
             return error_json(e.message, e.status_code)
         except HTTPClientError as e:
             return error_json(str(e), 502)
         resp = Response.json(result)
-        for k, v in headers_extra.items():
-            resp.headers.set(k, v)
+        if alias:
+            resp.headers.set("X-Selected-Provider", served.provider)
+            resp.headers.set("X-Selected-Model", served.model)
         return resp
 
     # ------------------------------------------------------------------
@@ -255,38 +335,54 @@ class RouterImpl:
         completions + responses): routing-pool alias resolution,
         provider/model prefix parsing, allow/deny enforcement on the
         ORIGINAL id (routes.go:641-653), and provider construction.
-        Returns (provider, provider_id, model, routed) or an error
-        Response — one implementation so the two endpoints can never
+        Returns ``(candidates, alias)`` — the full ordered failover list
+        (healthy replicas first for pool routes; a single candidate for
+        direct routes; ``alias`` is the pool alias or "") — or an error
+        Response. One implementation so the two endpoints can never
         drift (code-review round 3)."""
         model = original_model
         provider_id = req.query_get("provider")
-        routed: routing.Deployment | None = None
+        alias = ""
+        deployments: list[routing.Deployment] | None = None
         if self.selector is not None and not provider_id:
-            routed = self.selector.select(model)
-            if routed is not None:
-                provider_id = routed.provider
-                model = routed.model
+            deployments = self.selector.select_candidates(model)
+            if deployments:
+                alias = original_model
                 self.logger.debug("routed logical model", "alias", original_model,
-                                  "provider", routed.provider, "model", routed.model)
-        if not provider_id:
-            detected, model = routing.determine_provider_and_model_name(model)
-            if detected is None:
-                return error_json(
-                    "Unable to determine provider for model. Please specify a provider "
-                    "using the ?provider= query parameter or use the provider/model "
-                    "format (e.g., openai/gpt-4).", 400)
-            provider_id = detected
+                                  "candidates",
+                                  [(d.provider, d.model) for d in deployments])
+        if not deployments:
+            if not provider_id:
+                detected, model = routing.determine_provider_and_model_name(model)
+                if detected is None:
+                    return error_json(
+                        "Unable to determine provider for model. Please specify a provider "
+                        "using the ?provider= query parameter or use the provider/model "
+                        "format (e.g., openai/gpt-4).", 400)
+                provider_id = detected
+            deployments = [routing.Deployment(provider=provider_id, model=model)]
         if self.cfg.allowed_models:
             if not routing.model_matches(routing.parse_model_set(self.cfg.allowed_models), original_model):
                 return error_json("Model not allowed. Please check the list of allowed models.", 403)
         elif self.cfg.disallowed_models:
             if routing.model_matches(routing.parse_model_set(self.cfg.disallowed_models), original_model):
                 return error_json("Model is disallowed. Please use a different model.", 403)
-        try:
-            provider = self._build_provider(provider_id)
-        except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
-            return self._provider_error(e, provider_id)
-        return provider, provider_id, model, routed
+        candidates: list[_Candidate] = []
+        build_err: Exception | None = None
+        build_err_pid = ""
+        for d in deployments:
+            try:
+                provider = self._build_provider(d.provider)
+            except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
+                build_err, build_err_pid = e, d.provider
+                if alias:
+                    self.logger.warn("pool deployment provider unavailable",
+                                     "alias", alias, "provider", d.provider)
+                continue
+            candidates.append(_Candidate(provider, d.provider, d.model))
+        if not candidates:
+            return self._provider_error(build_err, build_err_pid)
+        return candidates, alias
 
     def _vision_gate(self, provider, provider_id: str, model: str, messages: list) -> list:
         """Strip image parts for non-vision providers (routes.go:670-706)."""
@@ -337,27 +433,46 @@ class RouterImpl:
         route = self._resolve_route(req, original_model)
         if isinstance(route, Response):
             return route
-        provider, provider_id, model, _routed = route
+        candidates, alias = route
 
-        chat_req = responses_to_chat_request(dict(body, model=model))
-        chat_req["messages"] = self._vision_gate(
-            provider, provider_id, model, chat_req.get("messages") or [])
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
+        budget = self.resilience.new_budget()
+
+        def chat_req_for(cand: _Candidate) -> dict[str, Any]:
+            chat_req = responses_to_chat_request(dict(body, model=cand.model))
+            chat_req["messages"] = self._vision_gate(
+                cand.provider_obj, cand.provider, cand.model, chat_req.get("messages") or [])
+            return chat_req
 
         if body.get("stream"):
+            async def call(cand: _Candidate, b) -> Any:
+                return await cand.provider_obj.stream_chat_completions(
+                    chat_req_for(cand), ctx, timeout=b.timeout())
+
             try:
-                stream = await provider.stream_chat_completions(chat_req, ctx)
+                stream, _served = await self.resilience.execute(
+                    candidates, call, budget=budget, idempotent=False, alias=alias)
+            except UpstreamUnavailableError as e:
+                return error_json(str(e), 503)
+            except BudgetExceededError:
+                return error_json("Request timed out", 504)
             except HTTPError as e:
                 return error_json(e.message, e.status_code)
             except HTTPClientError as e:
                 return error_json(str(e), 502)
-            return StreamingResponse.sse(stream_response_events(stream, body))
+            return StreamingResponse.sse(
+                stream_response_events(self.resilience.guard_stream(stream), body))
+
+        async def call(cand: _Candidate, b) -> Any:
+            return await cand.provider_obj.chat_completions(
+                chat_req_for(cand), ctx, timeout=b.timeout())
 
         try:
-            result = await asyncio.wait_for(
-                provider.chat_completions(chat_req, ctx), timeout=self.cfg.server.read_timeout
-            )
-        except asyncio.TimeoutError:
+            result, _served = await self.resilience.execute(
+                candidates, call, budget=budget, idempotent=True, alias=alias)
+        except UpstreamUnavailableError as e:
+            return error_json(str(e), 503)
+        except (BudgetExceededError, asyncio.TimeoutError):
             return error_json("Request timed out", 504)
         except HTTPError as e:
             return error_json(e.message, e.status_code)
@@ -432,11 +547,28 @@ class RouterImpl:
         if req.ctx.get("traceparent"):
             headers.set("traceparent", req.ctx["traceparent"])
 
-        try:
-            resp = await self.client.post(
+        # Passthrough is non-idempotent: no retry, but the circuit breaker
+        # sheds load from a dead upstream and the deadline budget bounds
+        # connect + headers (streaming) or the whole exchange.
+        async def call(cand, b) -> Any:
+            return await self.client.post(
                 upstream_url, body, headers=headers, stream=is_streaming,
-                timeout=None if is_streaming else self.cfg.server.read_timeout,
+                timeout=b.timeout(),
             )
+
+        try:
+            resp, _ = await self.resilience.execute(
+                [routing.Deployment(provider=provider_id, model=model)], call,
+                budget=self.resilience.new_budget(), idempotent=False,
+                # Upstream errors pass through verbatim (no exception), so
+                # tell the breaker which responses count as illness.
+                result_ok=lambda r: r.status < 500 and r.status != 429,
+            )
+        except UpstreamUnavailableError:
+            return messages_error(503, "overloaded_error",
+                                  "Upstream temporarily unavailable (circuit open)")
+        except BudgetExceededError:
+            return messages_error(504, "api_error", "Request timed out")
         except HTTPClientError as e:
             self.logger.error("failed to reach upstream server", e, "url", upstream_url)
             return messages_error(502, "api_error", "Failed to reach upstream server")
@@ -460,7 +592,7 @@ class RouterImpl:
             async for block in resp.iter_raw():
                 yield block
 
-        return StreamingResponse.sse(relay())
+        return StreamingResponse.sse(self.resilience.guard_stream(relay()))
 
     # ------------------------------------------------------------------
     async def list_tools_handler(self, req: Request) -> Response:

@@ -7,7 +7,12 @@ common_types.go); here payloads stay dicts and these TypedDicts +
 SCHEMAS give the typing/validation surface.
 """
 
-from typing import Any, NotRequired, TypedDict
+try:
+    from typing import Any, NotRequired, TypedDict
+except ImportError:  # Python < 3.11
+    from typing import Any, TypedDict
+
+    from typing_extensions import NotRequired
 
 # String enums (annotation aliases; the validator enforces values).
 Provider = str
@@ -58,6 +63,12 @@ ListModelsResponse = TypedDict('ListModelsResponse', {
     'provider': 'NotRequired[Provider]',
     'object': 'str',
     'data': 'list[Model]',
+    'failed_providers': 'NotRequired[list[FailedProvider]]',
+}, total=True)
+
+FailedProvider = TypedDict('FailedProvider', {
+    'provider': 'str',
+    'error': 'str',
 }, total=True)
 
 ImageURL = TypedDict('ImageURL', {
@@ -580,7 +591,13 @@ SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
                         'properties': {'provider': {'$ref': '#/components/schemas/Provider'},
                                        'object': {'type': 'string'},
                                        'data': {'type': 'array',
-                                                'items': {'$ref': '#/components/schemas/Model'}}}},
+                                                'items': {'$ref': '#/components/schemas/Model'}},
+                                       'failed_providers': {'type': 'array',
+                                                            'items': {'$ref': '#/components/schemas/FailedProvider'}}}},
+ 'FailedProvider': {'type': 'object',
+                    'required': ['provider', 'error'],
+                    'properties': {'provider': {'type': 'string'},
+                                   'error': {'type': 'string'}}},
  'MessageRole': {'type': 'string',
                  'enum': ['system', 'user', 'assistant', 'tool', 'developer', 'function']},
  'ImageURL': {'type': 'object',

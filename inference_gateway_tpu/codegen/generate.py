@@ -34,6 +34,24 @@ def load_spec(path: Path = SPEC_PATH) -> dict:
 # ---------------------------------------------------------------------------
 # Generation
 # ---------------------------------------------------------------------------
+# Emitted under the Resilience section of Configurations.md: what clients
+# observe in each degraded mode (ISSUE 1 satellite).
+_RESILIENCE_FAILURE_MODES = [
+    "### Failure modes",
+    "",
+    "What a client sees when the resilience layer degrades a request:",
+    "",
+    "| Condition | HTTP status | Error envelope |",
+    "|---|---|---|",
+    "| Circuit open for the requested deployment; pool exhausted (every candidate open or failing) | `503` | `{\"error\": \"all deployments unavailable (circuit open)...\"}` (Messages API: `{\"type\": \"error\", \"error\": {\"type\": \"overloaded_error\", ...}}`) |",
+    "| Deadline budget (`RESILIENCE_REQUEST_BUDGET`) exhausted across retries/failovers | `504` | `{\"error\": \"Request timed out\"}` |",
+    "| Upstream kept failing after retries and failover (transport errors) | `502` | `{\"error\": \"<client error detail>\"}` |",
+    "| Upstream returned a terminal HTTP error (passes through after retries for 429/5xx) | upstream status | upstream error body |",
+    "| SSE relay idle past `RESILIENCE_STREAM_IDLE_TIMEOUT` | stream aborted mid-flight (headers already sent) | connection closed |",
+    "",
+]
+
+
 def generate_configurations_md(spec: dict) -> str:
     out = [
         "# Configurations",
@@ -51,6 +69,8 @@ def generate_configurations_md(spec: dict) -> str:
             default = str(e.get("default", ""))
             out.append(f"| `{e['env']}` | `{default}` | {e['description']} |")
         out.append("")
+        if section == "resilience":
+            out.extend(_RESILIENCE_FAILURE_MODES)
     out.append("## Providers")
     out.append("")
     out.append("| Provider | `<ID>_API_URL` default | Auth |")
@@ -244,6 +264,15 @@ def check_config_defaults(spec: dict) -> list[str]:
         "CLIENT_EXPECT_CONTINUE_TIMEOUT": cfg.client.expect_continue_timeout,
         "ROUTING_ENABLED": cfg.routing.enabled,
         "ROUTING_CONFIG_PATH": cfg.routing.config_path,
+        "RESILIENCE_ENABLED": cfg.resilience.enabled,
+        "RESILIENCE_BREAKER_FAILURE_THRESHOLD": cfg.resilience.breaker_failure_threshold,
+        "RESILIENCE_BREAKER_COOLDOWN": cfg.resilience.breaker_cooldown,
+        "RESILIENCE_BREAKER_HALF_OPEN_PROBES": cfg.resilience.breaker_half_open_probes,
+        "RESILIENCE_RETRY_MAX_ATTEMPTS": cfg.resilience.retry_max_attempts,
+        "RESILIENCE_RETRY_BASE_BACKOFF": cfg.resilience.retry_base_backoff,
+        "RESILIENCE_RETRY_MAX_BACKOFF": cfg.resilience.retry_max_backoff,
+        "RESILIENCE_REQUEST_BUDGET": cfg.resilience.request_budget,
+        "RESILIENCE_STREAM_IDLE_TIMEOUT": cfg.resilience.stream_idle_timeout,
     }
     problems = []
     seen = set()

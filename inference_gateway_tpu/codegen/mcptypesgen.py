@@ -44,7 +44,12 @@ def generate_mcp_types_py(schema_path: Path | None = None) -> str:
         "these TypedDicts + MCP_SCHEMAS give the typing/validation surface.",
         '"""',
         "",
-        "from typing import Any, NotRequired, TypedDict",
+        "try:",
+        "    from typing import Any, NotRequired, TypedDict",
+        "except ImportError:  # Python < 3.11",
+        "    from typing import Any, TypedDict",
+        "",
+        "    from typing_extensions import NotRequired",
         "",
         "# String enums (annotation aliases; the validator enforces values).",
         *aliases,

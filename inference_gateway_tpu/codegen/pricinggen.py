@@ -24,7 +24,11 @@ import datetime
 import json
 import sys
 import tarfile
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11
+    import tomli as tomllib
 from decimal import Decimal
 from pathlib import Path
 

@@ -91,7 +91,12 @@ def generate_types_py(spec: dict[str, Any]) -> str:
         "SCHEMAS give the typing/validation surface.",
         '"""',
         "",
-        "from typing import Any, NotRequired, TypedDict",
+        "try:",
+        "    from typing import Any, NotRequired, TypedDict",
+        "except ImportError:  # Python < 3.11",
+        "    from typing import Any, TypedDict",
+        "",
+        "    from typing_extensions import NotRequired",
         "",
         "# String enums (annotation aliases; the validator enforces values).",
         *aliases,

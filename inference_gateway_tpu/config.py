@@ -189,6 +189,39 @@ class ClientConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """RESILIENCE_* — circuit breakers, retry/backoff, failover, and
+    per-request deadline budgets (ISSUE 1). Durations are float seconds.
+    When RESILIENCE_REQUEST_BUDGET is unset, ``Config.load`` couples the
+    budget to CLIENT_TIMEOUT so operators who lengthened the upstream
+    timeout (long generations) aren't silently capped at 30s."""
+
+    enabled: bool = True
+    breaker_failure_threshold: int = 5
+    breaker_cooldown: float = 30.0
+    breaker_half_open_probes: int = 1
+    retry_max_attempts: int = 3
+    retry_base_backoff: float = 0.1
+    retry_max_backoff: float = 2.0
+    request_budget: float = 30.0
+    stream_idle_timeout: float = 60.0
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "RESILIENCE_") -> "ResilienceConfig":
+        return cls(
+            enabled=_get_bool(env, prefix + "ENABLED", True),
+            breaker_failure_threshold=_get_int(env, prefix + "BREAKER_FAILURE_THRESHOLD", 5),
+            breaker_cooldown=_get_duration(env, prefix + "BREAKER_COOLDOWN", "30s"),
+            breaker_half_open_probes=_get_int(env, prefix + "BREAKER_HALF_OPEN_PROBES", 1),
+            retry_max_attempts=_get_int(env, prefix + "RETRY_MAX_ATTEMPTS", 3),
+            retry_base_backoff=_get_duration(env, prefix + "RETRY_BASE_BACKOFF", "100ms"),
+            retry_max_backoff=_get_duration(env, prefix + "RETRY_MAX_BACKOFF", "2s"),
+            request_budget=_get_duration(env, prefix + "REQUEST_BUDGET", "30s"),
+            stream_idle_timeout=_get_duration(env, prefix + "STREAM_IDLE_TIMEOUT", "60s"),
+        )
+
+
+@dataclass
 class RoutingConfig:
     """ROUTING_* (config.go:98-101)."""
 
@@ -219,6 +252,7 @@ class Config:
     server: ServerConfig = field(default_factory=ServerConfig)
     client: ClientConfig = field(default_factory=ClientConfig)
     routing: RoutingConfig = field(default_factory=RoutingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     providers: dict[str, ProviderConfig] = field(default_factory=dict)
 
     @classmethod
@@ -240,7 +274,12 @@ class Config:
             server=ServerConfig.load(env),
             client=ClientConfig.load(env),
             routing=RoutingConfig.load(env),
+            resilience=ResilienceConfig.load(env),
         )
+        if not env.get("RESILIENCE_REQUEST_BUDGET"):
+            # Follow the operator's upstream timeout unless the budget is
+            # set explicitly (the spec default 30s == CLIENT_TIMEOUT's).
+            cfg.resilience.request_budget = cfg.client.timeout
         for pid, defaults in REGISTRY.items():
             pc = defaults.copy()
             url = env.get(pid.upper() + "_API_URL")

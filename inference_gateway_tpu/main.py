@@ -27,6 +27,7 @@ from inference_gateway_tpu.netio.server import HTTPServer, Request, Router
 from inference_gateway_tpu.otel import OpenTelemetry
 from inference_gateway_tpu.providers import routing
 from inference_gateway_tpu.providers.registry import ProviderRegistry
+from inference_gateway_tpu.resilience import Resilience
 from inference_gateway_tpu.version import APPLICATION_NAME, VERSION
 
 
@@ -129,12 +130,17 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     )
     registry = ProviderRegistry(cfg.providers, logger=logger)
 
+    # Resilience layer (ISSUE 1): one breaker registry + retry policy per
+    # gateway, shared by the routing selector (health-aware candidate
+    # ordering) and every handler (failover/retry/deadline budgets).
+    resilience = Resilience(cfg.resilience, otel=otel, logger=logger)
+
     selector = None
     if cfg.routing.enabled:
         if not cfg.routing.config_path:
             raise ValueError("ROUTING_CONFIG_PATH is required when ROUTING_ENABLED is true")
         pools = routing.load_pools_config(cfg.routing.config_path)
-        selector = routing.Selector(pools)
+        selector = routing.Selector(pools, health=resilience.healthy)
         logger.info("routing pools loaded", "aliases", selector.aliases())
 
     # MCP subsystem (main.go:181-213).
@@ -148,6 +154,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     router_impl = RouterImpl(
         cfg, registry, client, logger=logger, otel=otel,
         mcp_client=mcp_client, mcp_agent=mcp_agent, selector=selector,
+        resilience=resilience,
     )
 
     # Middleware order matters (main.go:238-254): tracing → logger →

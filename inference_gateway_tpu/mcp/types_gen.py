@@ -7,7 +7,12 @@ internal/mcp/generated_types.go); here payloads stay dicts and
 these TypedDicts + MCP_SCHEMAS give the typing/validation surface.
 """
 
-from typing import Any, NotRequired, TypedDict
+try:
+    from typing import Any, NotRequired, TypedDict
+except ImportError:  # Python < 3.11
+    from typing import Any, TypedDict
+
+    from typing_extensions import NotRequired
 
 # String enums (annotation aliases; the validator enforces values).
 LoggingLevel = str

@@ -252,6 +252,20 @@ class HTTPClient:
         reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
         return reader, writer, False
 
+    async def _connect_bounded(self, scheme: str, host: str, port: int,
+                               fresh: bool, timeout: float | None):
+        """_connect under a deadline, closed-on-timeout-race: wait_for may
+        fire in the same tick the connect completes, in which case the
+        resolved (reader, writer) would otherwise be dropped and the
+        socket (or a pooled connection) leaked."""
+        task = asyncio.ensure_future(self._connect(scheme, host, port, fresh=fresh))
+        try:
+            return await asyncio.wait_for(task, timeout=timeout)
+        except asyncio.TimeoutError:
+            if task.done() and not task.cancelled() and task.exception() is None:
+                task.result()[1].close()
+            raise
+
     async def _release(self, scheme: str, host: str, port: int, reader, writer, reusable: bool):
         if not reusable or writer.is_closing():
             writer.close()
@@ -358,15 +372,25 @@ class HTTPClient:
 
         # A pooled connection may have been closed by the peer; retry once
         # on a fresh connection if it dies before the status line arrives.
+        # The connect phase shares the request timeout (a deadline budget
+        # propagated from the resilience layer bounds dial + headers, so
+        # retries never extend total latency), and connect-time OSErrors
+        # (refused, unreachable, DNS) surface as HTTPClientError like
+        # every other transport failure instead of escaping raw.
         for attempt in (0, 1):
-            reader, writer, pooled = await self._connect(scheme, host, port, fresh=attempt > 0)
+            writer = None
+            pooled = False
             try:
+                reader, writer, pooled = await self._connect_bounded(
+                    scheme, host, port, attempt > 0, timeout
+                )
                 writer.write(head.encode("latin-1") + body)
                 await asyncio.wait_for(writer.drain(), timeout=timeout)
                 status_blob = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=timeout)
                 break
-            except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
-                writer.close()
+            except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+                if writer is not None:
+                    writer.close()
                 if pooled and attempt == 0 and not isinstance(e, asyncio.TimeoutError):
                     continue
                 raise HTTPClientError(f"{type(e).__name__} talking to {host}:{port}") from e
@@ -374,7 +398,8 @@ class HTTPClient:
                 # Cancellation safety (same as the body-read phase): a
                 # caller's wait_for cancelling us mid-send must not leak
                 # the half-written connection.
-                writer.close()
+                if writer is not None:
+                    writer.close()
                 raise
 
         lines = status_blob.decode("latin-1").split("\r\n")

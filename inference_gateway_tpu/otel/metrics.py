@@ -52,6 +52,36 @@ class Counter:
 
 
 @dataclass
+class Gauge:
+    """Last-value instrument (Prometheus gauge) — e.g. circuit-breaker
+    state per (provider, model)."""
+
+    name: str
+    description: str
+    label_names: tuple[str, ...]
+    unit: str = ""
+    _values: dict[LabelValues, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def set(self, value: float, labels: dict[str, str] | None = None) -> None:
+        key = tuple((labels or {}).get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = value
+
+    def collect(self) -> str:
+        pname = _sanitize_name(self.name)
+        out = [f"# HELP {pname} {self.description}", f"# TYPE {pname} gauge"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, val in items:
+            labels = ",".join(
+                f'{_sanitize_name(n)}="{_escape(v)}"' for n, v in zip(self.label_names, key) if v
+            )
+            out.append(f"{pname}{{{labels}}} {val:g}" if labels else f"{pname} {val:g}")
+        return "\n".join(out)
+
+
+@dataclass
 class Histogram:
     name: str
     description: str
@@ -100,7 +130,7 @@ class Histogram:
 
 class Registry:
     def __init__(self) -> None:
-        self._instruments: list[Counter | Histogram] = []
+        self._instruments: list[Counter | Gauge | Histogram] = []
         self._lock = threading.Lock()
 
     def counter(self, name: str, description: str, label_names: tuple[str, ...], unit: str = "") -> Counter:
@@ -108,6 +138,12 @@ class Registry:
         with self._lock:
             self._instruments.append(c)
         return c
+
+    def gauge(self, name: str, description: str, label_names: tuple[str, ...], unit: str = "") -> Gauge:
+        g = Gauge(name, description, label_names, unit)
+        with self._lock:
+            self._instruments.append(g)
+        return g
 
     def histogram(
         self, name: str, description: str, label_names: tuple[str, ...],

@@ -74,6 +74,29 @@ class OpenTelemetry:
             "inference_gateway.tool_calls", "Number of tool calls observed in model responses",
             _BASE_LABELS + ("gen_ai_tool_name", "gen_ai_tool_type"), unit="{call}",
         )
+        # Resilience-layer instruments (ISSUE 1): breaker transitions,
+        # retries, failover hops, and a current-state gauge.
+        self.breaker_transition_counter = r.counter(
+            "inference_gateway.resilience.breaker_transitions",
+            "Circuit breaker state transitions per (provider, model)",
+            ("gen_ai_provider_name", "gen_ai_request_model", "from_state", "to_state"),
+            unit="{transition}",
+        )
+        self.breaker_state_gauge = r.gauge(
+            "inference_gateway.resilience.breaker_state",
+            "Current circuit state per (provider, model): 0=closed 1=half_open 2=open",
+            ("gen_ai_provider_name", "gen_ai_request_model"),
+        )
+        self.retry_counter = r.counter(
+            "inference_gateway.resilience.retries",
+            "Upstream retries attempted by the resilience layer",
+            ("gen_ai_provider_name", "gen_ai_request_model", "reason"), unit="{retry}",
+        )
+        self.failover_counter = r.counter(
+            "inference_gateway.resilience.failovers",
+            "Mid-request failovers to another pool deployment",
+            ("alias", "from_provider", "to_provider"), unit="{failover}",
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -109,6 +132,29 @@ class OpenTelemetry:
         labels.pop("gen_ai_operation_name")
         labels.update({"gen_ai_tool_name": tool_name, "gen_ai_tool_type": tool_type})
         self.tool_call_counter.add(1, labels)
+
+    # -- resilience (ISSUE 1) --------------------------------------------
+    def record_breaker_transition(self, provider: str, model: str, old: str, new: str) -> None:
+        self.breaker_transition_counter.add(1, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model,
+            "from_state": old, "to_state": new,
+        })
+
+    def set_breaker_state(self, provider: str, model: str, state_code: int) -> None:
+        self.breaker_state_gauge.set(state_code, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model,
+        })
+
+    def record_retry(self, provider: str, model: str, reason: str) -> None:
+        self.retry_counter.add(1, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model,
+            "reason": reason,
+        })
+
+    def record_failover(self, alias: str, from_provider: str, to_provider: str) -> None:
+        self.failover_counter.add(1, {
+            "alias": alias, "from_provider": from_provider, "to_provider": to_provider,
+        })
 
     def expose_prometheus(self) -> str:
         return self.registry.expose()
@@ -238,4 +284,16 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_tool_call(self, *a, **k) -> None:
+        pass
+
+    def record_breaker_transition(self, *a, **k) -> None:
+        pass
+
+    def set_breaker_state(self, *a, **k) -> None:
+        pass
+
+    def record_retry(self, *a, **k) -> None:
+        pass
+
+    def record_failover(self, *a, **k) -> None:
         pass

@@ -31,13 +31,22 @@ from inference_gateway_tpu.providers.transformers import transform_list_models
 STREAM_QUEUE_CAP = 100  # provider.go:259 channel cap
 
 
-class HTTPError(Exception):
-    """Upstream non-200 (provider.go:26-33)."""
+def _retry_after(resp) -> float | None:
+    from inference_gateway_tpu.resilience.retry import retry_after_seconds
 
-    def __init__(self, status_code: int, message: str):
+    return retry_after_seconds(resp.headers)
+
+
+class HTTPError(Exception):
+    """Upstream non-200 (provider.go:26-33). ``retry_after`` carries the
+    upstream's Retry-After hint (seconds) so the resilience layer's
+    backoff can honor it."""
+
+    def __init__(self, status_code: int, message: str, retry_after: float | None = None):
         super().__init__(message)
         self.status_code = status_code
         self.message = message
+        self.retry_after = retry_after
 
 
 class Provider:
@@ -98,15 +107,17 @@ class Provider:
         return out
 
     # -- API (interfaces.go:10-24) --------------------------------------
-    async def list_models(self, ctx: dict[str, Any] | None = None) -> dict[str, Any]:
+    async def list_models(self, ctx: dict[str, Any] | None = None,
+                          timeout: float | None = None) -> dict[str, Any]:
         url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.models}"
         try:
-            resp = await self.client.get(url, headers=self._headers(ctx))
+            resp = await self.client.get(url, headers=self._headers(ctx), timeout=timeout)
         except HTTPClientError as e:
             self.logger.error("failed to list models", e, "provider", self.name)
             raise
         if resp.status != 200:
-            raise HTTPError(resp.status, resp.body.decode("utf-8", errors="replace"))
+            raise HTTPError(resp.status, resp.body.decode("utf-8", errors="replace"),
+                            retry_after=_retry_after(resp))
         try:
             raw = resp.json()
         except ValueError:
@@ -118,21 +129,23 @@ class Provider:
         apply_community_pricing(out["data"])
         return out
 
-    async def chat_completions(self, req: dict[str, Any], ctx: dict[str, Any] | None = None) -> dict[str, Any]:
+    async def chat_completions(self, req: dict[str, Any], ctx: dict[str, Any] | None = None,
+                               timeout: float | None = None) -> dict[str, Any]:
         url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.chat}"
         body = json.dumps(req).encode()
         try:
-            resp = await self.client.post(url, body, headers=self._headers(ctx))
+            resp = await self.client.post(url, body, headers=self._headers(ctx), timeout=timeout)
         except HTTPClientError as e:
             self.logger.error("failed to send request", e, "provider", self.name)
             raise
         if resp.status != 200:
-            raise HTTPError(resp.status, resp.body.decode("utf-8", errors="replace"))
+            raise HTTPError(resp.status, resp.body.decode("utf-8", errors="replace"),
+                            retry_after=_retry_after(resp))
         return resp.json()
 
     async def stream_chat_completions(
         self, req: dict[str, Any], ctx: dict[str, Any] | None = None,
-        line_framing: bool = False,
+        line_framing: bool = False, timeout: float | None = None,
     ) -> AsyncIterator[bytes]:
         """SSE stream from the upstream, via a bounded relay queue.
 
@@ -143,12 +156,14 @@ class Provider:
         url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.chat}"
         stream_req = self._prepare_streaming_request(req)
         body = json.dumps(stream_req).encode()
-        resp = await self.client.post(url, body, headers=self._headers(ctx), stream=True)
+        resp = await self.client.post(url, body, headers=self._headers(ctx), stream=True,
+                                      timeout=timeout)
         if resp.status != 200:
             err_body = b""
             async for line in resp.iter_lines():
                 err_body += line
-            raise HTTPError(resp.status, err_body.decode("utf-8", errors="replace"))
+            raise HTTPError(resp.status, err_body.decode("utf-8", errors="replace"),
+                            retry_after=_retry_after(resp))
 
         queue: asyncio.Queue[bytes | None] = asyncio.Queue(maxsize=STREAM_QUEUE_CAP)
 

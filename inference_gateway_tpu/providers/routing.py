@@ -5,16 +5,19 @@ Capability parity with reference providers/routing/:
   (model_mapping.go:19-31)
 - ALLOWED_MODELS / DISALLOWED_MODELS case-insensitive sets matching both
   full and prefix-stripped ids (model_filter.go:10-65)
-- round-robin model-alias pools from YAML with an atomic per-replica
+- round-robin model-alias pools from YAML with a bounded per-pool
   cursor and a ≥2-deployments invariant (pool.go:39-105)
+- health-aware candidate ordering: ``Pool.candidates``/``
+  Selector.select_candidates`` return the full rotated deployment list
+  with circuit-open replicas demoted to the tail, so handlers fail over
+  mid-request instead of round-robining blindly into dead deployments
 """
 
 from __future__ import annotations
 
-import itertools
 import threading
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable
 
 from inference_gateway_tpu.providers.registry import REGISTRY
 
@@ -73,13 +76,36 @@ class Deployment:
 class Pool:
     alias: str
     deployments: list[Deployment]
-    _cursor: itertools.count = field(default_factory=itertools.count)
+    # Bounded cursor: wraps modulo pool size under the lock, so it never
+    # grows without bound the way the old itertools.count did.
+    _cursor: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
-    def next(self) -> Deployment:
+    def _advance(self) -> int:
         with self._lock:
-            idx = next(self._cursor)
-        return self.deployments[idx % len(self.deployments)]
+            idx = self._cursor
+            self._cursor = (idx + 1) % len(self.deployments)
+        return idx
+
+    def next(self) -> Deployment:
+        return self.deployments[self._advance()]
+
+    def candidates(self, healthy: Callable[[Deployment], bool] | None = None) -> list[Deployment]:
+        """The full deployment list rotated to this request's round-robin
+        start. With a health predicate, unhealthy (circuit-open) replicas
+        are demoted to the tail: never tried before a healthy one, and
+        skipped outright by the executor unless their breaker's cooldown
+        elapses by the time the failover walk reaches them (earlier
+        candidates' retries take time, so the tail is a genuine
+        second-chance window, not a guaranteed last resort)."""
+        start = self._advance()
+        n = len(self.deployments)
+        rotated = [self.deployments[(start + k) % n] for k in range(n)]
+        if healthy is None:
+            return rotated
+        ok = [d for d in rotated if healthy(d)]
+        bad = [d for d in rotated if not healthy(d)]
+        return ok + bad
 
 
 class PoolConfigError(ValueError):
@@ -119,19 +145,36 @@ def load_pools_config(path: str) -> dict[str, Pool]:
                 raise PoolConfigError(f"pool {alias!r} references unknown provider {d.provider!r}")
             if not d.model:
                 raise PoolConfigError(f"pool {alias!r} has a deployment without a model")
+        if alias in pools:
+            # Last-write-wins would silently shadow an earlier pool — an
+            # operator typo that deserves a hard startup failure.
+            raise PoolConfigError(f"duplicate pool alias {alias!r}")
         pools[alias] = Pool(alias, deployments)
     return pools
 
 
 class Selector:
-    """Round-robin alias selector (pool.go:68-105)."""
+    """Round-robin alias selector (pool.go:68-105), optionally
+    health-aware: ``health`` is a Deployment predicate (wired to the
+    resilience layer's breaker registry) used to demote circuit-open
+    replicas when ordering candidates."""
 
-    def __init__(self, pools: dict[str, Pool]):
+    def __init__(self, pools: dict[str, Pool],
+                 health: Callable[[Deployment], bool] | None = None):
         self._pools = pools
+        self._health = health
 
     def select(self, alias: str) -> Deployment | None:
+        candidates = self.select_candidates(alias)
+        return candidates[0] if candidates else None
+
+    def select_candidates(self, alias: str) -> list[Deployment] | None:
+        """Ordered failover candidates for one request: round-robin
+        rotated, healthy replicas first. None when the alias is unknown."""
         pool = self._pools.get(alias)
-        return pool.next() if pool else None
+        if pool is None:
+            return None
+        return pool.candidates(self._health)
 
     def aliases(self) -> list[str]:
         return list(self._pools)

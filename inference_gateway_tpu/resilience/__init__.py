@@ -1,0 +1,37 @@
+"""Resilience layer: circuit breakers, health-aware failover, retry with
+jittered backoff, deadline budgets, and a deterministic fault-injection
+harness (ISSUE 1 tentpole; STREAM/TPI-LLM treat failure-masking as a
+first-class middleware concern)."""
+
+from inference_gateway_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    STATE_CODES,
+    BreakerConfig,
+    BreakerRegistry,
+    CircuitBreaker,
+)
+from inference_gateway_tpu.resilience.budget import BudgetExceededError, DeadlineBudget
+from inference_gateway_tpu.resilience.clock import MonotonicClock, VirtualClock
+from inference_gateway_tpu.resilience.faults import Fault, FaultInjectingClient, FaultScript
+from inference_gateway_tpu.resilience.manager import (
+    Resilience,
+    StreamStalledError,
+    UpstreamUnavailableError,
+)
+from inference_gateway_tpu.resilience.retry import (
+    RETRYABLE_STATUSES,
+    RetryPolicy,
+    retry_after_seconds,
+)
+
+__all__ = [
+    "CLOSED", "HALF_OPEN", "OPEN", "STATE_CODES",
+    "BreakerConfig", "BreakerRegistry", "CircuitBreaker",
+    "BudgetExceededError", "DeadlineBudget",
+    "MonotonicClock", "VirtualClock",
+    "Fault", "FaultInjectingClient", "FaultScript",
+    "Resilience", "StreamStalledError", "UpstreamUnavailableError",
+    "RETRYABLE_STATUSES", "RetryPolicy", "retry_after_seconds",
+]

@@ -1,0 +1,179 @@
+"""Per-(provider, model) circuit breakers.
+
+Classic three-state machine (closed → open after N consecutive failures →
+half-open probe after a cooldown), monotonic-clock based so wall-clock
+jumps never flap circuits, and safe under both threads and event-loop
+concurrency: all state moves happen under one lock with no awaits, and
+transition callbacks fire after the lock is released.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from inference_gateway_tpu.resilience.clock import MonotonicClock
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# Prometheus-friendly numeric encoding for the state gauge.
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+@dataclass
+class BreakerConfig:
+    failure_threshold: int = 5
+    cooldown: float = 30.0
+    half_open_max_probes: int = 1
+
+
+class CircuitBreaker:
+    def __init__(self, config: BreakerConfig | None = None, clock=None,
+                 on_transition: Callable[[str, str], None] | None = None) -> None:
+        self.config = config or BreakerConfig()
+        self._clock = clock or MonotonicClock()
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_in_flight = 0
+
+    # -- internals (call under self._lock; returns transition events) ----
+    def _set_state(self, new: str, events: list[tuple[str, str]]) -> None:
+        if self._state != new:
+            events.append((self._state, new))
+            self._state = new
+
+    def _maybe_half_open(self, events: list[tuple[str, str]]) -> None:
+        if self._state == OPEN and self._clock.now() - self._opened_at >= self.config.cooldown:
+            self._set_state(HALF_OPEN, events)
+            self._probes_in_flight = 0
+
+    def _emit(self, events: list[tuple[str, str]]) -> None:
+        if self._on_transition:
+            for old, new in events:
+                self._on_transition(old, new)
+
+    # -- public ----------------------------------------------------------
+    @property
+    def state(self) -> str:
+        """Current state; lazily promotes open → half-open once the
+        cooldown has elapsed (there is no background timer)."""
+        events: list[tuple[str, str]] = []
+        with self._lock:
+            self._maybe_half_open(events)
+            state = self._state
+        self._emit(events)
+        return state
+
+    def admit(self) -> tuple[bool, bool]:
+        """(admitted, took_probe_slot). Half-open admits at most
+        ``half_open_max_probes`` concurrent probes — the losing side of a
+        probe race gets False, which is what keeps a recovering upstream
+        from being stampeded. ``took_probe_slot`` tells the caller
+        whether a later ``release()`` is owed: only admissions that
+        consumed a half-open slot may give one back, else a closed-state
+        admission racing a concurrent open→half-open flip could release
+        someone ELSE's probe and let extra probes through."""
+        events: list[tuple[str, str]] = []
+        with self._lock:
+            self._maybe_half_open(events)
+            if self._state == CLOSED:
+                out = (True, False)
+            elif self._state == HALF_OPEN and self._probes_in_flight < self.config.half_open_max_probes:
+                self._probes_in_flight += 1
+                out = (True, True)
+            else:
+                out = (False, False)
+        self._emit(events)
+        return out
+
+    def allow(self) -> bool:
+        """May a request proceed right now? (``admit()`` without the
+        slot-ownership detail.)"""
+        return self.admit()[0]
+
+    def record_success(self) -> None:
+        events: list[tuple[str, str]] = []
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probes_in_flight = 0
+            # A success from any state closes the circuit: in half-open it
+            # is the probe passing; in open it is a straggler request that
+            # proves the upstream recovered early.
+            self._set_state(CLOSED, events)
+        self._emit(events)
+
+    def record_failure(self) -> None:
+        events: list[tuple[str, str]] = []
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                # Probe failed: re-open and restart the cooldown.
+                self._probes_in_flight = 0
+                self._opened_at = self._clock.now()
+                self._set_state(OPEN, events)
+            elif self._state == CLOSED and self._consecutive_failures >= self.config.failure_threshold:
+                self._opened_at = self._clock.now()
+                self._set_state(OPEN, events)
+            # Already open: keep the original cooldown — stragglers must
+            # not extend the outage window.
+        self._emit(events)
+
+    def release(self) -> None:
+        """Give back an ``allow()`` admission that never reached an
+        outcome (e.g. the deadline budget expired before the attempt
+        launched). Without this a half-open probe slot leaks and the
+        breaker wedges: half-open forever with zero probe capacity —
+        found by the seeded fault fuzz (test_resilience_fuzz)."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probes_in_flight > 0:
+                self._probes_in_flight -= 1
+
+    def healthy(self) -> bool:
+        """Non-consuming peek for pool ordering: True unless hard-open.
+        A cooldown-elapsed (half-open-eligible) breaker counts healthy so
+        the probe request can reach it, but ``allow()`` still gates how
+        many probes get through."""
+        return self.state != OPEN
+
+
+class BreakerRegistry:
+    """Lazily-created breakers keyed by (provider, model)."""
+
+    def __init__(self, config: BreakerConfig | None = None, clock=None,
+                 on_transition: Callable[[tuple[str, str], str, str], None] | None = None) -> None:
+        self._config = config or BreakerConfig()
+        self._clock = clock or MonotonicClock()
+        self._on_transition = on_transition
+        self._breakers: dict[tuple[str, str], CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, provider: str, model: str) -> CircuitBreaker:
+        key = (provider, model)
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                cb = None
+                if self._on_transition is not None:
+                    outer = self._on_transition
+                    cb = lambda old, new, _k=key: outer(_k, old, new)  # noqa: E731
+                br = CircuitBreaker(self._config, clock=self._clock, on_transition=cb)
+                self._breakers[key] = br
+        return br
+
+    def healthy(self, provider: str, model: str) -> bool:
+        """Peek without creating: an upstream nobody has called yet has
+        no failure history and is healthy by definition."""
+        with self._lock:
+            br = self._breakers.get((provider, model))
+        return True if br is None else br.healthy()
+
+    def snapshot(self) -> dict[tuple[str, str], str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {key: br.state for key, br in items}

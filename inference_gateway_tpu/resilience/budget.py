@@ -1,0 +1,49 @@
+"""Per-request wall-clock deadline budgets.
+
+One budget is minted when a request enters a handler and decremented
+across every retry, failover hop, and backoff sleep; the remaining slice
+becomes the connect/read timeout of each upstream attempt, so retries
+re-divide the original deadline instead of extending total latency.
+"""
+
+from __future__ import annotations
+
+from inference_gateway_tpu.resilience.clock import MonotonicClock
+
+
+class BudgetExceededError(Exception):
+    """The request's wall-clock budget is spent."""
+
+
+class DeadlineBudget:
+    """``total <= 0`` means unlimited (mirrors CLIENT_TIMEOUT=0 =
+    no-timeout): never expires, and ``timeout()`` defers to the caller's
+    own default by returning the cap (or None)."""
+
+    def __init__(self, total: float, clock=None) -> None:
+        self.total = float(total)
+        self.unlimited = self.total <= 0.0
+        self._clock = clock or MonotonicClock()
+        self._start = self._clock.now()
+
+    def elapsed(self) -> float:
+        return self._clock.now() - self._start
+
+    def remaining(self) -> float:
+        if self.unlimited:
+            return float("inf")
+        return max(0.0, self.total - self.elapsed())
+
+    def expired(self) -> bool:
+        return False if self.unlimited else self.remaining() <= 0.0
+
+    def timeout(self, cap: float | None = None) -> float | None:
+        """The timeout to hand the next upstream attempt: what's left of
+        the budget, optionally capped. Raises once the budget is spent so
+        callers never launch an attempt that cannot finish in time."""
+        if self.unlimited:
+            return cap
+        rem = self.remaining()
+        if rem <= 0.0:
+            raise BudgetExceededError(f"deadline budget of {self.total:g}s exhausted")
+        return min(rem, cap) if cap is not None else rem

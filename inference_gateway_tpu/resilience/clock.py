@@ -1,0 +1,62 @@
+"""Injectable time source for the resilience layer.
+
+Every policy in this package (breaker cooldowns, backoff sleeps, deadline
+budgets, stream-idle guards) reads time and sleeps exclusively through a
+clock object, so tests drive the whole layer with a virtual clock and
+never sleep real wall-clock time (ISSUE: "deterministically, with zero
+real-time sleeps").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Awaitable
+
+
+class MonotonicClock:
+    """Production clock: monotonic time + real asyncio sleeps."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            await asyncio.sleep(seconds)
+
+    async def wait_for(self, awaitable: Awaitable[Any], timeout: float | None) -> Any:
+        return await asyncio.wait_for(awaitable, timeout)
+
+
+class VirtualClock:
+    """Deterministic clock: ``sleep`` advances virtual time instantly.
+
+    ``wait_for`` awaits the target and then checks how much *virtual*
+    time it consumed — a scripted stall that virtually sleeps past the
+    timeout raises ``asyncio.TimeoutError`` without any real waiting.
+    Recorded ``sleeps`` let tests assert backoff schedules exactly.
+    """
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._t = float(start)
+        self.sleeps: list[float] = []
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, seconds: float) -> None:
+        self._t += max(0.0, seconds)
+
+    async def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self._t += max(0.0, seconds)
+        # Yield once so concurrent tasks interleave like they would under
+        # a real sleep (the half-open race tests depend on this).
+        await asyncio.sleep(0)
+
+    async def wait_for(self, awaitable: Awaitable[Any], timeout: float | None) -> Any:
+        start = self._t
+        result = await awaitable
+        if timeout is not None and self._t - start > timeout:
+            raise asyncio.TimeoutError()
+        return result

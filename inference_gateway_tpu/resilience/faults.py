@@ -1,0 +1,172 @@
+"""Deterministic fault injection for the resilience layer.
+
+``FaultInjectingClient`` is HTTPClient-shaped: it intercepts requests
+whose URL matches a scripted target (substring match — provider ids work
+because every provider call targets ``/proxy/<id>/...``) and plays the
+target's next scripted fault: connection resets, 429/503 with
+Retry-After, stalled SSE streams, slow-first-byte. Unmatched requests
+fall through to the wrapped real client, so a test can fault one
+deployment of a live pool while the rest serve normally. All timing runs
+on the injected clock — with a ``VirtualClock`` no test ever sleeps real
+time.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+from inference_gateway_tpu.netio.client import ClientResponse, HTTPClientError
+from inference_gateway_tpu.netio.server import Headers
+from inference_gateway_tpu.resilience.clock import VirtualClock
+
+OK_CHAT_BODY = {
+    "id": "fault-ok", "object": "chat.completion", "created": 1, "model": "scripted",
+    "choices": [{"index": 0, "message": {"role": "assistant", "content": "ok"},
+                 "finish_reason": "stop"}],
+    "usage": {"prompt_tokens": 1, "completion_tokens": 1, "total_tokens": 2},
+}
+
+
+@dataclass
+class Fault:
+    kind: str  # "ok" | "reset" | "status" | "stall" | "slow_first_byte"
+    status: int = 200
+    body: bytes = b""
+    headers: dict[str, str] = field(default_factory=dict)
+    retry_after: float | None = None
+    delay: float = 0.0
+    # For "stall": chunks delivered before the stream goes silent.
+    chunks: tuple[bytes, ...] = ()
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def ok(cls, body: bytes | dict | None = None, status: int = 200) -> "Fault":
+        if body is None:
+            body = OK_CHAT_BODY
+        if isinstance(body, dict):
+            body = _json.dumps(body).encode()
+        return cls("ok", status=status, body=body)
+
+    @classmethod
+    def reset(cls) -> "Fault":
+        return cls("reset")
+
+    @classmethod
+    def error(cls, status: int, retry_after: float | None = None,
+              body: bytes = b'{"error":"injected"}') -> "Fault":
+        return cls("status", status=status, body=body, retry_after=retry_after)
+
+    @classmethod
+    def stall(cls, delay: float, chunks: tuple[bytes, ...] = ()) -> "Fault":
+        return cls("stall", delay=delay, chunks=chunks)
+
+    @classmethod
+    def slow_first_byte(cls, delay: float, body: bytes | dict | None = None) -> "Fault":
+        f = cls.ok(body)
+        f.kind = "slow_first_byte"
+        f.delay = delay
+        return f
+
+
+class FaultScript:
+    """Per-target FIFO of faults plus an optional repeating default."""
+
+    def __init__(self) -> None:
+        self._queues: dict[str, deque[Fault]] = {}
+        self._defaults: dict[str, Fault] = {}
+        self.log: list[tuple[str, str, str]] = []  # (target, kind, url)
+
+    def script(self, target: str, *faults: Fault) -> "FaultScript":
+        self._queues.setdefault(target, deque()).extend(faults)
+        return self
+
+    def default(self, target: str, fault: Fault) -> "FaultScript":
+        self._queues.setdefault(target, deque())
+        self._defaults[target] = fault
+        return self
+
+    def pop(self, url: str) -> Fault | None:
+        for target, queue in self._queues.items():
+            if target not in url:
+                continue
+            fault = queue.popleft() if queue else self._defaults.get(target)
+            if fault is not None:
+                self.log.append((target, fault.kind, url))
+            return fault
+        return None
+
+    def pending(self, target: str) -> int:
+        return len(self._queues.get(target, ()))
+
+
+class FaultInjectingClient:
+    """HTTPClient-compatible wrapper that injects scripted faults."""
+
+    def __init__(self, script: FaultScript, inner: Any = None, clock=None) -> None:
+        self.script = script
+        self.inner = inner
+        self.clock = clock or VirtualClock()
+
+    async def request(self, method: str, url: str, headers=None, body: bytes = b"",
+                      timeout: float | None = None, stream: bool = False) -> ClientResponse:
+        fault = self.script.pop(url)
+        if fault is None:
+            if self.inner is None:
+                raise AssertionError(f"no scripted fault and no inner client for {url}")
+            return await self.inner.request(method, url, headers=headers, body=body,
+                                            timeout=timeout, stream=stream)
+        return await self._play(fault, url, timeout, stream)
+
+    async def _play(self, fault: Fault, url: str, timeout: float | None,
+                    stream: bool) -> ClientResponse:
+        if fault.kind == "reset":
+            raise HTTPClientError(f"ConnectionResetError talking to {url} (injected)")
+
+        if fault.kind == "slow_first_byte":
+            if timeout is not None and fault.delay >= timeout:
+                # The caller's read timeout fires first — exactly the
+                # elapsed time the real client would have burned.
+                await self.clock.sleep(timeout)
+                raise HTTPClientError(f"TimeoutError talking to {url} (injected slow first byte)")
+            await self.clock.sleep(fault.delay)
+
+        headers = Headers()
+        for k, v in fault.headers.items():
+            headers.set(k, v)
+        if fault.retry_after is not None:
+            headers.set("Retry-After", f"{fault.retry_after:g}")
+        headers.set("Content-Type", "application/json")
+
+        if fault.kind == "stall":
+            clock = self.clock
+
+            async def stalled():
+                for chunk in fault.chunks:
+                    yield chunk
+                # Go silent: virtually sleep past any idle timeout, then
+                # hang up uncleanly like a dead upstream would.
+                await clock.sleep(fault.delay)
+                raise HTTPClientError(f"upstream stalled then reset {url} (injected)")
+
+            resp = ClientResponse(status=200, headers=headers)
+            resp._inproc_chunks = stalled()
+            return resp
+
+        resp = ClientResponse(status=fault.status, headers=headers, body=fault.body)
+        if stream:
+            async def one_shot(b=fault.body):
+                yield b
+
+            resp._inproc_chunks = one_shot()
+        return resp
+
+    async def get(self, url: str, headers=None, timeout: float | None = None) -> ClientResponse:
+        return await self.request("GET", url, headers=headers, timeout=timeout)
+
+    async def post(self, url: str, body: bytes, headers=None, timeout: float | None = None,
+                   stream: bool = False) -> ClientResponse:
+        return await self.request("POST", url, headers=headers, body=body,
+                                  timeout=timeout, stream=stream)

@@ -1,0 +1,262 @@
+"""The resilience facade handlers call into.
+
+One ``Resilience`` instance per gateway owns the breaker registry, retry
+policy, and clock, and exposes ``execute()`` — the failover loop that
+walks an ordered candidate list (healthy replicas first), retries
+idempotent calls with jittered backoff inside the request's deadline
+budget, keeps breaker bookkeeping, and emits otel counters for every
+transition, retry, and failover hop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from inference_gateway_tpu.netio.client import HTTPClientError
+from inference_gateway_tpu.providers.core import HTTPError
+from inference_gateway_tpu.resilience.breaker import (
+    STATE_CODES,
+    BreakerConfig,
+    BreakerRegistry,
+)
+from inference_gateway_tpu.resilience.budget import BudgetExceededError, DeadlineBudget
+from inference_gateway_tpu.resilience.clock import MonotonicClock
+from inference_gateway_tpu.resilience.retry import RETRYABLE_STATUSES, RetryPolicy
+
+
+class UpstreamUnavailableError(Exception):
+    """Every candidate deployment is circuit-open — nothing to try."""
+
+
+# An attempt granted less budget than this that then times out says more
+# about the budget than the upstream: don't charge its breaker, or a slow
+# primary would open a healthy secondary's circuit (failure contagion —
+# the fallback only ever sees starved time slices).
+MIN_VIABLE_ATTEMPT = 5.0
+
+
+class StreamStalledError(Exception):
+    """An SSE relay produced no upstream bytes for longer than the
+    configured idle timeout."""
+
+
+class Resilience:
+    def __init__(self, cfg: Any = None, otel=None, logger=None, clock=None,
+                 rng: random.Random | None = None) -> None:
+        self.enabled = getattr(cfg, "enabled", True)
+        self.otel = otel
+        self.logger = logger
+        self.clock = clock or MonotonicClock()
+        self.rng = rng or random.Random()
+        # The kill switch disables every policy: breakers inert (threshold
+        # below), no retries, no failover (execute truncates), unlimited
+        # budget (DeadlineBudget treats <=0 as no deadline), no SSE idle
+        # guard — upstream calls fall back to the client's own timeouts.
+        self.request_budget = getattr(cfg, "request_budget", 30.0) if self.enabled else 0.0
+        self.stream_idle_timeout = getattr(cfg, "stream_idle_timeout", 60.0) if self.enabled else 0.0
+        self.retry_policy = RetryPolicy(
+            max_attempts=getattr(cfg, "retry_max_attempts", 3) if self.enabled else 1,
+            base_backoff=getattr(cfg, "retry_base_backoff", 0.1),
+            max_backoff=getattr(cfg, "retry_max_backoff", 2.0),
+        )
+        breaker_cfg = BreakerConfig(
+            failure_threshold=getattr(cfg, "breaker_failure_threshold", 5)
+            if self.enabled else (1 << 62),
+            cooldown=getattr(cfg, "breaker_cooldown", 30.0),
+            half_open_max_probes=getattr(cfg, "breaker_half_open_probes", 1),
+        )
+        self.breakers = BreakerRegistry(
+            breaker_cfg, clock=self.clock, on_transition=self._on_transition
+        )
+
+    # -- observability ---------------------------------------------------
+    def _on_transition(self, key: tuple[str, str], old: str, new: str) -> None:
+        provider, model = key
+        if self.logger is not None:
+            self.logger.warn("circuit breaker transition", "provider", provider,
+                             "model", model, "from", old, "to", new)
+        if self.otel is not None:
+            self.otel.record_breaker_transition(provider, model, old, new)
+            self.otel.set_breaker_state(provider, model, STATE_CODES[new])
+
+    def _record_retry(self, provider: str, model: str, reason: str) -> None:
+        if self.otel is not None:
+            self.otel.record_retry(provider, model, reason)
+
+    def _record_failover(self, alias: str, from_provider: str, to_provider: str) -> None:
+        if self.logger is not None:
+            self.logger.info("failing over", "alias", alias,
+                             "from", from_provider, "to", to_provider)
+        if self.otel is not None:
+            self.otel.record_failover(alias, from_provider, to_provider)
+
+    # -- policy helpers --------------------------------------------------
+    def healthy(self, deployment: Any) -> bool:
+        """Health predicate for pool ordering (Deployment-shaped arg)."""
+        return self.breakers.healthy(deployment.provider, deployment.model)
+
+    def new_budget(self, total: float | None = None) -> DeadlineBudget:
+        return DeadlineBudget(self.request_budget if total is None else total,
+                              clock=self.clock)
+
+    @staticmethod
+    def _classify(e: Exception) -> tuple[bool, bool, float | None]:
+        """(retryable, counts_as_breaker_failure, retry_after)."""
+        if isinstance(e, HTTPClientError):
+            return True, True, None
+        if isinstance(e, asyncio.TimeoutError):
+            return True, True, None
+        if isinstance(e, HTTPError):
+            if e.status_code in RETRYABLE_STATUSES:
+                return True, True, getattr(e, "retry_after", None)
+            # Other 4xx are request problems — identical on every
+            # replica, and no evidence the upstream is unhealthy.
+            return False, e.status_code >= 500, None
+        return False, False, None
+
+    # -- the failover/retry loop ----------------------------------------
+    async def execute(
+        self,
+        candidates: list[Any],
+        call: Callable[[Any, DeadlineBudget], Awaitable[Any]],
+        *,
+        budget: DeadlineBudget | None = None,
+        idempotent: bool = True,
+        alias: str = "",
+        result_ok: Callable[[Any], bool] | None = None,
+    ) -> tuple[Any, Any]:
+        """Run ``call`` against the first candidate that works.
+
+        ``candidates`` are Deployment-shaped (``.provider``/``.model``),
+        already ordered healthy-first. Per candidate: up to
+        ``retry_max_attempts`` tries (idempotent calls only) with
+        full-jitter backoff, honoring Retry-After, all inside ``budget``.
+        Breakers gate entry (half-open admits limited probes) and record
+        every outcome. Returns ``(result, served_candidate)``.
+
+        Raises the last upstream error once candidates are exhausted,
+        ``BudgetExceededError`` when the deadline is spent, or
+        ``UpstreamUnavailableError`` when every circuit is open.
+        """
+        if budget is None:
+            budget = self.new_budget()
+        if not self.enabled:
+            candidates = candidates[:1]
+        last_exc: Exception | None = None
+        prev_provider: str | None = None
+        for cand in candidates:
+            breaker = self.breakers.get(cand.provider, cand.model)
+            admitted, took_slot = breaker.admit()
+            if not admitted:
+                continue
+            if prev_provider is not None:
+                self._record_failover(alias, prev_provider, cand.provider)
+            prev_provider = cand.provider
+            attempt = 0
+            # True while an admission that CONSUMED a half-open probe slot
+            # has no recorded outcome yet — released on abnormal exit so a
+            # probe slot can never leak (fuzz-found wedge), and only ever
+            # the slot this request actually took (review-found race).
+            admission_pending = took_slot
+            try:
+                while True:
+                    if budget.expired():
+                        raise BudgetExceededError(
+                            f"deadline budget of {budget.total:g}s exhausted"
+                        ) from last_exc
+                    allotted = budget.remaining()
+                    try:
+                        # The budget is a hard wall for the whole attempt,
+                        # not a per-read allowance: the client applies its
+                        # timeout per connect/read, which a drip-feeding
+                        # upstream evades — this ceiling does not.
+                        coro = call(cand, budget)
+                        result = await (coro if budget.unlimited
+                                        else self.clock.wait_for(coro, allotted))
+                    except BudgetExceededError:
+                        raise
+                    except Exception as e:
+                        retryable, counts_failure, retry_after = self._classify(e)
+                        if (counts_failure and isinstance(e, asyncio.TimeoutError)
+                                and allotted < MIN_VIABLE_ATTEMPT):
+                            # Starved attempt: the deadline, not the
+                            # upstream, is what failed here.
+                            counts_failure = False
+                        if counts_failure:
+                            breaker.record_failure()
+                            admission_pending = False
+                        if not retryable:
+                            raise
+                        last_exc = e
+                        attempt += 1
+                        if not idempotent or attempt >= self.retry_policy.max_attempts:
+                            break  # fail over to the next candidate
+                        admitted, took_slot = breaker.admit()
+                        if not admitted:
+                            break  # circuit opened mid-retry — move on
+                        admission_pending = took_slot
+                        if budget.remaining() <= 0:
+                            raise BudgetExceededError(
+                                f"deadline budget of {budget.total:g}s exhausted"
+                            ) from e
+                        delay = self.retry_policy.backoff(attempt - 1, self.rng, retry_after)
+                        if delay >= budget.remaining():
+                            # Can't afford the wait (e.g. Retry-After past
+                            # the deadline) — fail over to the next
+                            # candidate instead of sleeping or aborting;
+                            # failover costs nothing.
+                            break
+                        self._record_retry(cand.provider, cand.model, type(e).__name__)
+                        await self.clock.sleep(delay)
+                    else:
+                        # ``result_ok`` lets passthrough callers (the
+                        # Messages relay returns upstream errors verbatim
+                        # instead of raising) still feed the breaker: a
+                        # returned 503 is upstream illness even though it
+                        # is not an exception here.
+                        if result_ok is None or result_ok(result):
+                            breaker.record_success()
+                        else:
+                            breaker.record_failure()
+                        admission_pending = False
+                        return result, cand
+            finally:
+                if admission_pending:
+                    breaker.release()
+        if last_exc is not None:
+            if isinstance(last_exc, asyncio.TimeoutError) and budget.expired():
+                # The ceiling cancelled the final attempt: surface it as
+                # the deadline verdict it is (handlers map this to 504).
+                raise BudgetExceededError(
+                    f"deadline budget of {budget.total:g}s exhausted"
+                ) from last_exc
+            raise last_exc
+        raise UpstreamUnavailableError(
+            f"all deployments unavailable (circuit open){' for ' + alias if alias else ''}"
+        )
+
+    # -- stream guarding -------------------------------------------------
+    def guard_stream(self, stream: AsyncIterator[bytes],
+                     idle_timeout: float | None = None) -> AsyncIterator[bytes]:
+        """Wrap an SSE relay iterator with a per-chunk idle timeout: a
+        stalled upstream raises ``StreamStalledError`` instead of holding
+        the downstream connection open forever."""
+        timeout = self.stream_idle_timeout if idle_timeout is None else idle_timeout
+        if not timeout or timeout <= 0:
+            return stream
+
+        async def gen() -> AsyncIterator[bytes]:
+            it = stream.__aiter__()
+            while True:
+                try:
+                    chunk = await self.clock.wait_for(it.__anext__(), timeout)
+                except StopAsyncIteration:
+                    return
+                except asyncio.TimeoutError:
+                    raise StreamStalledError(
+                        f"no upstream bytes for {timeout:g}s — aborting relay")
+                yield chunk
+
+        return gen()

@@ -1,0 +1,47 @@
+"""Retry policy: exponential backoff with full jitter, Retry-After aware.
+
+Full jitter (delay ~ uniform[0, min(cap, base * 2^attempt)]) decorrelates
+retry storms across the fleet; an upstream ``Retry-After`` is honored as a
+floor when it asks for MORE patience than the jittered delay. The RNG is
+injectable so tests pin the schedule with ``random.Random(seed)``.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+# Statuses worth retrying/failing over: throttles and transient server
+# errors. Other 4xx are request problems — identical on every replica.
+RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+@dataclass
+class RetryPolicy:
+    max_attempts: int = 3  # total tries per deployment, first included
+    base_backoff: float = 0.1
+    max_backoff: float = 2.0
+
+    def backoff(self, attempt: int, rng: random.Random,
+                retry_after: float | None = None) -> float:
+        """Sleep before retry number ``attempt`` (0-based)."""
+        cap = min(self.max_backoff, self.base_backoff * (2 ** attempt))
+        delay = rng.uniform(0.0, cap)
+        if retry_after is not None and retry_after > delay:
+            delay = retry_after
+        return delay
+
+
+def retry_after_seconds(headers) -> float | None:
+    """Parse a Retry-After header value (delta-seconds form only; the
+    HTTP-date form is ignored). ``headers`` is any object with ``get``."""
+    if headers is None:
+        return None
+    raw = headers.get("Retry-After")
+    if not raw:
+        return None
+    try:
+        seconds = float(str(raw).strip())
+    except ValueError:
+        return None
+    return seconds if seconds >= 0 else None

@@ -1,0 +1,671 @@
+"""Resilience layer (ISSUE 1): breaker state machine (incl. the
+half-open probe race), failover order under mixed-health pools, backoff
+jitter bounds, deadline-budget exhaustion mid-retry, stalled-SSE timeout,
+and the end-to-end graceful-degradation acceptance scenario — all driven
+through the deterministic fault harness on a virtual clock, with zero
+real-time sleeps."""
+
+import json
+import random
+
+import pytest
+
+from inference_gateway_tpu.config import Config, ResilienceConfig
+from inference_gateway_tpu.netio.client import HTTPClientError
+from inference_gateway_tpu.netio.server import Headers, Request
+from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.providers.core import HTTPError
+from inference_gateway_tpu.providers.registry import ProviderRegistry
+from inference_gateway_tpu.providers.routing import (
+    Deployment,
+    Pool,
+    PoolConfigError,
+    Selector,
+    load_pools_config,
+)
+from inference_gateway_tpu.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerConfig,
+    BreakerRegistry,
+    BudgetExceededError,
+    CircuitBreaker,
+    DeadlineBudget,
+    Fault,
+    FaultInjectingClient,
+    FaultScript,
+    Resilience,
+    RetryPolicy,
+    StreamStalledError,
+    UpstreamUnavailableError,
+    VirtualClock,
+    retry_after_seconds,
+)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker state machine
+# ---------------------------------------------------------------------------
+def test_breaker_opens_after_consecutive_failures():
+    clk = VirtualClock()
+    br = CircuitBreaker(BreakerConfig(failure_threshold=3, cooldown=10.0), clock=clk)
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker(BreakerConfig(failure_threshold=3), clock=VirtualClock())
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED  # 2+2 with a reset in between never opens
+
+
+def test_breaker_half_open_probe_recovers():
+    clk = VirtualClock()
+    br = CircuitBreaker(BreakerConfig(failure_threshold=1, cooldown=10.0), clock=clk)
+    br.record_failure()
+    assert br.state == OPEN and not br.allow()
+    clk.advance(9.9)
+    assert not br.allow()  # still cooling down
+    clk.advance(0.2)
+    assert br.state == HALF_OPEN
+    assert br.allow()  # the probe
+    br.record_success()
+    assert br.state == CLOSED and br.allow()
+
+
+def test_breaker_half_open_probe_failure_reopens_and_rearms_cooldown():
+    clk = VirtualClock()
+    br = CircuitBreaker(BreakerConfig(failure_threshold=1, cooldown=10.0), clock=clk)
+    br.record_failure()
+    clk.advance(10.0)
+    assert br.allow()
+    br.record_failure()  # probe fails
+    assert br.state == OPEN
+    clk.advance(5.0)
+    assert not br.allow()  # cooldown restarted at the probe failure
+    clk.advance(5.0)
+    assert br.allow()
+
+
+def test_breaker_half_open_race_admits_limited_probes():
+    clk = VirtualClock()
+    br = CircuitBreaker(
+        BreakerConfig(failure_threshold=1, cooldown=1.0, half_open_max_probes=1),
+        clock=clk,
+    )
+    br.record_failure()
+    clk.advance(1.0)
+    # Two racers hit the half-open circuit: exactly one probe admitted.
+    assert br.allow()
+    assert not br.allow()
+    br.record_success()
+    assert br.state == CLOSED and br.allow()
+
+
+def test_breaker_transitions_fire_callback():
+    clk = VirtualClock()
+    events = []
+    br = CircuitBreaker(BreakerConfig(failure_threshold=1, cooldown=1.0), clock=clk,
+                        on_transition=lambda old, new: events.append((old, new)))
+    br.record_failure()
+    clk.advance(1.0)
+    br.allow()
+    br.record_success()
+    assert events == [(CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)]
+
+
+def test_breaker_release_prevents_half_open_wedge():
+    """Fuzz-found: an allow() admission with no recorded outcome (budget
+    expired pre-attempt) must give its probe slot back, or the breaker
+    wedges half-open with zero capacity forever."""
+    clk = VirtualClock()
+    br = CircuitBreaker(BreakerConfig(failure_threshold=1, cooldown=1.0,
+                                      half_open_max_probes=1), clock=clk)
+    br.record_failure()
+    clk.advance(1.0)
+    assert br.allow()
+    br.release()  # admission abandoned before any outcome
+    assert br.allow()  # capacity restored — not wedged
+
+
+def test_breaker_registry_peeks_without_creating():
+    reg = BreakerRegistry(BreakerConfig(failure_threshold=1), clock=VirtualClock())
+    assert reg.healthy("openai", "gpt-x")  # never seen → healthy
+    assert reg.snapshot() == {}
+    reg.get("openai", "gpt-x").record_failure()
+    assert not reg.healthy("openai", "gpt-x")
+    assert reg.snapshot() == {("openai", "gpt-x"): OPEN}
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+def test_backoff_full_jitter_bounds():
+    policy = RetryPolicy(max_attempts=5, base_backoff=0.1, max_backoff=2.0)
+    rng = random.Random(7)
+    for attempt in range(7):
+        cap = min(2.0, 0.1 * (2 ** attempt))
+        for _ in range(200):
+            d = policy.backoff(attempt, rng)
+            assert 0.0 <= d <= cap
+
+
+def test_backoff_honors_retry_after_as_floor():
+    policy = RetryPolicy(base_backoff=0.1, max_backoff=2.0)
+    rng = random.Random(7)
+    assert policy.backoff(0, rng, retry_after=5.0) == 5.0  # upstream asked for more patience
+    # A tiny Retry-After never shrinks the jittered delay below itself.
+    for _ in range(50):
+        assert policy.backoff(3, rng, retry_after=0.0) >= 0.0
+
+
+def test_retry_after_seconds_parsing():
+    h = Headers()
+    h.set("Retry-After", "3")
+    assert retry_after_seconds(h) == 3.0
+    h.set("Retry-After", "2.5")
+    assert retry_after_seconds(h) == 2.5
+    h.set("Retry-After", "Wed, 21 Oct 2026 07:28:00 GMT")  # date form ignored
+    assert retry_after_seconds(h) is None
+    h.set("Retry-After", "-1")
+    assert retry_after_seconds(h) is None
+    assert retry_after_seconds(Headers()) is None
+
+
+# ---------------------------------------------------------------------------
+# Deadline budget
+# ---------------------------------------------------------------------------
+def test_budget_decrements_on_virtual_clock():
+    clk = VirtualClock()
+    b = DeadlineBudget(10.0, clock=clk)
+    clk.advance(4.0)
+    assert b.remaining() == pytest.approx(6.0)
+    assert b.timeout(cap=2.0) == pytest.approx(2.0)
+    assert b.timeout() == pytest.approx(6.0)
+    clk.advance(6.5)
+    assert b.expired()
+    with pytest.raises(BudgetExceededError):
+        b.timeout()
+
+
+def test_budget_zero_means_unlimited():
+    """CLIENT_TIMEOUT=0 is the repo's 'no timeout' convention; a budget
+    coupled to it must mean 'no deadline', not 'instant 504'."""
+    clk = VirtualClock()
+    b = DeadlineBudget(0.0, clock=clk)
+    clk.advance(10_000.0)
+    assert not b.expired()
+    assert b.timeout() is None  # caller falls back to its own default
+    assert b.timeout(cap=5.0) == 5.0
+
+
+async def test_disabled_resilience_has_no_budget_or_idle_guard():
+    """RESILIENCE_ENABLED=false is a kill switch for the WHOLE layer:
+    no deadline budgets, no stream idle guard, no retries/failover."""
+    clk = VirtualClock()
+    res = Resilience(ResilienceConfig(enabled=False), clock=clk,
+                     rng=random.Random(0))
+    assert res.new_budget().unlimited
+    assert res.stream_idle_timeout == 0.0
+
+    async def slow_stream():
+        yield b"a"
+        await clk.sleep(10_000.0)  # would trip any idle guard
+        yield b"b"
+
+    got = [c async for c in res.guard_stream(slow_stream())]
+    assert got == [b"a", b"b"]  # passthrough, no guard
+
+    calls = []
+
+    async def call(cand, b):
+        calls.append(cand.provider)
+        raise HTTPClientError("boom")
+
+    with pytest.raises(HTTPClientError):
+        await res.execute([Deployment("a", "m"), Deployment("b", "m")], call)
+    assert calls == ["a"]  # no retry, no failover
+    assert res.breakers.get("a", "m").state == CLOSED  # breaker inert
+
+
+# ---------------------------------------------------------------------------
+# Health-aware pool ordering + satellite pool fixes
+# ---------------------------------------------------------------------------
+def test_pool_cursor_stays_bounded():
+    pool = Pool("p", [Deployment("a", "m"), Deployment("b", "m"), Deployment("c", "m")])
+    seen = []
+    for _ in range(10):
+        seen.append(pool.next().provider)
+        assert 0 <= pool._cursor < 3
+    assert seen[:6] == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_pool_candidates_demote_unhealthy_to_tail():
+    pool = Pool("p", [Deployment("a", "m"), Deployment("b", "m"), Deployment("c", "m")])
+    for _ in range(6):
+        cands = pool.candidates(healthy=lambda d: d.provider != "a")
+        assert [d.provider for d in cands][-1] == "a"  # demoted, never dropped
+        assert len(cands) == 3
+
+
+def test_pool_candidates_all_unhealthy_keeps_full_order():
+    pool = Pool("p", [Deployment("a", "m"), Deployment("b", "m")])
+    cands = pool.candidates(healthy=lambda d: False)
+    assert len(cands) == 2  # last-resort: whole pool still returned
+
+
+def test_selector_candidates_and_select(tmp_path):
+    pools = {"alias": Pool("alias", [Deployment("a", "m1"), Deployment("b", "m2")])}
+    sel = Selector(pools, health=lambda d: d.provider != "a")
+    cands = sel.select_candidates("alias")
+    assert [d.provider for d in cands][0] == "b"
+    assert sel.select("alias").provider == "b"
+    assert sel.select_candidates("nope") is None
+
+
+def test_duplicate_pool_alias_rejected(tmp_path):
+    cfg = tmp_path / "pools.yaml"
+    cfg.write_text("""
+pools:
+  - model: fast
+    deployments:
+      - {provider: ollama, model: a}
+      - {provider: tpu, model: b}
+  - model: fast
+    deployments:
+      - {provider: ollama, model: c}
+      - {provider: tpu, model: d}
+""")
+    with pytest.raises(PoolConfigError, match="duplicate pool alias"):
+        load_pools_config(str(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Fault harness
+# ---------------------------------------------------------------------------
+async def test_fault_client_plays_scripts_in_order():
+    script = FaultScript().script(
+        "/proxy/ollama/", Fault.reset(), Fault.error(429, retry_after=3.0), Fault.ok()
+    )
+    fc = FaultInjectingClient(script)
+    with pytest.raises(HTTPClientError):
+        await fc.get("/proxy/ollama/v1/models")
+    resp = await fc.get("/proxy/ollama/v1/models")
+    assert resp.status == 429
+    assert resp.headers.get("Retry-After") == "3"
+    resp = await fc.get("/proxy/ollama/v1/models")
+    assert resp.status == 200
+    assert script.pending("/proxy/ollama/") == 0
+    assert [kind for _, kind, _ in script.log] == ["reset", "status", "ok"]
+
+
+async def test_fault_client_slow_first_byte_respects_caller_timeout():
+    clk = VirtualClock()
+    script = FaultScript().script("/proxy/x/", Fault.slow_first_byte(10.0))
+    fc = FaultInjectingClient(script, clock=clk)
+    with pytest.raises(HTTPClientError, match="TimeoutError"):
+        await fc.get("/proxy/x/v1/models", timeout=2.0)
+    assert clk.now() == pytest.approx(2.0)  # burned exactly the timeout, virtually
+
+
+# ---------------------------------------------------------------------------
+# Failover / retry / budget orchestration
+# ---------------------------------------------------------------------------
+def _resilience(clk, otel=None, **overrides):
+    cfg = ResilienceConfig(**overrides)
+    return Resilience(cfg, otel=otel, clock=clk, rng=random.Random(42))
+
+
+async def test_execute_fails_over_in_health_order():
+    clk = VirtualClock()
+    res = _resilience(clk)
+    attempts = []
+
+    async def call(cand, b):
+        attempts.append(cand.provider)
+        if cand.provider == "a":
+            raise HTTPClientError("reset (injected)")
+        return "served-" + cand.provider
+
+    result, served = await res.execute(
+        [Deployment("a", "m"), Deployment("b", "m")], call, idempotent=False, alias="x")
+    assert result == "served-b" and served.provider == "b"
+    assert attempts == ["a", "b"]  # non-idempotent: one try each, failover once
+
+
+async def test_execute_retries_idempotent_with_jittered_backoff():
+    clk = VirtualClock()
+    res = _resilience(clk)
+    outcomes = [HTTPClientError("boom"), HTTPError(503, "busy"), "ok"]
+
+    async def call(cand, b):
+        out = outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    result, _ = await res.execute([Deployment("a", "m")], call, idempotent=True)
+    assert result == "ok"
+    assert len(clk.sleeps) == 2  # one backoff per retry
+    assert all(0.0 <= s <= 2.0 for s in clk.sleeps)
+
+
+async def test_execute_honors_retry_after_hint():
+    clk = VirtualClock()
+    res = _resilience(clk)
+    outcomes = [HTTPError(429, "throttled", retry_after=1.5), "ok"]
+
+    async def call(cand, b):
+        out = outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    await res.execute([Deployment("a", "m")], call, idempotent=True)
+    assert clk.sleeps == [1.5]
+
+
+async def test_budget_exhaustion_mid_retry():
+    clk = VirtualClock()
+    res = _resilience(clk, request_budget=2.0)
+
+    async def call(cand, b):
+        await clk.sleep(b.timeout())  # attempt consumes its whole slice
+        raise HTTPClientError("TimeoutError (injected)")
+
+    with pytest.raises(BudgetExceededError):
+        await res.execute([Deployment("a", "m")], call, idempotent=True)
+    assert clk.now() <= 2.0 + 1e-9  # never slept past the budget
+
+
+async def test_unaffordable_backoff_fails_over_instead_of_aborting():
+    """A Retry-After past the deadline must not 504 the request when a
+    healthy replica is one hop away — failover costs no sleep
+    (code-review finding)."""
+    clk = VirtualClock()
+    res = _resilience(clk, request_budget=30.0)
+
+    async def call(cand, b):
+        if cand.provider == "a":
+            raise HTTPError(429, "throttled", retry_after=60.0)
+        return "served-" + cand.provider
+
+    result, served = await res.execute(
+        [Deployment("a", "m"), Deployment("b", "m")], call, idempotent=True)
+    assert result == "served-b"
+    assert clk.sleeps == []  # no sleep was affordable, none was taken
+
+
+async def test_unaffordable_backoff_single_candidate_passes_error_through():
+    """With nowhere to fail over, the upstream's own 429 (with its
+    Retry-After) surfaces — not a synthetic 504."""
+    clk = VirtualClock()
+    res = _resilience(clk, request_budget=30.0)
+
+    async def call(cand, b):
+        raise HTTPError(429, "throttled", retry_after=60.0)
+
+    with pytest.raises(HTTPError) as ei:
+        await res.execute([Deployment("a", "m")], call, idempotent=True)
+    assert ei.value.status_code == 429
+
+
+async def test_result_ok_predicate_feeds_breaker_on_passthrough_errors():
+    """Messages-style passthrough returns upstream 5xx verbatim instead
+    of raising; result_ok still counts them as breaker failures so an
+    HTTP-level outage opens the circuit (code-review finding)."""
+
+    class FakeResp:
+        def __init__(self, status):
+            self.status = status
+
+    clk = VirtualClock()
+    res = _resilience(clk, breaker_failure_threshold=3)
+
+    async def call(cand, b):
+        return FakeResp(503)
+
+    ok = lambda r: r.status < 500 and r.status != 429  # noqa: E731
+    for _ in range(3):
+        resp, _ = await res.execute([Deployment("anthropic", "m")], call,
+                                    idempotent=False, result_ok=ok)
+        assert resp.status == 503  # passthrough preserved
+    assert res.breakers.get("anthropic", "m").state == OPEN
+
+
+async def test_attempt_is_bounded_by_total_budget_not_per_read():
+    """A drip-feeding upstream keeps every per-read timeout alive; the
+    executor's budget ceiling must still cut the attempt (code-review
+    finding: the budget was advisory once bytes flowed)."""
+    clk = VirtualClock()
+    res = _resilience(clk, request_budget=30.0)
+
+    async def drip(cand, b):
+        await clk.sleep(100.0)  # virtual: returns instantly, 100s elapse
+        return "too-late"
+
+    with pytest.raises(BudgetExceededError):
+        await res.execute([Deployment("a", "m")], drip, idempotent=True)
+
+
+async def test_starved_attempt_does_not_charge_fallback_breaker():
+    """A slow primary must not open a healthy secondary's circuit: the
+    fallback's timeout under a near-spent budget is the deadline's fault,
+    not the upstream's (failure contagion, code-review finding)."""
+    clk = VirtualClock()
+    res = _resilience(clk, request_budget=30.0, breaker_failure_threshold=1)
+
+    async def call(cand, b):
+        if cand.provider == "a":
+            await clk.sleep(29.0)  # burns nearly the whole budget
+            raise HTTPClientError("TimeoutError talking to a (injected)")
+        await clk.sleep(5.0)  # healthy B never got a viable slice
+        return "b"
+
+    with pytest.raises(BudgetExceededError):
+        await res.execute([Deployment("a", "m"), Deployment("b", "m")], call,
+                          idempotent=False)
+    assert res.breakers.get("a", "m").state == OPEN  # real offender charged
+    assert res.breakers.get("b", "m").state == CLOSED  # no contagion
+
+
+async def test_execute_raises_unavailable_when_every_circuit_open():
+    clk = VirtualClock()
+    res = _resilience(clk, breaker_failure_threshold=1)
+    res.breakers.get("a", "m").record_failure()
+    res.breakers.get("b", "m").record_failure()
+
+    async def call(cand, b):  # pragma: no cover - never reached
+        raise AssertionError("must not be called")
+
+    with pytest.raises(UpstreamUnavailableError):
+        await res.execute([Deployment("a", "m"), Deployment("b", "m")], call)
+
+
+async def test_execute_does_not_retry_non_retryable_4xx():
+    clk = VirtualClock()
+    res = _resilience(clk)
+    calls = []
+
+    async def call(cand, b):
+        calls.append(cand.provider)
+        raise HTTPError(400, "bad request")
+
+    with pytest.raises(HTTPError):
+        await res.execute([Deployment("a", "m"), Deployment("b", "m")], call)
+    assert calls == ["a"]  # identical on every replica: no retry, no failover
+    assert res.breakers.get("a", "m").state == CLOSED  # 4xx is not upstream illness
+
+
+# ---------------------------------------------------------------------------
+# Stalled-SSE guard
+# ---------------------------------------------------------------------------
+async def test_stalled_sse_stream_times_out_without_real_sleep():
+    clk = VirtualClock()
+    res = _resilience(clk)
+
+    async def stalled():
+        yield b"data: 1\n\n"
+        await clk.sleep(120.0)  # upstream goes silent (virtually)
+        yield b"data: 2\n\n"
+
+    got = []
+    with pytest.raises(StreamStalledError):
+        async for chunk in res.guard_stream(stalled(), idle_timeout=5.0):
+            got.append(chunk)
+    assert got == [b"data: 1\n\n"]
+
+
+async def test_guard_stream_passes_healthy_stream_through():
+    clk = VirtualClock()
+    res = _resilience(clk)
+
+    async def healthy():
+        for i in range(3):
+            await clk.sleep(1.0)
+            yield b"data: %d\n\n" % i
+
+    got = [c async for c in res.guard_stream(healthy(), idle_timeout=5.0)]
+    assert len(got) == 3
+
+
+# ---------------------------------------------------------------------------
+# Handler-level: list-models partial failure annotation
+# ---------------------------------------------------------------------------
+def _make_router(script, pools=None, env=None, otel=None, clk=None):
+    from inference_gateway_tpu.api.routes import RouterImpl
+
+    clk = clk or VirtualClock()
+    cfg = Config.load(env or {})
+    registry = ProviderRegistry(
+        {pid: cfg.providers[pid] for pid in ("ollama", "tpu")})
+    res = Resilience(cfg.resilience, otel=otel, clock=clk, rng=random.Random(0))
+    selector = Selector(pools, health=res.healthy) if pools else None
+    client = FaultInjectingClient(script, clock=clk)
+    return RouterImpl(cfg, registry, client, otel=otel, selector=selector,
+                      resilience=res), res, clk
+
+
+def _get(path: str, query=None) -> Request:
+    return Request(method="GET", path=path, query=query or {}, headers=Headers(), body=b"")
+
+
+def _post_chat(model: str) -> Request:
+    body = {"model": model, "messages": [{"role": "user", "content": "x"}]}
+    return Request(method="POST", path="/v1/chat/completions", query={},
+                   headers=Headers(), body=json.dumps(body).encode())
+
+
+async def test_list_models_surfaces_failed_providers():
+    script = (FaultScript()
+              .default("/proxy/ollama/", Fault.reset())
+              .default("/proxy/tpu/", Fault.ok({"object": "list",
+                                                "data": [{"id": "test-tiny"}]})))
+    router, _, _ = _make_router(script)
+    resp = await router.list_models_handler(_get("/v1/models"))
+    assert resp.status == 200
+    data = json.loads(resp.body)
+    assert [m["id"] for m in data["data"]] == ["tpu/test-tiny"]
+    failed = data["failed_providers"]
+    assert len(failed) == 1
+    assert failed[0]["provider"] == "ollama"
+    # Sanitized category only — no hosts/ports/exception classes leak.
+    assert failed[0]["error"] == "unreachable"
+
+
+async def test_list_models_omits_annotation_when_all_healthy():
+    ok = Fault.ok({"object": "list", "data": [{"id": "m"}]})
+    script = FaultScript().default("/proxy/ollama/", ok).default("/proxy/tpu/", ok)
+    router, _, _ = _make_router(script)
+    resp = await router.list_models_handler(_get("/v1/models"))
+    data = json.loads(resp.body)
+    assert "failed_providers" not in data
+
+
+async def test_list_models_single_provider_retries_transient_failures():
+    script = FaultScript().script(
+        "/proxy/tpu/",
+        Fault.error(503, retry_after=0.5),
+        Fault.ok({"object": "list", "data": [{"id": "test-tiny"}]}),
+    )
+    router, _, clk = _make_router(script)
+    resp = await router.list_models_handler(_get("/v1/models", {"provider": ["tpu"]}))
+    assert resp.status == 200
+    assert clk.sleeps == [0.5]  # one Retry-After-honoring backoff, virtual
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: end-to-end graceful degradation through the chat handler
+# ---------------------------------------------------------------------------
+async def test_pool_failover_breaker_recovery_end_to_end():
+    """Pool [A=ollama, B=tpu]; A scripted to fail 5× then recover. Every
+    request succeeds (failing over to B while A's breaker is open, probing
+    and restoring A after cooldown), with transitions, retries, and
+    failovers visible in otel — deterministically, zero real sleeps."""
+    otel = OpenTelemetry()
+    clk = VirtualClock()
+    pools = {"fast-model": Pool("fast-model",
+                                [Deployment("ollama", "model-a"),
+                                 Deployment("tpu", "model-b")])}
+    script = (FaultScript()
+              .script("/proxy/ollama/", *[Fault.reset()] * 5)
+              .default("/proxy/ollama/", Fault.ok(dict(
+                  json.loads(json.dumps(__import__(
+                      "inference_gateway_tpu.resilience.faults",
+                      fromlist=["OK_CHAT_BODY"]).OK_CHAT_BODY)), model="model-a")))
+              .default("/proxy/tpu/", Fault.ok()))
+    router, res, clk = _make_router(script, pools=pools, otel=otel, clk=clk)
+
+    served_by = []
+    for _ in range(6):
+        resp = await router.chat_completions_handler(_post_chat("fast-model"))
+        assert resp.status == 200
+        served_by.append(resp.headers.get("X-Selected-Provider"))
+
+    # A's 5 scripted failures are consumed across attempts; its breaker is
+    # open and every request has been served (by B when A was failing).
+    breaker = res.breakers.get("ollama", "model-a")
+    assert breaker.state == OPEN
+    assert script.pending("/proxy/ollama/") == 0
+    assert all(p in ("ollama", "tpu") for p in served_by)
+    assert "tpu" in served_by  # failover actually happened
+
+    # While open, every request lands on B without touching A.
+    for _ in range(3):
+        resp = await router.chat_completions_handler(_post_chat("fast-model"))
+        assert resp.status == 200
+        assert resp.headers.get("X-Selected-Provider") == "tpu"
+
+    # Cooldown elapses (virtually) → half-open probe → A recovers.
+    clk.advance(31.0)
+    recovered = []
+    for _ in range(4):
+        resp = await router.chat_completions_handler(_post_chat("fast-model"))
+        assert resp.status == 200
+        recovered.append(resp.headers.get("X-Selected-Provider"))
+    assert breaker.state == CLOSED
+    assert "ollama" in recovered  # A is serving again
+
+    # Observability: transitions, retries, and failovers all recorded.
+    transitions = otel.breaker_transition_counter._values
+    key = lambda old, new: ("ollama", "model-a", old, new)  # noqa: E731
+    assert transitions[key(CLOSED, OPEN)] >= 1
+    assert transitions[key(OPEN, HALF_OPEN)] >= 1
+    assert transitions[key(HALF_OPEN, CLOSED)] >= 1
+    assert sum(otel.failover_counter._values.values()) >= 1
+    assert sum(otel.retry_counter._values.values()) >= 1
+    expo = otel.expose_prometheus()
+    assert "inference_gateway_resilience_breaker_transitions" in expo
+    assert "inference_gateway_resilience_breaker_state" in expo
+    # Zero real sleeps: every backoff landed on the virtual clock.
+    assert clk.sleeps, "backoffs should have been recorded virtually"

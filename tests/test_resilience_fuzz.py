@@ -1,0 +1,123 @@
+"""Seeded property test (ISSUE 1 satellite): 1k randomized fault scripts
+driven through a 3-deployment pool. Invariants:
+
+1. **Deadline**: no request's virtual elapsed time ever exceeds its
+   deadline budget — retries and failovers re-divide the deadline, they
+   never extend it.
+2. **No healthy skip**: when a request fails outright (not by deadline),
+   every deployment that was healthy (circuit not open) at request start
+   was actually attempted — the failover walk never silently skips a
+   viable replica.
+
+Pure stdlib ``random.Random(seed)`` (no hypothesis), virtual clock, zero
+real sleeps — tier-1 fast.
+"""
+
+import random
+
+from inference_gateway_tpu.config import ResilienceConfig
+from inference_gateway_tpu.netio.client import HTTPClientError
+from inference_gateway_tpu.providers.core import HTTPError
+from inference_gateway_tpu.providers.routing import Deployment, Pool
+from inference_gateway_tpu.resilience import (
+    BudgetExceededError,
+    Resilience,
+    UpstreamUnavailableError,
+    VirtualClock,
+)
+
+SEED = 20260803
+TRIALS = 1000
+
+
+def _random_fault(rng: random.Random):
+    r = rng.random()
+    if r < 0.35:
+        return ("ok", 0.0)
+    if r < 0.55:
+        return ("reset", 0.0)
+    if r < 0.70:
+        return ("s503", rng.choice([None, round(rng.uniform(0.0, 3.0), 3)]))
+    if r < 0.80:
+        return ("s429", round(rng.uniform(0.0, 5.0), 3))
+    return ("slow", round(rng.uniform(0.5, 40.0), 3))
+
+
+async def _run_trials() -> None:
+    rng = random.Random(SEED)
+    successes = failures = deadline_hits = 0
+    for trial in range(TRIALS):
+        clk = VirtualClock()
+        cfg = ResilienceConfig(
+            breaker_failure_threshold=rng.choice([1, 2, 3, 5]),
+            breaker_cooldown=round(rng.uniform(5.0, 60.0), 3),
+            breaker_half_open_probes=1,
+            retry_max_attempts=rng.choice([1, 2, 3]),
+            retry_base_backoff=0.1,
+            retry_max_backoff=2.0,
+            request_budget=round(rng.uniform(0.5, 20.0), 3),
+        )
+        res = Resilience(cfg, clock=clk, rng=random.Random(trial))
+        pool = Pool("alias", [Deployment(p, "m") for p in ("a", "b", "c")])
+
+        for _ in range(rng.randint(1, 6)):
+            attempted: list[str] = []
+            healthy_at_start = {
+                d.provider for d in pool.deployments if res.healthy(d)
+            }
+            budget = res.new_budget()
+
+            async def call(cand, b, rng=rng, attempted=attempted, clk=clk):
+                attempted.append(cand.provider)
+                kind, arg = _random_fault(rng)
+                timeout = b.timeout()  # budget-derived, like the handlers
+                if kind == "ok":
+                    return cand.provider
+                if kind == "reset":
+                    raise HTTPClientError("ConnectionResetError (injected)")
+                if kind == "s503":
+                    raise HTTPError(503, "unavailable", retry_after=arg)
+                if kind == "s429":
+                    raise HTTPError(429, "throttled", retry_after=arg)
+                # slow: upstream stalls for `arg`s; the caller's timeout
+                # fires first when smaller — burning that much budget.
+                await clk.sleep(min(arg, timeout))
+                if arg >= timeout:
+                    raise HTTPClientError("TimeoutError (injected slow upstream)")
+                return cand.provider
+
+            candidates = pool.candidates(healthy=res.healthy)
+            start = clk.now()
+            outcome = "ok"
+            try:
+                await res.execute(candidates, call, budget=budget,
+                                  idempotent=True, alias="alias")
+                successes += 1
+            except BudgetExceededError:
+                deadline_hits += 1
+                outcome = "deadline"
+            except (UpstreamUnavailableError, HTTPError, HTTPClientError):
+                failures += 1
+                outcome = "failed"
+            elapsed = clk.now() - start
+
+            # Invariant 1: the deadline budget is a hard wall.
+            assert elapsed <= budget.total + 1e-9, (
+                f"trial {trial}: elapsed {elapsed:.3f}s exceeded "
+                f"budget {budget.total:.3f}s"
+            )
+            # Invariant 2: a non-deadline failure means every deployment
+            # healthy at request start was attempted.
+            if outcome == "failed":
+                assert healthy_at_start <= set(attempted), (
+                    f"trial {trial}: healthy {sorted(healthy_at_start)} "
+                    f"but only attempted {sorted(set(attempted))}"
+                )
+
+    # The mix must actually exercise all three outcomes.
+    assert successes > 0 and failures > 0 and deadline_hits > 0, (
+        successes, failures, deadline_hits)
+
+
+def test_fuzz_1k_fault_scripts_hold_invariants(aloop):
+    aloop.run(_run_trials())
