@@ -166,6 +166,69 @@ async def bench_sse_relay_concurrent(streams: int = 32, n_chunks: int = 500) -> 
     }
 
 
+async def bench_overload(streams: int = 64, cap: int = 16, queue: int = 8,
+                         n_chunks: int = 200) -> dict:
+    """Offered load above the admission cap (ISSUE 2): goodput, shed
+    rate, and p99 completion latency under saturation — the regression
+    surface for the overload-protection layer. Admitted streams must all
+    finish; excess must be fast 429s, never hangs or 5xxs."""
+    from inference_gateway_tpu.netio.server import StreamingResponse
+
+    async def chat(req: Request) -> Response:
+        async def chunks():
+            frame = b'data: {"choices":[{"delta":{"content":"x"},"index":0}]}\n\n'
+            for _ in range(n_chunks):
+                yield frame
+            yield b"data: [DONE]\n\n"
+        return StreamingResponse.sse(chunks())
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    up_port = await upstream.start("127.0.0.1", 0)
+    gw = build_gateway(env={
+        "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+        "SERVER_PORT": "0",
+        "OVERLOAD_MAX_CONCURRENT_STREAMING": str(cap),
+        "OVERLOAD_QUEUE_DEPTH_STREAMING": str(queue),
+        "OVERLOAD_QUEUE_TIMEOUT": "30s",
+    })
+    port = await gw.start("127.0.0.1", 0)
+    body = json.dumps({"model": "ollama/m", "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]}).encode()
+
+    async def one_stream() -> tuple[str, float]:
+        client = HTTPClient()
+        t0 = time.perf_counter()
+        resp = await client.post(
+            f"http://127.0.0.1:{port}/v1/chat/completions", body, stream=True)
+        async for _ in resp.iter_raw():
+            pass
+        if resp.status == 200:
+            return "ok", time.perf_counter() - t0
+        if resp.status == 429:
+            return "shed", time.perf_counter() - t0
+        return "error", time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    results = await asyncio.gather(*[one_stream() for _ in range(streams)])
+    wall = time.perf_counter() - t0
+    ok = sorted(lat for kind, lat in results if kind == "ok")
+    shed = [lat for kind, lat in results if kind == "shed"]
+    errors = sum(1 for kind, _ in results if kind == "error")
+    await gw.shutdown()
+    await upstream.shutdown()
+    return {
+        "bench": f"overload_{streams}_offered_cap_{cap}",
+        "goodput_streams_per_sec": round(len(ok) / wall, 1),
+        "shed_rate": round(len(shed) / streams, 3),
+        "errors": errors,
+        "p99_completion_ms": round(ok[min(len(ok) - 1, int(len(ok) * 0.99))] * 1000, 1) if ok else None,
+        "p99_shed_ms": round(sorted(shed)[min(len(shed) - 1, int(len(shed) * 0.99))] * 1000, 1) if shed else None,
+        "streams": streams,
+    }
+
+
 async def main() -> None:
     results = [
         await bench_chat_completions(),
@@ -173,6 +236,7 @@ async def main() -> None:
         await bench_sse_relay(),
         await bench_sse_relay_concurrent(),
         await bench_sse_relay_concurrent(streams=128, n_chunks=200),
+        await bench_overload(),
     ]
     for r in results:
         print(json.dumps(r))

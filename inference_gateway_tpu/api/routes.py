@@ -96,6 +96,7 @@ class RouterImpl:
         mcp_agent=None,
         selector: routing.Selector | None = None,
         resilience: Resilience | None = None,
+        overload=None,
     ) -> None:
         self.cfg = cfg
         self.registry = registry
@@ -108,6 +109,9 @@ class RouterImpl:
         self.resilience = resilience or Resilience(
             getattr(cfg, "resilience", None), otel=otel, logger=self.logger
         )
+        # Admission/drain ledger (ISSUE 2): the health handler consults
+        # it so LBs see readiness fail the moment a drain begins.
+        self.overload = overload
 
     # -- wiring --------------------------------------------------------
     def build_router(self) -> Router:
@@ -138,6 +142,11 @@ class RouterImpl:
 
     # -- handlers ------------------------------------------------------
     async def healthcheck_handler(self, req: Request) -> Response:
+        if self.overload is not None and self.overload.draining:
+            # Readiness flip (ISSUE 2 graceful drain): the listener is
+            # still open so in-flight streams can finish, but the LB
+            # must stop routing new traffic here.
+            return Response.json({"message": "draining"}, status=503)
         return Response.json({"message": "OK"})
 
     async def not_found_handler(self, req: Request) -> Response:

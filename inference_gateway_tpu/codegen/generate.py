@@ -52,6 +52,35 @@ _RESILIENCE_FAILURE_MODES = [
 ]
 
 
+# Emitted under the Overload section of Configurations.md: shed-order
+# table + LB readiness semantics (ISSUE 2 satellite).
+_OVERLOAD_DRAIN_DOC = [
+    "### Overload & drain",
+    "",
+    "Admission control caps in-flight work per endpoint class and bounds the",
+    "wait queue; excess is rejected with `429` + `Retry-After` computed from",
+    "the observed per-class service time (monotone in the backlog). When any",
+    "wait queue crosses `OVERLOAD_SHED_HIGH_WATER` — or a registered",
+    "serving-engine depth probe crosses `OVERLOAD_ENGINE_DEPTH_HIGH_WATER` —",
+    "the lowest-priority work is shed first with a sanitized `503`.",
+    "",
+    "Shed order (first shed to never shed):",
+    "",
+    "| Priority | Endpoints | Under overload | During drain |",
+    "|---|---|---|---|",
+    "| batch (shed first) | `GET /v1/models`, `GET /v1/mcp/tools`, `/proxy/*`, everything else | `503` shed | `503` + `Connection: close` |",
+    "| interactive | `POST /v1/chat/completions`, `/v1/responses`, `/v1/messages` | queued up to the cap, then `429` + `Retry-After` | `503` + `Connection: close` |",
+    "| critical (never shed) | `GET /health`, `GET /metrics`, `POST /v1/metrics` | always served | always served |",
+    "",
+    "LB readiness semantics: on SIGTERM the gateway flips readiness —",
+    "`GET /health` returns `503 {\"message\": \"draining\"}` while the listener",
+    "stays open. New non-critical requests are rejected fast; in-flight",
+    "requests (including SSE streams, whose admission ticket is held until",
+    "the last chunk) get `DRAIN_DEADLINE` to finish before sockets close.",
+    "",
+]
+
+
 def generate_configurations_md(spec: dict) -> str:
     out = [
         "# Configurations",
@@ -71,6 +100,8 @@ def generate_configurations_md(spec: dict) -> str:
         out.append("")
         if section == "resilience":
             out.extend(_RESILIENCE_FAILURE_MODES)
+        elif section == "overload":
+            out.extend(_OVERLOAD_DRAIN_DOC)
     out.append("## Providers")
     out.append("")
     out.append("| Provider | `<ID>_API_URL` default | Auth |")
@@ -273,6 +304,16 @@ def check_config_defaults(spec: dict) -> list[str]:
         "RESILIENCE_RETRY_MAX_BACKOFF": cfg.resilience.retry_max_backoff,
         "RESILIENCE_REQUEST_BUDGET": cfg.resilience.request_budget,
         "RESILIENCE_STREAM_IDLE_TIMEOUT": cfg.resilience.stream_idle_timeout,
+        "OVERLOAD_ENABLED": cfg.overload.enabled,
+        "OVERLOAD_MAX_CONCURRENT_STREAMING": cfg.overload.max_concurrent_streaming,
+        "OVERLOAD_MAX_CONCURRENT_BUFFERED": cfg.overload.max_concurrent_buffered,
+        "OVERLOAD_QUEUE_DEPTH_STREAMING": cfg.overload.queue_depth_streaming,
+        "OVERLOAD_QUEUE_DEPTH_BUFFERED": cfg.overload.queue_depth_buffered,
+        "OVERLOAD_QUEUE_TIMEOUT": cfg.overload.queue_timeout,
+        "OVERLOAD_SHED_HIGH_WATER": cfg.overload.shed_high_water,
+        "OVERLOAD_ENGINE_DEPTH_HIGH_WATER": cfg.overload.engine_depth_high_water,
+        "DRAIN_DEADLINE": cfg.overload.drain_deadline,
+        "DRAIN_RETRY_AFTER": cfg.overload.drain_retry_after,
     }
     problems = []
     seen = set()

@@ -41,6 +41,13 @@ def _get_int(env: Mapping[str, str], key: str, default: int) -> int:
     return int(raw)
 
 
+def _get_float(env: Mapping[str, str], key: str, default: float) -> float:
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    return float(raw)
+
+
 def _get_duration(env: Mapping[str, str], key: str, default: str) -> float:
     return parse_duration(env.get(key) or default)
 
@@ -222,6 +229,44 @@ class ResilienceConfig:
 
 
 @dataclass
+class OverloadConfig:
+    """OVERLOAD_* / DRAIN_* — admission control, priority load shedding,
+    and graceful drain (ISSUE 2). Caps and queue depths are per endpoint
+    class: "streaming" covers the chat-shaped generation endpoints whose
+    responses hold slots for whole SSE streams; "buffered" covers
+    everything else. ``shed_high_water`` is the fraction of a wait
+    queue's capacity at which batch-priority work is shed;
+    ``engine_depth_high_water`` (0 = off) does the same against a
+    registered serving-engine scheduler depth probe."""
+
+    enabled: bool = True
+    max_concurrent_streaming: int = 128
+    max_concurrent_buffered: int = 256
+    queue_depth_streaming: int = 64
+    queue_depth_buffered: int = 128
+    queue_timeout: float = 5.0
+    shed_high_water: float = 0.5
+    engine_depth_high_water: int = 0
+    drain_deadline: float = 30.0
+    drain_retry_after: float = 1.0
+
+    @classmethod
+    def load(cls, env: Mapping[str, str]) -> "OverloadConfig":
+        return cls(
+            enabled=_get_bool(env, "OVERLOAD_ENABLED", True),
+            max_concurrent_streaming=_get_int(env, "OVERLOAD_MAX_CONCURRENT_STREAMING", 128),
+            max_concurrent_buffered=_get_int(env, "OVERLOAD_MAX_CONCURRENT_BUFFERED", 256),
+            queue_depth_streaming=_get_int(env, "OVERLOAD_QUEUE_DEPTH_STREAMING", 64),
+            queue_depth_buffered=_get_int(env, "OVERLOAD_QUEUE_DEPTH_BUFFERED", 128),
+            queue_timeout=_get_duration(env, "OVERLOAD_QUEUE_TIMEOUT", "5s"),
+            shed_high_water=_get_float(env, "OVERLOAD_SHED_HIGH_WATER", 0.5),
+            engine_depth_high_water=_get_int(env, "OVERLOAD_ENGINE_DEPTH_HIGH_WATER", 0),
+            drain_deadline=_get_duration(env, "DRAIN_DEADLINE", "30s"),
+            drain_retry_after=_get_duration(env, "DRAIN_RETRY_AFTER", "1s"),
+        )
+
+
+@dataclass
 class RoutingConfig:
     """ROUTING_* (config.go:98-101)."""
 
@@ -253,6 +298,7 @@ class Config:
     client: ClientConfig = field(default_factory=ClientConfig)
     routing: RoutingConfig = field(default_factory=RoutingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     providers: dict[str, ProviderConfig] = field(default_factory=dict)
 
     @classmethod
@@ -275,6 +321,7 @@ class Config:
             client=ClientConfig.load(env),
             routing=RoutingConfig.load(env),
             resilience=ResilienceConfig.load(env),
+            overload=OverloadConfig.load(env),
         )
         if not env.get("RESILIENCE_REQUEST_BUDGET"):
             # Follow the operator's upstream timeout unless the budget is

@@ -14,12 +14,31 @@ import json
 import sys
 import threading
 import time
+import weakref
 from typing import Any, TextIO
 
 
 def _is_test_mode() -> bool:
     argv0 = sys.argv[0] if sys.argv else ""
     return "pytest" in argv0 or "py.test" in argv0 or "pytest" in sys.modules
+
+
+# One module-level exit hook over a WeakSet instead of a per-instance
+# atexit.register(self.flush): the latter pins every Logger for process
+# lifetime, so short-lived loggers (per-test) were never collectable and
+# each could leave a daemon flusher thread behind (ADVICE round 5).
+_live_loggers: "weakref.WeakSet[Logger]" = weakref.WeakSet()
+
+
+def _flush_all_loggers() -> None:
+    for logger in list(_live_loggers):
+        try:
+            logger.flush()
+        except Exception:
+            pass
+
+
+atexit.register(_flush_all_loggers)
 
 
 class Logger:
@@ -42,7 +61,10 @@ class Logger:
         self._buf_bytes = 0
         self._wake = threading.Event()
         self._flusher: threading.Thread | None = None
-        atexit.register(self.flush)
+        _live_loggers.add(self)
+        # Wake the flusher when the logger is collected so the thread can
+        # observe the dead weakref and exit instead of parking forever.
+        weakref.finalize(self, self._wake.set)
 
     # -- core ------------------------------------------------------------
     def _kv(self, args: tuple[Any, ...]) -> dict[str, Any]:
@@ -68,8 +90,11 @@ class Logger:
                 self._flush_locked()
                 return
             if self._flusher is None:
+                # The thread holds only a weakref + the wake event, so a
+                # collected logger's flusher exits rather than pinning it.
                 self._flusher = threading.Thread(
-                    target=self._flush_loop, name="logger-flush", daemon=True)
+                    target=Logger._flush_loop, args=(weakref.ref(self), self._wake),
+                    name="logger-flush", daemon=True)
                 self._flusher.start()
         self._wake.set()
 
@@ -89,12 +114,21 @@ class Logger:
         with self._lock:
             self._flush_locked()
 
-    def _flush_loop(self) -> None:
+    @staticmethod
+    def _flush_loop(ref: "weakref.ref[Logger]", wake: threading.Event) -> None:
         while True:
-            self._wake.wait()
-            self._wake.clear()
-            time.sleep(self.FLUSH_INTERVAL)
-            self.flush()
+            wake.wait()
+            wake.clear()
+            logger = ref()
+            if logger is None:
+                return
+            del logger  # don't pin the logger through the sleep
+            time.sleep(Logger.FLUSH_INTERVAL)
+            logger = ref()
+            if logger is None:
+                return
+            logger.flush()
+            del logger  # release before parking in wait()
 
     # -- public API (logger.go:12-17) ------------------------------------
     def info(self, msg: str, *args: Any) -> None:

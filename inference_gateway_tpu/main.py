@@ -27,7 +27,7 @@ from inference_gateway_tpu.netio.server import HTTPServer, Request, Router
 from inference_gateway_tpu.otel import OpenTelemetry
 from inference_gateway_tpu.providers import routing
 from inference_gateway_tpu.providers.registry import ProviderRegistry
-from inference_gateway_tpu.resilience import Resilience
+from inference_gateway_tpu.resilience import OverloadController, Resilience, admission_middleware
 from inference_gateway_tpu.version import APPLICATION_NAME, VERSION
 
 
@@ -44,6 +44,7 @@ class Gateway:
     api_server: HTTPServer
     metrics_server: HTTPServer | None = None
     mcp_client: Any = None
+    overload: OverloadController | None = None
     port: int = 0
     metrics_port: int = 0
     _tasks: list[asyncio.Task] = field(default_factory=list)
@@ -85,11 +86,21 @@ class Gateway:
                 self.logger.warn("provider validation failed", "provider", pid, "error", str(e))
 
     async def shutdown(self) -> None:
+        """Graceful drain (ISSUE 2): readiness flips first (health 503s,
+        new work rejected fast by the admission middleware), then the
+        listener stays open while in-flight requests — including SSE
+        streams — finish within DRAIN_DEADLINE, and only then are
+        sockets torn down."""
         for t in self._tasks:
             t.cancel()
+        if self.overload is not None:
+            self.overload.begin_drain()
         if self.mcp_client is not None:
             await self.mcp_client.shutdown()
-        await self.api_server.shutdown()
+        await self.api_server.shutdown(
+            drain=self.cfg.overload.drain_deadline if self.overload is not None else 0.0,
+            ledger=self.overload,
+        )
         if self.metrics_server is not None:
             await self.metrics_server.shutdown()
         self.logger.info("gateway stopped")
@@ -135,6 +146,11 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     # ordering) and every handler (failover/retry/deadline budgets).
     resilience = Resilience(cfg.resilience, otel=otel, logger=logger)
 
+    # Overload protection (ISSUE 2): one admission ledger per gateway —
+    # the admission middleware, the health handler (readiness), and
+    # shutdown (graceful drain) all coordinate through it.
+    overload = OverloadController(cfg.overload, otel=otel, logger=logger)
+
     selector = None
     if cfg.routing.enabled:
         if not cfg.routing.config_path:
@@ -154,12 +170,14 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     router_impl = RouterImpl(
         cfg, registry, client, logger=logger, otel=otel,
         mcp_client=mcp_client, mcp_agent=mcp_agent, selector=selector,
-        resilience=resilience,
+        resilience=resilience, overload=overload,
     )
 
-    # Middleware order matters (main.go:238-254): tracing → logger →
-    # telemetry → auth → mcp. MCP must be last.
-    middlewares = []
+    # Middleware order matters (main.go:238-254): admission first — a
+    # shed request must cost nothing downstream (no span, no log line,
+    # no auth round trip) — then tracing → logger → telemetry → auth →
+    # mcp. MCP must be last.
+    middlewares = [admission_middleware(overload, logger)]
     if otel is not None and cfg.telemetry.tracing_enable:
         middlewares.append(tracing_middleware(otel.tracer))
     middlewares.append(logger_middleware(logger))
@@ -192,7 +210,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     return Gateway(
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
         router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
-        mcp_client=mcp_client,
+        mcp_client=mcp_client, overload=overload,
     )
 
 
@@ -205,7 +223,9 @@ async def run() -> None:
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
-    await asyncio.wait_for(gw.shutdown(), timeout=5.0)
+    # Shutdown is drain-aware: allow the configured drain window plus a
+    # margin for socket teardown before giving up.
+    await asyncio.wait_for(gw.shutdown(), timeout=gw.cfg.overload.drain_deadline + 10.0)
 
 
 def main() -> None:

@@ -35,6 +35,14 @@ static int hexval(unsigned char c) {
     return -1;
 }
 
+/* Python's bytes.strip() whitespace set — the twin strips the size field
+ * with it, so the C side must trim the identical set (space, \t, \n, \r,
+ * \v, \f), not just space/tab (ADVICE round 5). */
+static int is_ws(unsigned char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+           c == '\v' || c == '\f';
+}
+
 static PyObject *parse_chunked(PyObject *self, PyObject *args) {
     const char *buf;
     Py_ssize_t len, maxp;
@@ -60,12 +68,13 @@ static PyObject *parse_chunked(PyObject *self, PyObject *args) {
         /* Parse "<ws><hex><ws>[;ext]" — exactly int(split(';')[0].strip(), 16),
          * with "" parsing as 0. */
         Py_ssize_t p = pos, q = i;
-        while (p < q && (buf[p] == ' ' || buf[p] == '\t')) p++;
+        while (p < q && is_ws((unsigned char)buf[p])) p++;
         Py_ssize_t semi = p;
         while (semi < q && buf[semi] != ';') semi++;
         Py_ssize_t e = semi;
-        while (e > p && (buf[e - 1] == ' ' || buf[e - 1] == '\t')) e--;
+        while (e > p && is_ws((unsigned char)buf[e - 1])) e--;
         Py_ssize_t size = 0;
+        int oversize = 0;
         if (e == p) {
             size = 0; /* empty size field */
         } else {
@@ -78,13 +87,20 @@ static PyObject *parse_chunked(PyObject *self, PyObject *args) {
                     return NULL;
                 }
                 if (size > (PY_SSIZE_T_MAX >> 4)) {
-                    Py_DECREF(out);
-                    PyErr_SetString(PyExc_ValueError, "chunk size overflow");
-                    return NULL;
+                    /* The Python twin's arbitrary-precision int parses any
+                     * hex size and then treats size > len as an incomplete
+                     * chunk; mirror that for sizes that would overflow
+                     * Py_ssize_t instead of raising (ADVICE round 5) —
+                     * still bounded BEFORE the `need` arithmetic, so a
+                     * hostile size line can never reach the memcpy. */
+                    oversize = 1;
+                    break;
                 }
                 size = (size << 4) | v;
             }
         }
+        if (oversize)
+            break; /* can never complete inside this buffer */
 
         if (size == 0) {
             done = 1;

@@ -294,6 +294,12 @@ class HTTPClient:
         """Dispatch a self-addressed request straight through the wired
         server's router + middleware chain — no socket, no HTTP framing."""
         hdrs = self._normalize_headers(headers, self.self_host, self.self_port)
+        # Mirror the headers the TCP path always sets, so middleware and
+        # handlers observe an identical request whichever way the /proxy
+        # hop dispatches (ADVICE round 5).
+        hdrs.set("Content-Length", str(len(body)))
+        if self.config.disable_compression:
+            hdrs.set("Accept-Encoding", "identity")
         req = ServerRequest(
             method=method.upper(),
             path=unquote(split.path or "/"),

@@ -83,6 +83,13 @@ class Response:
     status: int = 200
     headers: Headers = field(default_factory=Headers)
     body: bytes = b""
+    # Invoked by the server after the response bytes were written (or the
+    # write failed): the admission middleware parks its ticket here so a
+    # buffered response counts as in-flight until it actually left the
+    # socket — otherwise graceful drain could close the connection
+    # mid-write (code-review ISSUE 2 round). Streaming bodies don't need
+    # it; their ticket rides the chunk generator's finally.
+    on_sent: Callable[[], None] | None = None
 
     @classmethod
     def json(cls, obj: Any, status: int = 200) -> "Response":
@@ -220,8 +227,17 @@ class HTTPServer:
                                                   ssl=ssl_ctx, backlog=1024)
         return self._server.sockets[0].getsockname()[1]
 
-    async def shutdown(self) -> None:
+    async def shutdown(self, drain: float = 0.0, ledger=None) -> None:
+        """Stop serving. With a drain window (``drain`` seconds and an
+        admission ``ledger`` — OverloadController-shaped, exposing
+        ``wait_idle``), the listener stays open while in-flight requests
+        finish: new work is already being rejected by the admission
+        middleware, the LB sees readiness failing, and sockets are only
+        torn down once the ledger is idle or the deadline expires —
+        instead of abandoning mid-stream connections (ISSUE 2)."""
         if self._server:
+            if ledger is not None and drain > 0:
+                await ledger.wait_idle(drain)
             self._server.close()
             for writer in list(self._conns):
                 try:
@@ -248,7 +264,19 @@ class HTTPServer:
                 first = False
                 keep_alive = (req.headers.get("Connection", "keep-alive") or "").lower() != "close"
                 resp = await self._dispatch(req)
-                clean = await self._write_response(writer, resp, keep_alive)
+                # A handler/middleware can demand connection teardown
+                # (drain rejections set Connection: close so LBs stop
+                # reusing a socket the listener is about to close).
+                if (resp.headers.get("Connection") or "").lower() == "close":
+                    keep_alive = False
+                try:
+                    clean = await self._write_response(writer, resp, keep_alive)
+                finally:
+                    if resp.on_sent is not None:
+                        try:
+                            resp.on_sent()
+                        except Exception:
+                            pass
                 # A chunked stream is cleanly delimited by its terminal
                 # chunk, so the connection is reusable afterwards exactly
                 # like a Content-Length response — closing here forced a
@@ -398,6 +426,17 @@ class HTTPServer:
                 clean = False
                 raise
             finally:
+                # Close the chunk generator NOW (not at GC time): the
+                # wrapper stack's finallys — admission-ticket release,
+                # telemetry usage scan — must run promptly, or graceful
+                # drain would wait out its whole deadline on a stream
+                # whose client already disconnected.
+                aclose = getattr(resp.chunks, "aclose", None)
+                if aclose is not None:
+                    try:
+                        await aclose()
+                    except Exception:
+                        pass
                 try:
                     writer.write(b"0\r\n\r\n")
                     await asyncio.wait_for(writer.drain(), timeout=self.write_timeout)

@@ -97,6 +97,29 @@ class OpenTelemetry:
             "Mid-request failovers to another pool deployment",
             ("alias", "from_provider", "to_provider"), unit="{failover}",
         )
+        # Overload-protection instruments (ISSUE 2): admission ledger
+        # gauges plus shed/drain counters, extending the PR 1 breaker
+        # dashboards to self-inflicted saturation.
+        self.overload_in_flight_gauge = r.gauge(
+            "inference_gateway.overload.in_flight",
+            "Admitted in-flight requests per endpoint class",
+            ("endpoint_class",),
+        )
+        self.overload_queue_gauge = r.gauge(
+            "inference_gateway.overload.queue_depth",
+            "Admission wait-queue depth per endpoint class",
+            ("endpoint_class",),
+        )
+        self.overload_shed_counter = r.counter(
+            "inference_gateway.overload.shed",
+            "Requests rejected by admission control (cap, shed, drain)",
+            ("endpoint_class", "priority", "reason"), unit="{request}",
+        )
+        self.drain_counter = r.counter(
+            "inference_gateway.overload.drain_events",
+            "Graceful-drain lifecycle events (begun/completed/timed_out)",
+            ("phase",), unit="{event}",
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -155,6 +178,21 @@ class OpenTelemetry:
         self.failover_counter.add(1, {
             "alias": alias, "from_provider": from_provider, "to_provider": to_provider,
         })
+
+    # -- overload protection (ISSUE 2) -----------------------------------
+    def set_overload_in_flight(self, endpoint_class: str, value: int) -> None:
+        self.overload_in_flight_gauge.set(value, {"endpoint_class": endpoint_class})
+
+    def set_overload_queue_depth(self, endpoint_class: str, value: int) -> None:
+        self.overload_queue_gauge.set(value, {"endpoint_class": endpoint_class})
+
+    def record_overload_shed(self, endpoint_class: str, priority: str, reason: str) -> None:
+        self.overload_shed_counter.add(1, {
+            "endpoint_class": endpoint_class, "priority": priority, "reason": reason,
+        })
+
+    def record_drain_event(self, phase: str) -> None:
+        self.drain_counter.add(1, {"phase": phase})
 
     def expose_prometheus(self) -> str:
         return self.registry.expose()
@@ -296,4 +334,16 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_failover(self, *a, **k) -> None:
+        pass
+
+    def set_overload_in_flight(self, *a, **k) -> None:
+        pass
+
+    def set_overload_queue_depth(self, *a, **k) -> None:
+        pass
+
+    def record_overload_shed(self, *a, **k) -> None:
+        pass
+
+    def record_drain_event(self, *a, **k) -> None:
         pass

@@ -1,7 +1,8 @@
 """Resilience layer: circuit breakers, health-aware failover, retry with
 jittered backoff, deadline budgets, and a deterministic fault-injection
 harness (ISSUE 1 tentpole; STREAM/TPI-LLM treat failure-masking as a
-first-class middleware concern)."""
+first-class middleware concern) — plus overload protection: admission
+control, priority load shedding, and graceful drain (ISSUE 2)."""
 
 from inference_gateway_tpu.resilience.breaker import (
     CLOSED,
@@ -20,6 +21,20 @@ from inference_gateway_tpu.resilience.manager import (
     StreamStalledError,
     UpstreamUnavailableError,
 )
+from inference_gateway_tpu.resilience.overload import (
+    CLASS_BUFFERED,
+    CLASS_CONTROL,
+    CLASS_STREAMING,
+    PRIORITY_BATCH,
+    PRIORITY_CRITICAL,
+    PRIORITY_INTERACTIVE,
+    AdmissionRejectedError,
+    OverloadController,
+    ServiceTimeEstimator,
+    Ticket,
+    admission_middleware,
+    classify_request,
+)
 from inference_gateway_tpu.resilience.retry import (
     RETRYABLE_STATUSES,
     RetryPolicy,
@@ -34,4 +49,8 @@ __all__ = [
     "Fault", "FaultInjectingClient", "FaultScript",
     "Resilience", "StreamStalledError", "UpstreamUnavailableError",
     "RETRYABLE_STATUSES", "RetryPolicy", "retry_after_seconds",
+    "CLASS_BUFFERED", "CLASS_CONTROL", "CLASS_STREAMING",
+    "PRIORITY_BATCH", "PRIORITY_CRITICAL", "PRIORITY_INTERACTIVE",
+    "AdmissionRejectedError", "OverloadController", "ServiceTimeEstimator",
+    "Ticket", "admission_middleware", "classify_request",
 ]

@@ -193,6 +193,17 @@ class Resilience:
                         attempt += 1
                         if not idempotent or attempt >= self.retry_policy.max_attempts:
                             break  # fail over to the next candidate
+                        if admission_pending:
+                            # The prior attempt consumed a probe slot but
+                            # recorded no outcome (a starved timeout is not
+                            # charged as a failure above): give that slot
+                            # back BEFORE re-admitting, or the overwrite of
+                            # admission_pending below leaks it and — with
+                            # half_open_max_probes > 1 — can wedge the
+                            # breaker half-open with zero probe capacity
+                            # (code-review ISSUE 2 round).
+                            breaker.release()
+                            admission_pending = False
                         admitted, took_slot = breaker.admit()
                         if not admitted:
                             break  # circuit opened mid-retry — move on

@@ -1,0 +1,421 @@
+"""Overload protection: admission control, priority load shedding, and
+graceful drain (ISSUE 2 tentpole).
+
+PR 1 made the gateway survive *upstream* failure; this module makes it
+survive its own saturation — the dual-channel backpressure concern STREAM
+solves for multi-tier token streaming (PAPERS.md). Three policies, all
+driven through the same injectable clock as the rest of the resilience
+package so tests run on a virtual clock with zero real sleeps:
+
+- **Admission control** — a per-endpoint-class (streaming generation vs.
+  buffered) in-flight concurrency cap plus a bounded wait queue. Excess
+  is rejected with 429 + ``Retry-After`` computed from the observed
+  per-class service time EWMA, monotone in the backlog.
+- **Priority load shedding** — requests are classified
+  critical (health/metrics) > interactive (chat-shaped generation) >
+  batch (list-models, tools, proxy). When any wait queue crosses its
+  high-water mark — or a registered engine depth probe crosses
+  ``engine_depth_high_water`` — batch work is shed first with a
+  sanitized 503.
+- **Graceful drain** — ``begin_drain()`` flips readiness (the health
+  handler reports 503 so LBs stop routing), fails queued waiters, and
+  rejects new non-critical work fast; ``wait_idle()`` lets in-flight
+  requests (including SSE streams, whose admission ticket is released
+  only when the stream finishes) complete within the drain deadline
+  before the listener closes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from collections import deque
+from typing import Any, Callable
+
+from inference_gateway_tpu.resilience.clock import MonotonicClock
+
+# Shed order: higher value is shed first. Critical is never shed — a
+# drain or overload that silenced /health would blind the LB exactly
+# when it must reroute.
+PRIORITY_CRITICAL = 0
+PRIORITY_INTERACTIVE = 1
+PRIORITY_BATCH = 2
+PRIORITY_NAMES = {
+    PRIORITY_CRITICAL: "critical",
+    PRIORITY_INTERACTIVE: "interactive",
+    PRIORITY_BATCH: "batch",
+}
+
+# Endpoint classes: generation endpoints hold slots for whole streams
+# (seconds to minutes); buffered endpoints turn around in milliseconds.
+# Separate ledgers keep a burst of one from starving the other.
+CLASS_CONTROL = "control"
+CLASS_STREAMING = "streaming"
+CLASS_BUFFERED = "buffered"
+
+_CONTROL_PATHS = frozenset({"/health", "/metrics", "/v1/metrics"})
+_GENERATION_PATHS = frozenset({"/v1/chat/completions", "/v1/responses", "/v1/messages"})
+
+
+def classify_request(method: str, path: str) -> tuple[str, int]:
+    """(endpoint class, shed priority) for a request line."""
+    if path in _CONTROL_PATHS:
+        return CLASS_CONTROL, PRIORITY_CRITICAL
+    if method.upper() == "POST" and path in _GENERATION_PATHS:
+        return CLASS_STREAMING, PRIORITY_INTERACTIVE
+    return CLASS_BUFFERED, PRIORITY_BATCH
+
+
+class AdmissionRejectedError(Exception):
+    """A request was refused admission (cap, shed, or drain)."""
+
+    def __init__(self, status: int, message: str, retry_after: float,
+                 reason: str, endpoint_class: str, priority: int) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.retry_after = retry_after
+        self.reason = reason
+        self.endpoint_class = endpoint_class
+        self.priority = priority
+
+    def to_response(self):
+        """Sanitized client response: category + Retry-After, no
+        internals (queue lengths, caps, class names stay server-side)."""
+        from inference_gateway_tpu.netio.server import Response
+
+        resp = Response.json({"error": self.message}, status=self.status)
+        resp.headers.set("Retry-After", str(max(1, int(math.ceil(self.retry_after)))))
+        if self.reason == "draining":
+            # LBs should stop reusing this connection: the listener is
+            # about to close.
+            resp.headers.set("Connection", "close")
+        return resp
+
+
+class ServiceTimeEstimator:
+    """EWMA of observed request service time → Retry-After estimates.
+
+    One implementation shared by the gateway's admission ledger and the
+    serving sidecar's saturation shed, so the backoff policy can never
+    drift between the two layers."""
+
+    def __init__(self, alpha: float = 0.2, default: float = 1.0) -> None:
+        self.alpha = alpha
+        self.default = default
+        self.ewma = 0.0
+        self.samples = 0
+
+    def observe(self, seconds: float) -> None:
+        if seconds < 0:
+            return
+        self.ewma = (seconds if self.samples == 0
+                     else (1.0 - self.alpha) * self.ewma + self.alpha * seconds)
+        self.samples += 1
+
+    def per_request(self) -> float:
+        return self.ewma if self.samples else self.default
+
+    def retry_after(self, backlog: int, parallelism: int) -> float:
+        """Expected seconds until capacity frees: per-request service
+        time × backlog ahead of the caller, per parallel slot — monotone
+        in the backlog, never less than 1s."""
+        return max(1.0, math.ceil(
+            self.per_request() * max(1, backlog) / max(1, parallelism)))
+
+
+class _ClassState:
+    """One endpoint class's admission ledger."""
+
+    def __init__(self, name: str, cap: int, queue_cap: int) -> None:
+        self.name = name
+        self.cap = max(1, int(cap))
+        self.queue_cap = max(0, int(queue_cap))
+        self.in_flight = 0
+        self.waiters: deque[asyncio.Future] = deque()
+        self.service = ServiceTimeEstimator()
+
+
+class Ticket:
+    """An admission: holds one in-flight slot until released. Release is
+    idempotent — middleware finallys and error paths may both fire."""
+
+    __slots__ = ("_controller", "_state", "_t0", "_released")
+
+    def __init__(self, controller: "OverloadController", state: _ClassState | None,
+                 t0: float) -> None:
+        self._controller = controller
+        self._state = state
+        self._t0 = t0
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        if self._state is None:
+            return
+        ctrl = self._controller
+        st = self._state
+        # Observed service time feeds the Retry-After hint.
+        st.service.observe(ctrl.clock.now() - self._t0)
+        ctrl._release_slot(st)
+
+
+class OverloadController:
+    """The admission ledger ``netio``, ``api``, and ``main`` coordinate
+    through. Single-event-loop discipline (like the rest of the gateway):
+    no locks, every mutation happens on the serving loop."""
+
+    def __init__(self, cfg: Any = None, otel=None, logger=None, clock=None) -> None:
+        self.enabled = getattr(cfg, "enabled", True)
+        self.otel = otel
+        self.logger = logger
+        self.clock = clock or MonotonicClock()
+        self.queue_timeout = getattr(cfg, "queue_timeout", 5.0)
+        self.shed_high_water = getattr(cfg, "shed_high_water", 0.5)
+        self.engine_depth_high_water = getattr(cfg, "engine_depth_high_water", 0)
+        self.drain_deadline = getattr(cfg, "drain_deadline", 30.0)
+        self.drain_retry_after = getattr(cfg, "drain_retry_after", 1.0)
+        self._classes: dict[str, _ClassState] = {
+            CLASS_STREAMING: _ClassState(
+                CLASS_STREAMING,
+                getattr(cfg, "max_concurrent_streaming", 128),
+                getattr(cfg, "queue_depth_streaming", 64)),
+            CLASS_BUFFERED: _ClassState(
+                CLASS_BUFFERED,
+                getattr(cfg, "max_concurrent_buffered", 256),
+                getattr(cfg, "queue_depth_buffered", 128)),
+        }
+        # External saturation signals (e.g. a co-hosted serving engine's
+        # scheduler queue depth); consulted by the shed check.
+        self._depth_probes: list[Callable[[], int]] = []
+        self.draining = False
+        self._idle_event = asyncio.Event()
+
+    # -- observability -------------------------------------------------
+    def _set_gauges(self, st: _ClassState) -> None:
+        if self.otel is not None:
+            self.otel.set_overload_in_flight(st.name, st.in_flight)
+            self.otel.set_overload_queue_depth(st.name, len(st.waiters))
+
+    def _record_shed(self, endpoint_class: str, priority: int, reason: str) -> None:
+        if self.logger is not None:
+            self.logger.warn("request shed", "class", endpoint_class,
+                             "priority", PRIORITY_NAMES.get(priority, str(priority)),
+                             "reason", reason)
+        if self.otel is not None:
+            self.otel.record_overload_shed(
+                endpoint_class, PRIORITY_NAMES.get(priority, str(priority)), reason)
+
+    def _record_drain(self, phase: str) -> None:
+        if self.logger is not None:
+            self.logger.info("drain", "phase", phase,
+                             "in_flight", self.total_in_flight())
+        if self.otel is not None:
+            self.otel.record_drain_event(phase)
+
+    # -- introspection -------------------------------------------------
+    def total_in_flight(self) -> int:
+        return sum(st.in_flight for st in self._classes.values())
+
+    def queue_depth(self, endpoint_class: str) -> int:
+        return len(self._classes[endpoint_class].waiters)
+
+    def in_flight(self, endpoint_class: str) -> int:
+        return self._classes[endpoint_class].in_flight
+
+    def add_depth_probe(self, probe: Callable[[], int]) -> None:
+        """Register an engine saturation signal (e.g. a scheduler's
+        ``queue_depth``); compared against ``engine_depth_high_water``."""
+        self._depth_probes.append(probe)
+
+    def overloaded(self) -> bool:
+        """High-water check driving the shed decision: any admission
+        queue past its mark, or any engine depth probe past its own."""
+        for st in self._classes.values():
+            if st.queue_cap > 0 and len(st.waiters) >= max(
+                    1, math.ceil(st.queue_cap * self.shed_high_water)):
+                return True
+        if self.engine_depth_high_water > 0:
+            for probe in self._depth_probes:
+                try:
+                    if probe() >= self.engine_depth_high_water:
+                        return True
+                except Exception:
+                    continue  # a broken probe must never take the gateway down
+        return False
+
+    def estimate_retry_after(self, endpoint_class: str) -> float:
+        """Monotone in the wait-queue length, so a deepening burst tells
+        clients to back off progressively longer."""
+        st = self._classes[endpoint_class]
+        return st.service.retry_after(len(st.waiters) + 1, st.cap)
+
+    # -- admission -----------------------------------------------------
+    async def admit(self, endpoint_class: str, priority: int) -> Ticket:
+        """Admit or reject one request. Returns a Ticket that MUST be
+        released when the response (including a streamed body) is done;
+        raises AdmissionRejectedError otherwise."""
+        if endpoint_class == CLASS_CONTROL or priority <= PRIORITY_CRITICAL:
+            # Control-plane traffic is never capped, queued, or counted:
+            # health polls during drain must not hold shutdown open.
+            return Ticket(self, None, 0.0)
+        if self.draining:
+            self._record_shed(endpoint_class, priority, "draining")
+            raise AdmissionRejectedError(
+                503, "Service is draining for shutdown. Please retry.",
+                self.drain_retry_after, "draining", endpoint_class, priority)
+        st = self._classes[endpoint_class]
+        if not self.enabled:
+            # Kill switch: no caps/queue/shed, but in-flight accounting
+            # stays on — graceful drain is a shutdown correctness
+            # property, not an overload policy.
+            st.in_flight += 1
+            self._set_gauges(st)
+            return Ticket(self, st, self.clock.now())
+        if priority >= PRIORITY_BATCH and self.overloaded():
+            self._record_shed(endpoint_class, priority, "shed")
+            raise AdmissionRejectedError(
+                503, "Server overloaded. Please retry later.",
+                self.estimate_retry_after(endpoint_class), "shed",
+                endpoint_class, priority)
+        if st.in_flight < st.cap:
+            st.in_flight += 1
+            self._set_gauges(st)
+            return Ticket(self, st, self.clock.now())
+        if len(st.waiters) >= st.queue_cap:
+            self._record_shed(endpoint_class, priority, "capacity")
+            raise AdmissionRejectedError(
+                429, "Too many requests. Please retry later.",
+                self.estimate_retry_after(endpoint_class), "capacity",
+                endpoint_class, priority)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        st.waiters.append(fut)
+        self._set_gauges(st)
+        t_enqueued = self.clock.now()
+        try:
+            await self.clock.wait_for(fut, self.queue_timeout)
+        except asyncio.TimeoutError:
+            if fut in st.waiters:
+                st.waiters.remove(fut)
+            elif fut.done() and not fut.cancelled() and fut.exception() is None:
+                # Race: a releaser handed us the slot in the same tick
+                # the timeout fired — give it back (or it leaks forever).
+                self._release_slot(st)
+            self._set_gauges(st)
+            self._record_shed(endpoint_class, priority, "queue_timeout")
+            raise AdmissionRejectedError(
+                429, "Too many requests. Please retry later.",
+                self.estimate_retry_after(endpoint_class), "queue_timeout",
+                endpoint_class, priority) from None
+        # Admitted via slot handover: the releaser kept in_flight counted
+        # for us, so the ticket's clock starts at enqueue time (queue wait
+        # is part of the service the client observed).
+        self._set_gauges(st)
+        return Ticket(self, st, t_enqueued)
+
+    def _release_slot(self, st: _ClassState) -> None:
+        """Return one slot: hand it to the oldest live waiter, else
+        decrement in-flight (and wake the drain waiter at zero)."""
+        while st.waiters:
+            fut = st.waiters.popleft()
+            if not fut.done():
+                fut.set_result(True)
+                self._set_gauges(st)
+                return
+        st.in_flight = max(0, st.in_flight - 1)
+        self._set_gauges(st)
+        # Wake the drain waiter on EVERY decrement (not just at zero):
+        # wait_idle re-checks and re-arms, and a deadline overrun is only
+        # observable at a wakeup when time is virtual.
+        self._idle_event.set()
+
+    # -- graceful drain ------------------------------------------------
+    def begin_drain(self) -> None:
+        """SIGTERM entry point: flip readiness, fail queued waiters,
+        reject all new non-critical work. Idempotent."""
+        if self.draining:
+            return
+        self.draining = True
+        self._record_drain("begun")
+        for st in self._classes.values():
+            while st.waiters:
+                fut = st.waiters.popleft()
+                if not fut.done():
+                    self._record_shed(st.name, PRIORITY_INTERACTIVE, "draining")
+                    fut.set_exception(AdmissionRejectedError(
+                        503, "Service is draining for shutdown. Please retry.",
+                        self.drain_retry_after, "draining", st.name,
+                        PRIORITY_INTERACTIVE))
+            self._set_gauges(st)
+        if self.total_in_flight() == 0:
+            self._idle_event.set()
+
+    async def wait_idle(self, deadline: float | None = None) -> bool:
+        """Block until every admitted request has released its ticket, or
+        the drain deadline expires. True when fully drained."""
+        deadline = self.drain_deadline if deadline is None else deadline
+        start = self.clock.now()
+        while self.total_in_flight() > 0:
+            remaining = deadline - (self.clock.now() - start)
+            if remaining <= 0:
+                self._record_drain("timed_out")
+                return False
+            self._idle_event.clear()
+            try:
+                await self.clock.wait_for(self._idle_event.wait(), remaining)
+            except asyncio.TimeoutError:
+                self._record_drain("timed_out")
+                return False
+        self._record_drain("completed")
+        return True
+
+
+def admission_middleware(overload: OverloadController, logger=None):
+    """Outermost middleware: admission is decided before any other work
+    (tracing, logging, auth) is spent on a request that will be shed.
+
+    In-process self-dispatch (the provider layer's /proxy double hop,
+    ``client=("inprocess", 0)``) bypasses admission: the edge request
+    already holds a ticket, and re-admitting the inner hop could deadlock
+    the very request the slot was granted to."""
+    from inference_gateway_tpu.netio.server import StreamingResponse
+
+    async def middleware(req, nxt):
+        if req.client is not None and req.client[0] == "inprocess":
+            return await nxt(req)
+        endpoint_class, priority = classify_request(req.method, req.path)
+        try:
+            ticket = await overload.admit(endpoint_class, priority)
+        except AdmissionRejectedError as e:
+            return e.to_response()
+        try:
+            resp = await nxt(req)
+        except BaseException:
+            ticket.release()
+            raise
+        if isinstance(resp, StreamingResponse) and resp.chunks is not None:
+            # The slot is held for the whole stream: release only when
+            # the body finishes (or the connection dies) — that is what
+            # lets graceful drain wait for in-flight SSE streams.
+            inner = resp.chunks
+
+            async def guarded():
+                try:
+                    async for chunk in inner:
+                        yield chunk
+                finally:
+                    ticket.release()
+
+            resp.chunks = guarded()
+        else:
+            # Buffered bodies stay in-flight until the server has written
+            # them: releasing at handler-return would let a drain close
+            # the socket mid-write. Release is idempotent, so the server
+            # failing before on_sent (connection error) is also safe —
+            # _handle_conn invokes on_sent in a finally.
+            resp.on_sent = ticket.release
+        return resp
+
+    return middleware

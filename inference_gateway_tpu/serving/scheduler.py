@@ -28,6 +28,17 @@ from inference_gateway_tpu.serving.engine import Engine
 TokenCallback = Callable[[int, float, bool, str | None], None]
 
 
+class SchedulerSaturatedError(RuntimeError):
+    """The scheduler's bounded wait queue is full: the caller must shed
+    (429 + Retry-After at the serving edge) instead of queueing
+    unboundedly — an unbounded deque under sustained overload grows until
+    every queued client has long since timed out (ISSUE 2)."""
+
+    def __init__(self, queue_depth: int) -> None:
+        super().__init__(f"scheduler queue full ({queue_depth} waiting)")
+        self.queue_depth = queue_depth
+
+
 @dataclass
 class GenRequest:
     prompt_ids: list[int]
@@ -115,11 +126,14 @@ class _PendingPrefill:
 
 
 class Scheduler:
-    def __init__(self, engine: Engine, logger=None):
+    def __init__(self, engine: Engine, logger=None, max_queue_depth: int = 0):
         from inference_gateway_tpu.logger import NoopLogger
 
         self.engine = engine
         self.logger = logger or NoopLogger()
+        # Bounded admission (0 = unbounded): submit raises
+        # SchedulerSaturatedError past this many waiting requests.
+        self.max_queue_depth = max_queue_depth
         self._waiting: deque[GenRequest] = deque()
         self._slots: dict[int, _SlotState] = {}
         self._free = list(range(engine.config.max_slots))
@@ -161,6 +175,8 @@ class Scheduler:
         if len(req.prompt_ids) > limit:
             req.prompt_ids = req.prompt_ids[-limit:]
         with self._wake:
+            if self.max_queue_depth and len(self._waiting) >= self.max_queue_depth:
+                raise SchedulerSaturatedError(len(self._waiting))
             self._waiting.append(req)
             self.queue_depth = len(self._waiting)
             self._wake.notify()

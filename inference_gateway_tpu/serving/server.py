@@ -31,22 +31,29 @@ from typing import Any
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio import sse
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.resilience.overload import ServiceTimeEstimator
 from inference_gateway_tpu.serving.engine import Engine, EngineConfig
-from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
+from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler, SchedulerSaturatedError
 from inference_gateway_tpu.serving.tokenizer import DetokenizeState
 
 
 class SidecarServer:
     def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
                  served_model_name: str | None = None, logger: Logger | None = None,
-                 metrics_push_url: str | None = None, metrics_push_interval: float = 15.0):
+                 metrics_push_url: str | None = None, metrics_push_interval: float = 15.0,
+                 max_queue_depth: int = 0):
         self.engine = engine
         self.logger = logger or new_logger()
         # The scheduler's failure paths log through this logger —
         # without it a recurring _admit/_release bug would be invisible
         # in the deployed sidecar (round-3 review finding).
-        self.scheduler = scheduler or Scheduler(engine, logger=self.logger)
+        self.scheduler = scheduler or Scheduler(engine, logger=self.logger,
+                                                max_queue_depth=max_queue_depth)
         self._own_scheduler = scheduler is None
+        # Observed per-request service time → Retry-After hints when the
+        # scheduler queue saturates (ISSUE 2; same estimator as the
+        # gateway's admission ledger so the policy can't drift).
+        self._service = ServiceTimeEstimator()
         self.model_name = served_model_name or engine.config.model
         self.created = int(time.time())
         self._started = time.monotonic()
@@ -84,6 +91,12 @@ class SidecarServer:
         await self.http.shutdown()
         if self._own_scheduler:
             self.scheduler.stop()
+
+    def depth_probe(self) -> int:
+        """Engine saturation signal for a co-hosted gateway's
+        OverloadController.add_depth_probe (ISSUE 2 priority shedding:
+        gateway sheds batch work when the engine queue backs up)."""
+        return self.scheduler.queue_depth
 
     # -- OTLP metrics push ---------------------------------------------
     def record_ttft(self, seconds: float) -> None:
@@ -320,11 +333,22 @@ class SidecarServer:
         gen.callback = cb
         want_logprobs = bool(body.get("logprobs"))
 
+        # Bounded admission: a full scheduler queue sheds with 429 +
+        # Retry-After derived from observed service time and backlog —
+        # BEFORE any SSE headers go out (ISSUE 2).
+        try:
+            self.scheduler.submit(gen)
+        except SchedulerSaturatedError:
+            resp = Response.json(
+                {"error": "Engine is saturated. Please retry later."}, status=429)
+            resp.headers.set("Retry-After", str(self._retry_after_hint()))
+            return resp
+
         if stream:
-            return StreamingResponse.sse(self._stream_chunks(gen, meta, q, include_usage))
+            return StreamingResponse.sse(
+                self._stream_chunks(gen, meta, q, include_usage, arrival))
 
         # Non-streaming: drain the queue to completion.
-        self.scheduler.submit(gen)
         detok = DetokenizeState()
         completion_tokens = 0
         reason = "stop"
@@ -339,6 +363,7 @@ class SidecarServer:
             if finished:
                 reason = fin_reason or "stop"
                 break
+        self._observe_service(time.monotonic() - arrival)
         text, reason = self._apply_stop_strings(detok.emitted, meta["stop_strings"], reason)
         choice: dict[str, Any] = {
             "index": 0,
@@ -367,9 +392,20 @@ class SidecarServer:
                 return text[: text.index(s)], "stop"
         return text, reason
 
-    async def _stream_chunks(self, gen: GenRequest, meta: dict[str, Any], q: asyncio.Queue, include_usage: bool):
-        """OpenAI chat.completion.chunk SSE frames off the decode loop."""
-        self.scheduler.submit(gen)
+    def _observe_service(self, seconds: float) -> None:
+        self._service.observe(seconds)
+
+    def _retry_after_hint(self) -> int:
+        """Seconds until a shed client should retry: observed request
+        service time × backlog per decode slot."""
+        backlog = self.scheduler.queue_depth + self.scheduler.active_requests() + 1
+        return int(self._service.retry_after(backlog, self.engine.config.max_slots))
+
+    async def _stream_chunks(self, gen: GenRequest, meta: dict[str, Any], q: asyncio.Queue,
+                             include_usage: bool, arrival: float):
+        """OpenAI chat.completion.chunk SSE frames off the decode loop.
+        The request is already submitted (admission happens in
+        chat_completions, where saturation can still become a 429)."""
 
         def chunk(delta: dict[str, Any], finish: str | None) -> bytes:
             return sse.format_event({
@@ -412,6 +448,7 @@ class SidecarServer:
                 reason = fin_reason or "stop"
                 break
 
+        self._observe_service(time.monotonic() - arrival)
         yield chunk({}, reason)
         if include_usage:
             yield sse.format_event({

@@ -1,0 +1,63 @@
+"""Logger lifecycle (ADVICE round 5): the module-level atexit hook over a
+WeakSet replaces per-instance atexit.register, so short-lived loggers are
+collectable and their flusher threads exit instead of leaking."""
+
+import gc
+import io
+import time
+import weakref
+
+from inference_gateway_tpu import logger as logger_mod
+from inference_gateway_tpu.logger import Logger
+
+
+def test_short_lived_logger_is_collectable():
+    lg = Logger("production", stream=io.StringIO())
+    lg.warn("sync path", "k", "v")  # warn flushes synchronously: no thread
+    assert lg._flusher is None
+    ref = weakref.ref(lg)
+    del lg
+    gc.collect()
+    assert ref() is None  # atexit no longer pins the instance
+
+
+def test_module_exit_hook_flushes_live_loggers():
+    buf = io.StringIO()
+    lg = Logger("production", stream=buf)
+    lg.info("buffered line")  # info is buffered, not yet written
+    logger_mod._flush_all_loggers()
+    assert "buffered line" in buf.getvalue()
+
+
+def test_weakset_shrinks_when_logger_dies():
+    before = len(logger_mod._live_loggers)
+    lg = Logger("production", stream=io.StringIO())
+    assert len(logger_mod._live_loggers) == before + 1
+    del lg
+    gc.collect()
+    assert len(logger_mod._live_loggers) == before
+
+
+def test_flusher_thread_exits_after_logger_collected():
+    buf = io.StringIO()
+    lg = Logger("production", stream=buf)
+    lg.info("spawn the flusher")
+    thread = lg._flusher
+    assert thread is not None and thread.is_alive()
+    # Let the pending flush land so the thread parks in wait().
+    deadline = time.monotonic() + 5.0
+    while "spawn the flusher" not in buf.getvalue():
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    wake = lg._wake
+    ref = weakref.ref(lg)
+    del lg
+    # The thread holds only a weakref; once the logger is collected the
+    # finalizer wakes it and it observes the dead ref and returns.
+    deadline = time.monotonic() + 5.0
+    while thread.is_alive():
+        assert time.monotonic() < deadline, "flusher thread leaked"
+        gc.collect()
+        wake.set()
+        thread.join(timeout=0.05)
+    assert ref() is None

@@ -102,6 +102,30 @@ def test_iter_raw_uses_whichever_parser_identically(aloop):
 
 
 @needs_native
+def test_whitespace_and_overflow_shapes_identical():
+    """ADVICE round 5: the C twin must trim the FULL ASCII whitespace set
+    (bytes.strip semantics, not just space/tab) and treat >=2^59 size
+    lines exactly like Python's arbitrary-precision parser (incomplete
+    chunk — break, don't raise)."""
+    shapes = [
+        b"\v5\r\n01234\r\n",            # leading \v padding
+        b"\x0c5\r\n01234\r\n",          # leading \f padding
+        b"5\v\r\n01234\r\n",            # trailing \v padding
+        b"\n5 \r\n01234\r\n",           # mixed \n + space padding
+        b" \v ; ext\r\n",               # all-whitespace field + extension
+        b"FFFFFFFFFFFFFFFF\r\nAAAA",    # 2^64-1: incomplete in both twins
+        b"8000000000000000\r\nAAAA",    # 2^63: first digit past the guard
+        b"FFFFFFFFFFFFFFFFFF\r\nAAAA",  # 18 digits, far past Py_ssize_t
+    ]
+    for buf in shapes:
+        assert framing.parse_chunked(buf, 65536) == _parse_chunked_py(buf, 65536), buf
+    # The whitespace-padded well-formed shapes actually parse payloads.
+    assert framing.parse_chunked(b"\v5\r\n01234\r\n", 65536) == (b"01234", 11, 0)
+    # Oversized size lines are an incomplete tail, not an error.
+    assert framing.parse_chunked(b"FFFFFFFFFFFFFFFFFF\r\nAAAA", 65536) == (b"", 0, 0)
+
+
+@needs_native
 def test_hostile_inputs_safe_and_identical():
     """Near-PY_SSIZE_T_MAX sizes must not overflow the C parser's bounds
     math (code-review round 5: verified SIGSEGV before the guard), and

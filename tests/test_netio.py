@@ -110,3 +110,36 @@ async def test_iter_raw_eof_at_chunk_boundary_tolerated():
     out = await _collect(resp)
     assert b"".join(out) == b"ok"
     assert not resp._drained  # unclean close → not poolable
+
+
+async def test_inprocess_dispatch_headers_match_tcp_path():
+    """ADVICE round 5: the in-process self-dispatch must present the same
+    request headers the TCP path always sets (Content-Length,
+    Accept-Encoding), so middleware behaves identically either way."""
+    from inference_gateway_tpu.netio.client import HTTPClient
+    from inference_gateway_tpu.netio.server import HTTPServer, Response, Router
+
+    captured = []
+
+    async def echo(req):
+        captured.append({k.lower(): v for k, v in req.headers.items()})
+        return Response.json({"ok": True})
+
+    r = Router()
+    r.post("/echo", echo)
+    server = HTTPServer(r)
+    port = await server.start("127.0.0.1", 0)
+    body = b'{"x": 1}'
+
+    tcp_client = HTTPClient(self_host="127.0.0.1", self_port=port)
+    assert (await tcp_client.post("/echo", body)).status == 200
+
+    inproc_client = HTTPClient(self_host="127.0.0.1", self_port=port)
+    inproc_client.inprocess_server = server
+    assert (await inproc_client.post("/echo", body)).status == 200
+
+    tcp_headers, inproc_headers = captured
+    assert inproc_headers["content-length"] == tcp_headers["content-length"] == str(len(body))
+    assert inproc_headers["accept-encoding"] == tcp_headers["accept-encoding"] == "identity"
+    assert inproc_headers["host"] == tcp_headers["host"]
+    await server.shutdown()

@@ -1,0 +1,574 @@
+"""Overload protection (ISSUE 2): admission control caps + bounded
+queues, monotone Retry-After from observed service time, priority load
+shedding (queue high-water and engine depth probe), graceful drain with
+readiness flip — unit-tested on the virtual clock with zero real sleeps,
+plus the deterministic burst/drain acceptance e2e over real sockets
+(event-gated, no sleeps)."""
+
+import asyncio
+import json
+
+import pytest
+
+from inference_gateway_tpu.config import OverloadConfig
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.netio.client import HTTPClient, HTTPClientError
+from inference_gateway_tpu.netio.server import (
+    Headers,
+    HTTPServer,
+    Request,
+    Response,
+    Router,
+    StreamingResponse,
+)
+from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.resilience import (
+    CLASS_BUFFERED,
+    CLASS_CONTROL,
+    CLASS_STREAMING,
+    PRIORITY_BATCH,
+    PRIORITY_CRITICAL,
+    PRIORITY_INTERACTIVE,
+    AdmissionRejectedError,
+    OverloadController,
+    VirtualClock,
+    admission_middleware,
+    classify_request,
+)
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+def test_classify_request_table():
+    assert classify_request("GET", "/health") == (CLASS_CONTROL, PRIORITY_CRITICAL)
+    assert classify_request("GET", "/metrics") == (CLASS_CONTROL, PRIORITY_CRITICAL)
+    assert classify_request("POST", "/v1/metrics") == (CLASS_CONTROL, PRIORITY_CRITICAL)
+    for path in ("/v1/chat/completions", "/v1/responses", "/v1/messages"):
+        assert classify_request("POST", path) == (CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    assert classify_request("GET", "/v1/models") == (CLASS_BUFFERED, PRIORITY_BATCH)
+    assert classify_request("GET", "/v1/mcp/tools") == (CLASS_BUFFERED, PRIORITY_BATCH)
+    assert classify_request("POST", "/proxy/openai/v1/chat/completions") == (
+        CLASS_BUFFERED, PRIORITY_BATCH)
+
+
+# ---------------------------------------------------------------------------
+# Admission: cap → queue → reject
+# ---------------------------------------------------------------------------
+def _controller(clk=None, otel=None, **kw):
+    defaults = dict(max_concurrent_streaming=2, queue_depth_streaming=2,
+                    max_concurrent_buffered=4, queue_depth_buffered=4,
+                    queue_timeout=5.0, shed_high_water=0.5,
+                    engine_depth_high_water=0, drain_deadline=30.0,
+                    drain_retry_after=1.0)
+    defaults.update(kw)
+    return OverloadController(OverloadConfig(**defaults), otel=otel,
+                              clock=clk or VirtualClock())
+
+
+async def test_admits_to_cap_queues_then_rejects_429():
+    ctrl = _controller()
+    t1 = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    t2 = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    assert ctrl.in_flight(CLASS_STREAMING) == 2
+
+    queued = [asyncio.ensure_future(ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE))
+              for _ in range(2)]
+    await asyncio.sleep(0)
+    assert ctrl.queue_depth(CLASS_STREAMING) == 2
+
+    with pytest.raises(AdmissionRejectedError) as ei:
+        await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    assert ei.value.status == 429
+    assert ei.value.reason == "capacity"
+    assert ei.value.retry_after >= 1.0
+
+    # Releases hand slots to waiters FIFO; in-flight never exceeds cap.
+    t1.release()
+    t3 = await queued[0]
+    assert ctrl.in_flight(CLASS_STREAMING) == 2
+    t2.release()
+    t4 = await queued[1]
+    t3.release()
+    t4.release()
+    assert ctrl.total_in_flight() == 0
+    assert ctrl.queue_depth(CLASS_STREAMING) == 0
+
+
+async def test_retry_after_monotone_in_backlog():
+    """Retry-After derives from observed service time and grows with the
+    wait-queue backlog (the burst-above-cap satellite invariant)."""
+    clk = VirtualClock()
+    ctrl = _controller(clk, max_concurrent_streaming=2, queue_depth_streaming=8)
+    # Teach the EWMA a 2-second service time.
+    t = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    clk.advance(2.0)
+    t.release()
+
+    hold = [await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE) for _ in range(2)]
+    estimates = [ctrl.estimate_retry_after(CLASS_STREAMING)]
+    queued = []
+    for _ in range(4):
+        queued.append(asyncio.ensure_future(
+            ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)))
+        await asyncio.sleep(0)
+        estimates.append(ctrl.estimate_retry_after(CLASS_STREAMING))
+    assert estimates == sorted(estimates)  # monotone non-decreasing
+    assert estimates[-1] > estimates[0]    # and actually growing
+
+    # Drain the structure: each release admits the next waiter.
+    for ticket in hold:
+        ticket.release()
+    for fut in queued:
+        (await fut).release()
+    assert ctrl.total_in_flight() == 0
+
+
+async def test_queue_timeout_returns_handed_slot():
+    """A waiter whose queue wait exceeded the timeout (virtual clock)
+    rejects with 429 AND gives back the slot it was handed in the same
+    tick — the slot must never leak."""
+    clk = VirtualClock()
+    ctrl = _controller(clk, max_concurrent_streaming=1, queue_timeout=5.0)
+    t1 = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    waiter = asyncio.ensure_future(ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE))
+    await asyncio.sleep(0)
+    assert ctrl.queue_depth(CLASS_STREAMING) == 1
+    await clk.sleep(10.0)  # virtual wait past the 5s queue timeout
+    t1.release()           # hands the slot to the (already expired) waiter
+    with pytest.raises(AdmissionRejectedError) as ei:
+        await waiter
+    assert ei.value.status == 429 and ei.value.reason == "queue_timeout"
+    assert ctrl.total_in_flight() == 0  # the handed slot was returned
+    # And the class still works afterwards.
+    t = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    t.release()
+
+
+# ---------------------------------------------------------------------------
+# Priority load shedding
+# ---------------------------------------------------------------------------
+async def test_queue_high_water_sheds_batch_first():
+    ctrl = _controller(max_concurrent_streaming=1, queue_depth_streaming=4,
+                       shed_high_water=0.5)
+    hold = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    queued = [asyncio.ensure_future(ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE))
+              for _ in range(2)]
+    await asyncio.sleep(0)
+    assert ctrl.overloaded()  # 2 waiters >= ceil(4 * 0.5)
+
+    # Batch priority is shed with a sanitized 503 ...
+    with pytest.raises(AdmissionRejectedError) as ei:
+        await ctrl.admit(CLASS_BUFFERED, PRIORITY_BATCH)
+    assert ei.value.status == 503 and ei.value.reason == "shed"
+    assert "overloaded" in ei.value.message.lower()
+    # ... while interactive still queues and critical is always admitted.
+    third = asyncio.ensure_future(ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE))
+    await asyncio.sleep(0)
+    assert ctrl.queue_depth(CLASS_STREAMING) == 3
+    crit = await ctrl.admit(CLASS_CONTROL, PRIORITY_CRITICAL)
+    crit.release()
+
+    hold.release()
+    for fut in queued + [third]:
+        (await fut).release()
+    assert ctrl.total_in_flight() == 0
+
+
+async def test_engine_depth_probe_sheds_batch():
+    ctrl = _controller(engine_depth_high_water=4)
+    ctrl.add_depth_probe(lambda: 10)  # e.g. a sidecar scheduler's queue_depth
+    with pytest.raises(AdmissionRejectedError) as ei:
+        await ctrl.admit(CLASS_BUFFERED, PRIORITY_BATCH)
+    assert ei.value.status == 503 and ei.value.reason == "shed"
+    t = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)  # interactive unaffected
+    t.release()
+
+
+async def test_broken_depth_probe_never_sheds():
+    def bad_probe():
+        raise RuntimeError("probe broke")
+
+    ctrl = _controller(engine_depth_high_water=4)
+    ctrl.add_depth_probe(bad_probe)
+    t = await ctrl.admit(CLASS_BUFFERED, PRIORITY_BATCH)
+    t.release()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain
+# ---------------------------------------------------------------------------
+async def test_begin_drain_rejects_new_and_fails_queued():
+    ctrl = _controller(max_concurrent_streaming=1)
+    hold = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    waiter = asyncio.ensure_future(ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE))
+    await asyncio.sleep(0)
+
+    ctrl.begin_drain()
+    assert ctrl.draining
+    with pytest.raises(AdmissionRejectedError) as ei:
+        await waiter  # queued waiter failed fast
+    assert ei.value.status == 503 and ei.value.reason == "draining"
+    with pytest.raises(AdmissionRejectedError) as ei:
+        await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    assert ei.value.status == 503 and ei.value.reason == "draining"
+    # Critical traffic (health checks for the LB) is still admitted.
+    crit = await ctrl.admit(CLASS_CONTROL, PRIORITY_CRITICAL)
+    crit.release()
+    # The in-flight request is NOT interrupted; drain waits for it.
+    hold.release()
+    assert await ctrl.wait_idle(5.0)
+
+
+async def test_wait_idle_completes_within_deadline_virtual():
+    clk = VirtualClock()
+    ctrl = _controller(clk)
+    ticket = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+
+    async def finish_stream():
+        await clk.sleep(1.0)
+        ticket.release()
+
+    task = asyncio.ensure_future(finish_stream())
+    assert await ctrl.wait_idle(5.0)
+    await task
+
+
+async def test_wait_idle_times_out_past_deadline_virtual():
+    clk = VirtualClock()
+    ctrl = _controller(clk)
+    t1 = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    t2 = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+
+    async def slow_release():
+        await clk.sleep(10.0)  # virtually past the 5s deadline
+        t1.release()
+
+    task = asyncio.ensure_future(slow_release())
+    assert not await ctrl.wait_idle(5.0)
+    t2.release()
+    await task
+
+
+# ---------------------------------------------------------------------------
+# Middleware + rejection response shape
+# ---------------------------------------------------------------------------
+def _request(method="POST", path="/v1/chat/completions", client=("127.0.0.1", 9)):
+    return Request(method=method, path=path, query={}, headers=Headers(),
+                   body=b"{}", client=client)
+
+
+async def test_middleware_holds_ticket_for_whole_stream():
+    ctrl = _controller()
+    mw = admission_middleware(ctrl)
+
+    async def handler(req):
+        async def chunks():
+            yield b"data: one\n\n"
+            yield b"data: [DONE]\n\n"
+        return StreamingResponse.sse(chunks())
+
+    resp = await mw(_request(), handler)
+    assert ctrl.in_flight(CLASS_STREAMING) == 1  # held while the body streams
+    out = []
+    async for chunk in resp.chunks:
+        out.append(chunk)
+        assert ctrl.in_flight(CLASS_STREAMING) == 1
+    assert ctrl.in_flight(CLASS_STREAMING) == 0  # released at stream end
+    assert out[-1] == b"data: [DONE]\n\n"
+
+
+async def test_middleware_holds_buffered_ticket_until_body_written():
+    """Buffered responses stay in-flight until the server reports the
+    body written (on_sent) — otherwise graceful drain could close the
+    socket mid-write of a large buffered body."""
+    ctrl = _controller()
+    mw = admission_middleware(ctrl)
+
+    async def ok(req):
+        return Response.json({"ok": True})
+
+    resp = await mw(_request(path="/v1/models", method="GET"), ok)
+    assert resp.status == 200
+    assert ctrl.total_in_flight() == 1   # held through the pending write
+    resp.on_sent()                       # the server calls this post-write
+    assert ctrl.total_in_flight() == 0
+    resp.on_sent()                       # idempotent (finally + error paths)
+    assert ctrl.total_in_flight() == 0
+
+    async def boom(req):
+        raise RuntimeError("handler exploded")
+
+    with pytest.raises(RuntimeError):
+        await mw(_request(), boom)
+    assert ctrl.total_in_flight() == 0  # released on the error path too
+
+
+async def test_middleware_bypasses_inprocess_self_hop():
+    ctrl = _controller(max_concurrent_buffered=1)
+    hold = await ctrl.admit(CLASS_BUFFERED, PRIORITY_BATCH)
+    mw = admission_middleware(ctrl)
+
+    async def ok(req):
+        return Response.json({"ok": True})
+
+    # The /proxy self-hop dispatches in-process with client=("inprocess", 0);
+    # it must not be re-admitted (the edge request already holds a ticket).
+    resp = await mw(_request(path="/proxy/tpu/v1/models", method="GET",
+                             client=("inprocess", 0)), ok)
+    assert resp.status == 200
+    hold.release()
+
+
+async def test_rejection_response_sanitized_with_retry_after():
+    ctrl = _controller(max_concurrent_streaming=1, queue_depth_streaming=0)
+    hold = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    mw = admission_middleware(ctrl)
+
+    async def never(req):  # pragma: no cover - must not be reached
+        raise AssertionError("shed request must not reach the handler")
+
+    resp = await mw(_request(), never)
+    assert resp.status == 429
+    assert int(resp.headers.get("Retry-After")) >= 1
+    body = json.loads(resp.body)
+    # Sanitized: no caps, queue lengths, or class names leak to clients.
+    assert set(body) == {"error"}
+    assert "queue" not in body["error"].lower()
+    hold.release()
+
+
+async def test_overload_metrics_exposed():
+    otel = OpenTelemetry()
+    ctrl = _controller(otel=otel, max_concurrent_streaming=1, queue_depth_streaming=0)
+    hold = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    with pytest.raises(AdmissionRejectedError):
+        await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    ctrl.begin_drain()
+    hold.release()
+    assert await ctrl.wait_idle(1.0)
+    text = otel.expose_prometheus()
+    assert 'inference_gateway_overload_in_flight{endpoint_class="streaming"} 0' in text
+    assert 'inference_gateway_overload_shed' in text
+    assert 'reason="capacity"' in text
+    assert 'inference_gateway_overload_drain_events{phase="begun"} 1' in text
+    assert 'phase="completed"' in text
+
+
+# ---------------------------------------------------------------------------
+# Serving layer: bounded scheduler queue + sidecar 429
+# ---------------------------------------------------------------------------
+class _FakeTokenizer:
+    eos_token_id = 0
+
+    def apply_chat_template(self, messages):
+        return [1, 2, 3]
+
+
+class _FakeEngineConfig:
+    model = "fake"
+    max_slots = 2
+    max_seq_len = 64
+    max_prefill_batch = 2
+    pipeline_depth = 1
+    decode_chunk = 1
+
+
+class _FakeEngine:
+    config = _FakeEngineConfig()
+    tokenizer = _FakeTokenizer()
+    vision_cfg = None
+    spec = False
+    spec_ngram = False
+    metrics: dict = {}
+    allocator = None
+    prefix_cache = None
+
+    def context_window(self):
+        return 64
+
+
+def test_scheduler_bounded_queue_raises_when_full():
+    from inference_gateway_tpu.serving.scheduler import (
+        GenRequest,
+        Scheduler,
+        SchedulerSaturatedError,
+    )
+
+    sched = Scheduler(_FakeEngine(), max_queue_depth=2)  # not started: queue only fills
+    sched.submit(GenRequest(prompt_ids=[1]))
+    sched.submit(GenRequest(prompt_ids=[1]))
+    with pytest.raises(SchedulerSaturatedError) as ei:
+        sched.submit(GenRequest(prompt_ids=[1]))
+    assert ei.value.queue_depth == 2
+    assert sched.queue_depth == 2  # the rejected request was not enqueued
+
+
+async def test_sidecar_sheds_with_429_when_scheduler_saturated():
+    from inference_gateway_tpu.serving.scheduler import Scheduler
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    engine = _FakeEngine()
+    sidecar = SidecarServer(engine, scheduler=Scheduler(engine, max_queue_depth=1),
+                            served_model_name="fake")
+    body = json.dumps({"model": "fake", "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]}).encode()
+    req = Request(method="POST", path="/v1/chat/completions", query={},
+                  headers=Headers(), body=body)
+    first = await sidecar.chat_completions(req)
+    assert isinstance(first, StreamingResponse)  # admitted (queued; never run)
+    second = await sidecar.chat_completions(req)
+    assert second.status == 429
+    assert int(second.headers.get("Retry-After")) >= 1
+    assert b"saturated" in second.body.lower()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e (real sockets; event-gated, zero sleeps): burst at 2× the
+# cap, then SIGTERM-equivalent drain mid-stream.
+# ---------------------------------------------------------------------------
+def _sse_frame(content: str) -> bytes:
+    return ("data: " + json.dumps(
+        {"choices": [{"delta": {"content": content}, "index": 0}]}) + "\n\n").encode()
+
+
+async def _gated_upstream(gate: asyncio.Event, peak: list, active: list):
+    """Fake provider whose streams block on ``gate`` mid-body, recording
+    peak concurrency so the test can assert the cap was enforced
+    upstream."""
+    async def chat(req: Request) -> Response:
+        async def chunks():
+            active.append(1)
+            peak[0] = max(peak[0], len(active))
+            try:
+                yield _sse_frame("tok")
+                await gate.wait()
+                yield _sse_frame("en")
+                yield b"data: [DONE]\n\n"
+            finally:
+                active.pop()
+        return StreamingResponse.sse(chunks())
+
+    r = Router()
+    r.post("/v1/chat/completions", chat)
+    upstream = HTTPServer(r)
+    port = await upstream.start("127.0.0.1", 0)
+    return upstream, port
+
+
+async def test_burst_at_twice_the_cap_e2e():
+    """2× the concurrency cap: admitted requests all complete (200, full
+    stream), excess gets 429 + Retry-After — never a hang or a 5xx — and
+    upstream concurrency never exceeds the cap."""
+    gate = asyncio.Event()
+    peak = [0]
+    active: list = []
+    upstream, up_port = await _gated_upstream(gate, peak, active)
+    gw = build_gateway(env={
+        "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+        "SERVER_PORT": "0",
+        "OVERLOAD_MAX_CONCURRENT_STREAMING": "2",
+        "OVERLOAD_QUEUE_DEPTH_STREAMING": "1",
+        "OVERLOAD_QUEUE_TIMEOUT": "60s",
+    })
+    port = await gw.start("127.0.0.1", 0)
+    body = json.dumps({"model": "ollama/m", "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]}).encode()
+
+    async def one():
+        client = HTTPClient()
+        resp = await client.post(
+            f"http://127.0.0.1:{port}/v1/chat/completions", body, stream=True)
+        frames = b""
+        async for block in resp.iter_raw():
+            frames += block
+        return resp.status, resp.headers.get("Retry-After"), frames
+
+    tasks = [asyncio.ensure_future(one()) for _ in range(4)]
+    # The single over-queue request is rejected immediately; every
+    # admitted/queued stream is still blocked on the gate.
+    done, pending = await asyncio.wait(tasks, return_when=asyncio.FIRST_COMPLETED,
+                                       timeout=60)
+    assert len(done) == 1
+    status, retry_after, _ = next(iter(done)).result()
+    assert status == 429
+    assert int(retry_after) >= 1
+    assert len(pending) == 3
+
+    gate.set()
+    results = [await t for t in tasks]
+    statuses = sorted(s for s, _, _ in results)
+    assert statuses == [200, 200, 200, 429]  # no hangs, no 5xx
+    for status, _, frames in results:
+        if status == 200:
+            assert frames.endswith(b"data: [DONE]\n\n")  # streams ran to completion
+    assert peak[0] <= 2  # the cap held upstream
+
+    await gw.shutdown()
+    await upstream.shutdown()
+
+
+async def test_graceful_drain_mid_burst_e2e():
+    """SIGTERM-equivalent mid-stream: readiness fails throughout the
+    drain, new work is rejected fast, the in-flight SSE stream finishes
+    to [DONE] within the drain deadline, and only then does the listener
+    close."""
+    gate = asyncio.Event()
+    peak = [0]
+    active: list = []
+    upstream, up_port = await _gated_upstream(gate, peak, active)
+    gw = build_gateway(env={
+        "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+        "SERVER_PORT": "0",
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        "DRAIN_DEADLINE": "60s",
+    })
+    port = await gw.start("127.0.0.1", 0)
+    url = f"http://127.0.0.1:{port}/v1/chat/completions"
+    body = json.dumps({"model": "ollama/m", "stream": True,
+                       "messages": [{"role": "user", "content": "x"}]}).encode()
+
+    async def consume_stream():
+        client = HTTPClient()
+        resp = await client.post(url, body, stream=True)
+        frames = b""
+        async for block in resp.iter_raw():
+            frames += block
+        return resp.status, frames
+
+    stream_task = asyncio.ensure_future(consume_stream())
+    while not active:  # upstream stream admitted and mid-body (no sleeps)
+        await asyncio.sleep(0)
+
+    shutdown_task = asyncio.ensure_future(gw.shutdown())
+    while not gw.overload.draining:
+        await asyncio.sleep(0)
+
+    # Readiness fails for LBs while the listener is still open.
+    health = await HTTPClient().get(f"http://127.0.0.1:{port}/health")
+    assert health.status == 503
+    assert health.json() == {"message": "draining"}
+
+    # New work is rejected fast with a sanitized body + Connection: close.
+    rejected = await HTTPClient().post(url, body)
+    assert rejected.status == 503
+    assert int(rejected.headers.get("Retry-After")) >= 1
+    assert json.loads(rejected.body) == {
+        "error": "Service is draining for shutdown. Please retry."}
+    assert not stream_task.done()  # the in-flight stream was NOT cut
+
+    gate.set()
+    status, frames = await stream_task
+    assert status == 200
+    assert frames.endswith(b"data: [DONE]\n\n")  # drained to completion
+    await shutdown_task
+
+    # The drain completed (not timed out) and the listener is now closed.
+    text = gw.otel.expose_prometheus()
+    assert 'inference_gateway_overload_drain_events{phase="begun"} 1' in text
+    assert 'inference_gateway_overload_drain_events{phase="completed"} 1' in text
+    assert 'phase="timed_out"' not in text
+    with pytest.raises(HTTPClientError):
+        await HTTPClient().get(f"http://127.0.0.1:{port}/health")
+    await upstream.shutdown()

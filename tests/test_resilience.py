@@ -669,3 +669,35 @@ async def test_pool_failover_breaker_recovery_end_to_end():
     assert "inference_gateway_resilience_breaker_state" in expo
     # Zero real sleeps: every backoff landed on the virtual clock.
     assert clk.sleeps, "backoffs should have been recorded virtually"
+
+
+async def test_starved_retry_releases_probe_slot_before_readmitting():
+    """Regression (code-review ISSUE 2 round): a starved-timeout attempt
+    (allotted < MIN_VIABLE_ATTEMPT, so no breaker outcome is recorded)
+    followed by a retry re-admission used to overwrite admission_pending
+    and leak the first half-open probe slot — with half_open_max_probes
+    >= 2 the breaker wedged half-open with shrinking capacity."""
+    import asyncio
+
+    clk = VirtualClock()
+    res = _resilience(clk, breaker_failure_threshold=1, breaker_cooldown=10.0,
+                      breaker_half_open_probes=2, retry_max_attempts=3)
+    br = res.breakers.get("a", "m")
+    br.record_failure()       # -> OPEN
+    clk.advance(10.1)         # cooldown elapsed -> half-open eligible
+
+    async def starved(cand, b):
+        raise asyncio.TimeoutError()  # budget-starved: never charged
+
+    with pytest.raises(asyncio.TimeoutError):
+        # Budget of 2s < MIN_VIABLE_ATTEMPT: every timeout is classified
+        # as starved, so admission_pending stays set across retries.
+        await res.execute([Deployment("a", "m")], starved,
+                          budget=res.new_budget(2.0), idempotent=True)
+
+    # Both probe slots must be free again: two racers each get one.
+    assert br.admit() == (True, True)
+    assert br.admit() == (True, True)
+    assert br.admit() == (False, False)  # and the cap still holds
+    br.release()
+    br.release()
