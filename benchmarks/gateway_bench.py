@@ -229,6 +229,68 @@ async def bench_overload(streams: int = 64, cap: int = 16, queue: int = 8,
     }
 
 
+async def bench_telemetry_overhead(n: int = 200) -> dict:
+    """p99 per-request latency with the full observability stack on
+    (metrics + tracing + wide-event access log) vs. off — the ISSUE 3
+    regression surface: instrumentation must stay cheap enough that no
+    future perf PR is tempted to turn it off."""
+    import io
+
+    async def chat(req: Request) -> Response:
+        return Response.json({
+            "id": "b", "object": "chat.completion", "created": 1, "model": "m",
+            "choices": [{"index": 0, "message": {"role": "assistant", "content": "ok"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 2, "total_tokens": 12},
+        })
+
+    async def run_variant(telemetry_on: bool) -> list[float]:
+        r = Router()
+        r.post("/v1/chat/completions", chat)
+        upstream = HTTPServer(r)
+        up_port = await upstream.start("127.0.0.1", 0)
+        env = {"OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1", "SERVER_PORT": "0"}
+        if telemetry_on:
+            env.update({
+                "TELEMETRY_ENABLE": "true",
+                "TELEMETRY_TRACING_ENABLE": "true",
+                "TELEMETRY_ACCESS_LOG": "true",
+                "TELEMETRY_METRICS_PORT": "0",
+            })
+        gw = build_gateway(env=env)
+        if gw.access_log is not None:
+            gw.access_log._stream = io.StringIO()  # keep bench stdout parseable
+        port = await gw.start("127.0.0.1", 0)
+        client = HTTPClient()
+        body = json.dumps({"model": "ollama/m",
+                           "messages": [{"role": "user", "content": "x" * 64}]}).encode()
+        for _ in range(10):
+            await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body)
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body)
+            assert resp.status == 200
+            lats.append(time.perf_counter() - t0)
+        await gw.shutdown()
+        await upstream.shutdown()
+        return sorted(lats)
+
+    off = await run_variant(False)
+    on = await run_variant(True)
+
+    def p(lats: list[float], q: float) -> float:
+        return round(lats[min(len(lats) - 1, int(len(lats) * q))] * 1000, 3)
+
+    return {
+        "bench": "telemetry_overhead",
+        "p50_off_ms": p(off, 0.50), "p50_on_ms": p(on, 0.50),
+        "p99_off_ms": p(off, 0.99), "p99_on_ms": p(on, 0.99),
+        "p99_delta_ms": round(p(on, 0.99) - p(off, 0.99), 3),
+        "ops": n,
+    }
+
+
 async def main() -> None:
     results = [
         await bench_chat_completions(),
@@ -237,6 +299,7 @@ async def main() -> None:
         await bench_sse_relay_concurrent(),
         await bench_sse_relay_concurrent(streams=128, n_chunks=200),
         await bench_overload(),
+        await bench_telemetry_overhead(),
     ]
     for r in results:
         print(json.dumps(r))

@@ -81,6 +81,12 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
 
         provider, model = _provider_and_model(req)
         team = req.headers.get("X-Team") or ""
+        event = req.ctx.get("wide_event")
+        if event is not None:
+            event["provider"] = provider
+            event["model"] = model
+            if team:
+                event["team"] = team
         start = time.perf_counter()
         resp = await nxt(req)
         span = req.ctx.get("span")
@@ -94,6 +100,8 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
             )
             if usage:
                 otel.record_token_usage(source, team, provider, model, usage[0], usage[1])
+                if event is not None:
+                    event["input_tokens"], event["output_tokens"] = usage
             for name in tool_names:
                 otel.record_tool_call(source, team, provider, model, classify_tool_type(name), name)
             if error_type and span is not None:
@@ -105,12 +113,42 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
             ring: deque[bytes] = deque(maxlen=USAGE_SCAN_CHUNKS)
 
             async def observed():
+                # Token-level streaming metrics off the SSE relay (ISSUE
+                # 3): time-to-first-chunk, inter-chunk gaps as the
+                # gateway-edge TPOT view (the sidecar's emit-path TPOT is
+                # the per-token truth; this one includes relay queueing —
+                # exactly the delta a saturated relay shows), and
+                # tokens/sec over the whole stream once usage is known.
+                t_first: float | None = None
+                t_last: float | None = None
+                n_gaps = 0
                 try:
                     async for chunk in inner:
+                        now = time.perf_counter()
                         if chunk.strip():
+                            if t_first is None:
+                                t_first = now
+                                otel.record_time_to_first_chunk(
+                                    source, team, provider, model, now - start)
+                            elif t_last is not None and not chunk.startswith(b"data: [DONE]"):
+                                # Skip the FIRST gap: for OpenAI-style
+                                # streams chunk 1 is the role preamble,
+                                # so preamble→token-1 is prefill time
+                                # (TTFT's job), not inter-token latency.
+                                # Trailing finish/usage frames still add
+                                # a couple ~0 gaps — unavoidable without
+                                # parsing JSON on the relay hot path;
+                                # the sidecar's emit-path TPOT is exact.
+                                n_gaps += 1
+                                if n_gaps >= 2:
+                                    otel.record_tpot(source, team, provider, model,
+                                                     now - t_last)
+                            t_last = now
                             ring.append(chunk)
                         yield chunk
                 finally:
+                    if event is not None and t_first is not None:
+                        event["ttfc_ms"] = round((t_first - start) * 1000, 3)
                     usage = None
                     tool_names: list[str] = []
                     # The relay yields raw transport blocks, not SSE
@@ -152,6 +190,14 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                                 if name:
                                     tool_names.append(name)
                     record("", usage, tool_names)
+                    if (usage and usage[1] > 1 and t_first is not None
+                            and t_last is not None and t_last > t_first):
+                        # First token anchors the clock: N tokens span
+                        # N-1 inter-token intervals.
+                        rate = (usage[1] - 1) / (t_last - t_first)
+                        otel.record_output_token_rate(source, team, provider, model, rate)
+                        if event is not None:
+                            event["tokens_per_sec"] = round(rate, 2)
 
             resp.chunks = observed()
             return resp

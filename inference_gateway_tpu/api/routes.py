@@ -282,6 +282,9 @@ class RouterImpl:
 
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
         budget = self.resilience.new_budget()
+        event = req.ctx.get("wide_event")
+        if event is not None and alias:
+            event["alias"] = alias
 
         def request_for(cand: _Candidate) -> dict[str, Any]:
             out = dict(body)
@@ -299,7 +302,8 @@ class RouterImpl:
 
             try:
                 stream, served = await self.resilience.execute(
-                    candidates, call, budget=budget, idempotent=False, alias=alias)
+                    candidates, call, budget=budget, idempotent=False, alias=alias,
+                    event=event)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
             except BudgetExceededError:
@@ -308,6 +312,9 @@ class RouterImpl:
                 return error_json(e.message, e.status_code)
             except HTTPClientError as e:
                 return error_json(str(e), 502)
+            if event is not None:
+                event["served_provider"] = served.provider
+                event["served_model"] = served.model
             resp = StreamingResponse.sse(self.resilience.guard_stream(stream))
             if alias:
                 resp.headers.set("X-Selected-Provider", served.provider)
@@ -323,7 +330,8 @@ class RouterImpl:
 
         try:
             result, served = await self.resilience.execute(
-                candidates, call, budget=budget, idempotent=True, alias=alias)
+                candidates, call, budget=budget, idempotent=True, alias=alias,
+                event=event)
         except UpstreamUnavailableError as e:
             return error_json(str(e), 503)
         except (BudgetExceededError, asyncio.TimeoutError):
@@ -332,6 +340,9 @@ class RouterImpl:
             return error_json(e.message, e.status_code)
         except HTTPClientError as e:
             return error_json(str(e), 502)
+        if event is not None:
+            event["served_provider"] = served.provider
+            event["served_model"] = served.model
         resp = Response.json(result)
         if alias:
             resp.headers.set("X-Selected-Provider", served.provider)
@@ -446,6 +457,9 @@ class RouterImpl:
 
         ctx = {"auth_token": req.ctx.get("auth_token"), "traceparent": req.ctx.get("traceparent")}
         budget = self.resilience.new_budget()
+        event = req.ctx.get("wide_event")
+        if event is not None and alias:
+            event["alias"] = alias
 
         def chat_req_for(cand: _Candidate) -> dict[str, Any]:
             chat_req = responses_to_chat_request(dict(body, model=cand.model))
@@ -460,7 +474,8 @@ class RouterImpl:
 
             try:
                 stream, _served = await self.resilience.execute(
-                    candidates, call, budget=budget, idempotent=False, alias=alias)
+                    candidates, call, budget=budget, idempotent=False, alias=alias,
+                    event=event)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
             except BudgetExceededError:
@@ -478,7 +493,8 @@ class RouterImpl:
 
         try:
             result, _served = await self.resilience.execute(
-                candidates, call, budget=budget, idempotent=True, alias=alias)
+                candidates, call, budget=budget, idempotent=True, alias=alias,
+                event=event)
         except UpstreamUnavailableError as e:
             return error_json(str(e), 503)
         except (BudgetExceededError, asyncio.TimeoutError):
@@ -569,6 +585,7 @@ class RouterImpl:
             resp, _ = await self.resilience.execute(
                 [routing.Deployment(provider=provider_id, model=model)], call,
                 budget=self.resilience.new_budget(), idempotent=False,
+                event=req.ctx.get("wide_event"),
                 # Upstream errors pass through verbatim (no exception), so
                 # tell the breaker which responses count as illness.
                 result_ok=lambda r: r.status < 500 and r.status != 429,

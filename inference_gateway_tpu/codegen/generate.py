@@ -34,6 +34,32 @@ def load_spec(path: Path = SPEC_PATH) -> dict:
 # ---------------------------------------------------------------------------
 # Generation
 # ---------------------------------------------------------------------------
+# Emitted under the Telemetry section of Configurations.md: the request
+# observability surface (ISSUE 3 satellite) — what lights up when the
+# TELEMETRY_* knobs are on, and where the dashboards live.
+_TELEMETRY_OBSERVABILITY_DOC = [
+    "### Request observability",
+    "",
+    "With `TELEMETRY_ENABLE` + `TELEMETRY_TRACING_ENABLE` on, one W3C trace",
+    "spans the whole request: the gateway server span, the `/proxy` loopback",
+    "hop, and the TPU sidecar's `queue.wait` / `prefill` / `decode` child",
+    "spans (built from the scheduler's per-request phase clock). Token-level",
+    "streaming histograms — inter-token latency (TPOT), time-in-queue, and",
+    "output tokens/sec — record from the SSE relay and the scheduler emit",
+    "path; engine gauges (batch-slot occupancy, KV-page utilization, queue",
+    "depth, speculative acceptance) are sampled per request and per scrape.",
+    "",
+    "`TELEMETRY_ACCESS_LOG` emits one wide-event JSON line per request",
+    "carrying the trace id, route, provider/model, status, token counts,",
+    "phase durations, and shed/retry/failover annotations. The metrics",
+    "listener serves `GET /metrics` (Prometheus) and `GET /debug/status`",
+    "(JSON snapshot: build info, breaker states, admission ledger, live",
+    "gauges). Span tree, instrument table, and example PromQL queries:",
+    "[docs/observability.md](docs/observability.md).",
+    "",
+]
+
+
 # Emitted under the Resilience section of Configurations.md: what clients
 # observe in each degraded mode (ISSUE 1 satellite).
 _RESILIENCE_FAILURE_MODES = [
@@ -98,7 +124,9 @@ def generate_configurations_md(spec: dict) -> str:
             default = str(e.get("default", ""))
             out.append(f"| `{e['env']}` | `{default}` | {e['description']} |")
         out.append("")
-        if section == "resilience":
+        if section == "telemetry":
+            out.extend(_TELEMETRY_OBSERVABILITY_DOC)
+        elif section == "resilience":
             out.extend(_RESILIENCE_FAILURE_MODES)
         elif section == "overload":
             out.extend(_OVERLOAD_DRAIN_DOC)
@@ -254,6 +282,7 @@ def check_config_defaults(spec: dict) -> list[str]:
         "TELEMETRY_METRICS_PORT": cfg.telemetry.metrics_port,
         "TELEMETRY_TRACING_ENABLE": cfg.telemetry.tracing_enable,
         "TELEMETRY_TRACING_OTLP_ENDPOINT": cfg.telemetry.tracing_otlp_endpoint,
+        "TELEMETRY_ACCESS_LOG": cfg.telemetry.access_log,
         "MCP_ENABLE": cfg.mcp.enable,
         "MCP_EXPOSE": cfg.mcp.expose,
         "MCP_SERVERS": cfg.mcp.servers,

@@ -61,6 +61,7 @@ class TelemetryConfig:
     metrics_port: str = "9464"
     tracing_enable: bool = False
     tracing_otlp_endpoint: str = "http://localhost:4318"
+    access_log: bool = False
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "TELEMETRY_") -> "TelemetryConfig":
@@ -70,6 +71,7 @@ class TelemetryConfig:
             metrics_port=_get_str(env, prefix + "METRICS_PORT", "9464"),
             tracing_enable=_get_bool(env, prefix + "TRACING_ENABLE", False),
             tracing_otlp_endpoint=_get_str(env, prefix + "TRACING_OTLP_ENDPOINT", "http://localhost:4318"),
+            access_log=_get_bool(env, prefix + "ACCESS_LOG", False),
         )
 
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import signal
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -45,9 +46,12 @@ class Gateway:
     metrics_server: HTTPServer | None = None
     mcp_client: Any = None
     overload: OverloadController | None = None
+    resilience: Any = None
+    access_log: Any = None
     port: int = 0
     metrics_port: int = 0
     _tasks: list[asyncio.Task] = field(default_factory=list)
+    _started: float = field(default_factory=time.monotonic)
 
     async def start(self, host: str | None = None, port: int | None = None) -> int:
         host = host or self.cfg.server.host
@@ -114,6 +118,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
 
     otel = None
     metrics_server = None
+    metrics_router = None
     if cfg.telemetry.enable:
         otel = OpenTelemetry(
             environment=cfg.environment,
@@ -127,6 +132,8 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
 
         metrics_router = Router()
         metrics_router.get("/metrics", prometheus_handler)
+        # /debug/status is registered below, once the breaker registry
+        # and admission ledger it snapshots exist.
         metrics_server = HTTPServer(metrics_router, logger=logger)
 
     client = HTTPClient(
@@ -173,11 +180,20 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         resilience=resilience, overload=overload,
     )
 
-    # Middleware order matters (main.go:238-254): admission first — a
-    # shed request must cost nothing downstream (no span, no log line,
-    # no auth round trip) — then tracing → logger → telemetry → auth →
-    # mcp. MCP must be last.
-    middlewares = [admission_middleware(overload, logger)]
+    # Middleware order matters (main.go:238-254): the wide-event access
+    # log is outermost so even shed requests leave one JSON line (ISSUE
+    # 3) — it is the one observability cost a rejected request pays —
+    # then admission (everything else costs nothing for a shed request:
+    # no span, no log line, no auth round trip), then tracing → logger →
+    # telemetry → auth → mcp. MCP must be last.
+    access_log = None
+    middlewares = []
+    if cfg.telemetry.access_log:
+        from inference_gateway_tpu.otel.access_log import AccessLog, access_log_middleware
+
+        access_log = AccessLog(service=APPLICATION_NAME)
+        middlewares.append(access_log_middleware(access_log))
+    middlewares.append(admission_middleware(overload, logger))
     if otel is not None and cfg.telemetry.tracing_enable:
         middlewares.append(tracing_middleware(otel.tracer))
     middlewares.append(logger_middleware(logger))
@@ -207,11 +223,35 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     # router + middleware chain instead of a loopback TCP round trip.
     client.inprocess_server = api_server
 
-    return Gateway(
+    gw = Gateway(
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
         router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
-        mcp_client=mcp_client, overload=overload,
+        mcp_client=mcp_client, overload=overload, resilience=resilience,
+        access_log=access_log,
     )
+
+    if metrics_router is not None:
+        # /debug/status (ISSUE 3): one JSON snapshot for humans and
+        # probes — build info, breaker states, the admission ledger, and
+        # every live gauge point (engine occupancy/KV pressure when a
+        # sidecar is co-hosted, breaker codes, overload in-flight).
+        async def debug_status_handler(req: Request) -> Response:
+            status: dict[str, Any] = {
+                "app": APPLICATION_NAME,
+                "version": VERSION,
+                "environment": cfg.environment,
+                "uptime_seconds": round(time.monotonic() - gw._started, 3),
+                "breakers": resilience.breaker_snapshot(),
+                "admission": overload.snapshot(),
+                "gauges": otel.registry.gauge_snapshot(),
+            }
+            if access_log is not None:
+                status["access_log_tail"] = list(access_log.tail)[-8:]
+            return Response.json(status)
+
+        metrics_router.get("/debug/status", debug_status_handler)
+
+    return gw
 
 
 async def run() -> None:

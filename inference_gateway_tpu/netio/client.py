@@ -290,10 +290,13 @@ class HTTPClient:
 
     async def _request_inprocess(self, method: str, split, headers,
                                  body: bytes, timeout: float | None,
-                                 stream: bool) -> ClientResponse:
+                                 stream: bool,
+                                 traceparent: str | None = None) -> ClientResponse:
         """Dispatch a self-addressed request straight through the wired
         server's router + middleware chain — no socket, no HTTP framing."""
         hdrs = self._normalize_headers(headers, self.self_host, self.self_port)
+        if traceparent:
+            hdrs.set("traceparent", traceparent)
         # Mirror the headers the TCP path always sets, so middleware and
         # handlers observe an identical request whichever way the /proxy
         # hop dispatches (ADVICE round 5).
@@ -351,6 +354,7 @@ class HTTPClient:
         body: bytes = b"",
         timeout: float | None = None,
         stream: bool = False,
+        traceparent: str | None = None,
     ) -> ClientResponse:
         split = urlsplit(url)
         scheme = split.scheme or self.self_scheme
@@ -363,9 +367,15 @@ class HTTPClient:
 
         if self.inprocess_server is not None and not split.hostname:
             return await self._request_inprocess(method, split, headers, body,
-                                                 timeout, stream)
+                                                 timeout, stream,
+                                                 traceparent=traceparent)
 
         hdrs = self._normalize_headers(headers, host, port)
+        if traceparent:
+            # W3C trace propagation into the outbound hop (ISSUE 3): the
+            # active span context rides every caller path — TCP and
+            # in-process alike — without call sites rebuilding headers.
+            hdrs.set("traceparent", traceparent)
         hdrs.set("Content-Length", str(len(body)))
         if self.config.disable_compression:
             hdrs.set("Accept-Encoding", "identity")
@@ -465,8 +475,12 @@ class HTTPClient:
         await self._release(scheme, host, port, reader, writer, reusable=keep)
         return resp
 
-    async def get(self, url: str, headers=None, timeout: float | None = None) -> ClientResponse:
-        return await self.request("GET", url, headers=headers, timeout=timeout)
+    async def get(self, url: str, headers=None, timeout: float | None = None,
+                  traceparent: str | None = None) -> ClientResponse:
+        return await self.request("GET", url, headers=headers, timeout=timeout,
+                                  traceparent=traceparent)
 
-    async def post(self, url: str, body: bytes, headers=None, timeout: float | None = None, stream: bool = False) -> ClientResponse:
-        return await self.request("POST", url, headers=headers, body=body, timeout=timeout, stream=stream)
+    async def post(self, url: str, body: bytes, headers=None, timeout: float | None = None,
+                   stream: bool = False, traceparent: str | None = None) -> ClientResponse:
+        return await self.request("POST", url, headers=headers, body=body, timeout=timeout,
+                                  stream=stream, traceparent=traceparent)

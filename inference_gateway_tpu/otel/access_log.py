@@ -1,0 +1,105 @@
+"""Wide-event access log (ISSUE 3 tentpole part 4).
+
+One structured JSON line per edge request — trace id, route, provider,
+model, status, token counts, phase durations, and resilience annotations
+(shed/retry/failover) — behind the ``TELEMETRY_ACCESS_LOG`` knob. The
+"wide event" discipline: every subsystem that touches a request adds its
+fields to ONE per-request dict (``req.ctx["wide_event"]``) instead of
+scattering log lines, so a single grep-able record answers "what
+happened to this request" with the trace id linking it to the span tree
+and the sidecar's own line (same trace id, engine phase durations).
+
+The emitter keeps a bounded in-memory tail so ``/debug/status`` and
+tests can read recent events without tailing the stream.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, TextIO
+
+
+class AccessLog:
+    """JSON-lines wide-event sink with a bounded in-memory tail."""
+
+    def __init__(self, stream: TextIO | None = None, service: str = "gateway",
+                 tail_size: int = 256) -> None:
+        self._stream = stream if stream is not None else sys.stdout
+        self.service = service
+        self.tail: deque[dict[str, Any]] = deque(maxlen=tail_size)
+        self._lock = threading.Lock()
+
+    def emit(self, event: dict[str, Any]) -> None:
+        event = {k: v for k, v in event.items() if v is not None}
+        event.setdefault("log", "access")
+        event.setdefault("service", self.service)
+        event.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + "Z")
+        line = json.dumps(event, default=str, separators=(",", ":"))
+        with self._lock:
+            self.tail.append(event)
+            try:
+                self._stream.write(line + "\n")
+                self._stream.flush()
+            except Exception:
+                pass  # a closed stream must never fail a request
+
+
+def access_log_middleware(access_log: AccessLog):
+    """Outermost middleware: wraps even admission control so shed
+    requests (429/503 before any other middleware runs) still produce
+    their wide event — the admission middleware annotates the shed
+    reason into ``req.ctx["wide_event"]``. In-process self-dispatch (the
+    provider layer's /proxy double hop) is skipped: the edge request's
+    event already covers the hop, and /health polls are skipped to keep
+    LB probes out of the stream."""
+    from inference_gateway_tpu.netio.server import StreamingResponse
+
+    async def middleware(req, nxt):
+        if req.client is not None and req.client[0] == "inprocess":
+            return await nxt(req)
+        if req.path == "/health":
+            return await nxt(req)
+        event: dict[str, Any] = {"method": req.method, "route": req.path}
+        req.ctx["wide_event"] = event
+        start = time.perf_counter()
+
+        def finalize(status: int) -> None:
+            event["status"] = status
+            event["duration_ms"] = round((time.perf_counter() - start) * 1000, 3)
+            span = req.ctx.get("span")
+            if span is not None:
+                event.setdefault("trace_id", span.trace_id)
+                event.setdefault("span_id", span.span_id)
+            access_log.emit(event)
+
+        try:
+            resp = await nxt(req)
+        except BaseException as e:
+            event["error"] = type(e).__name__
+            finalize(500)
+            raise
+        if isinstance(resp, StreamingResponse) and resp.chunks is not None:
+            inner = resp.chunks
+            event["stream"] = True
+
+            async def tailed():
+                # Emit only when the body finishes (or the client dies):
+                # token counts and phase durations are filled by inner
+                # middlewares' finallys, which run before this one —
+                # this wrapper is outermost, so its finally fires last.
+                try:
+                    async for chunk in inner:
+                        yield chunk
+                finally:
+                    finalize(resp.status)
+
+            resp.chunks = tailed()
+            return resp
+        finalize(resp.status)
+        return resp
+
+    return middleware

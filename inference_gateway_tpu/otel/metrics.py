@@ -38,6 +38,10 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
+    def values(self) -> dict[LabelValues, float]:
+        with self._lock:
+            return dict(self._values)
+
     def collect(self) -> str:
         pname = _sanitize_name(self.name)
         out = [f"# HELP {pname} {self.description}", f"# TYPE {pname} counter"]
@@ -67,6 +71,10 @@ class Gauge:
         key = tuple((labels or {}).get(n, "") for n in self.label_names)
         with self._lock:
             self._values[key] = value
+
+    def values(self) -> dict[LabelValues, float]:
+        with self._lock:
+            return dict(self._values)
 
     def collect(self) -> str:
         pname = _sanitize_name(self.name)
@@ -103,6 +111,12 @@ class Histogram:
             counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def total_count(self) -> int:
+        """Observations across every label set — the cheap "did anything
+        record here" probe tests and /debug/status lean on."""
+        with self._lock:
+            return sum(self._totals.values())
 
     def collect(self) -> str:
         pname = _sanitize_name(self.name)
@@ -159,6 +173,21 @@ class Registry:
         with self._lock:
             instruments = list(self._instruments)
         return "\n".join(i.collect() for i in instruments) + "\n"
+
+    def gauge_snapshot(self) -> dict[str, dict[str, float]]:
+        """JSON-able ``{gauge_name: {"label=value,...": value}}`` of every
+        gauge's current points — the /debug/status view of live state
+        (breaker codes, admission ledger, engine occupancy)."""
+        with self._lock:
+            gauges = [i for i in self._instruments if isinstance(i, Gauge)]
+        out: dict[str, dict[str, float]] = {}
+        for g in gauges:
+            points = {}
+            for key, val in sorted(g.values().items()):
+                label = ",".join(f"{n}={v}" for n, v in zip(g.label_names, key) if v)
+                points[label or "_total"] = val
+            out[g.name] = points
+        return out
 
 
 def replay_histogram(hist: Histogram, bucket_counts: list[int], bounds: list[float],

@@ -21,6 +21,12 @@ TEAM_UNKNOWN = "unknown"
 # Semconv-recommended boundaries (otel.go:80-83).
 DURATION_BOUNDARIES = (0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64, 1.28, 2.56, 5.12, 10.24, 20.48, 40.96, 81.92)
 TOKEN_BOUNDARIES = (1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216, 67108864)
+# Inter-token latency lives well under the request-duration scale: a
+# 7B-class decode step is single-digit milliseconds on TPU, hundreds of
+# ms through a saturated relay (ISSUE 3 token-level streaming metrics).
+TPOT_BOUNDARIES = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# Output throughput per stream, tokens/second.
+TOKEN_RATE_BOUNDARIES = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0)
 
 _BASE_LABELS = ("source", "team", "gen_ai_operation_name", "gen_ai_provider_name", "gen_ai_request_model")
 
@@ -120,6 +126,47 @@ class OpenTelemetry:
             "Graceful-drain lifecycle events (begun/completed/timed_out)",
             ("phase",), unit="{event}",
         )
+        # Token-level streaming instruments (ISSUE 3): the per-token
+        # latency visibility the ROADMAP north star is judged against —
+        # TPOT from the SSE relay and the scheduler emit path, queue wait
+        # from the sidecar's phase clock, per-stream output throughput.
+        self.time_per_output_token = r.histogram(
+            "gen_ai.server.time_per_output_token",
+            "Inter-token latency (TPOT) observed on the streaming path",
+            _BASE_LABELS, TPOT_BOUNDARIES, unit="s",
+        )
+        self.time_in_queue = r.histogram(
+            "gen_ai.server.time_in_queue",
+            "Time a request waited for a decode slot before prefill began",
+            _BASE_LABELS, DURATION_BOUNDARIES, unit="s",
+        )
+        self.output_tokens_per_second = r.histogram(
+            "gen_ai.server.output_tokens_per_second",
+            "Completion tokens per second over a finished stream",
+            _BASE_LABELS, TOKEN_RATE_BOUNDARIES, unit="{token}/s",
+        )
+        # Engine gauges (ISSUE 3): continuous-batching saturation signals
+        # sampled from a co-hosted Engine/Scheduler.
+        self.engine_slot_occupancy_gauge = r.gauge(
+            "inference_gateway.engine.slot_occupancy",
+            "Active decode slots / max_slots (0..1) per served model",
+            ("gen_ai_request_model",),
+        )
+        self.engine_kv_utilization_gauge = r.gauge(
+            "inference_gateway.engine.kv_page_utilization",
+            "KV-cache pages in use / total pages (0..1) per served model",
+            ("gen_ai_request_model",),
+        )
+        self.engine_queue_depth_gauge = r.gauge(
+            "inference_gateway.engine.queue_depth",
+            "Scheduler wait-queue depth per served model",
+            ("gen_ai_request_model",),
+        )
+        self.engine_spec_acceptance_gauge = r.gauge(
+            "inference_gateway.engine.spec_tokens_per_slot_round",
+            "Speculative decoding acceptance: emitted tokens per slot round",
+            ("gen_ai_request_model",),
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -194,6 +241,46 @@ class OpenTelemetry:
     def record_drain_event(self, phase: str) -> None:
         self.drain_counter.add(1, {"phase": phase})
 
+    # -- token-level streaming metrics (ISSUE 3) -------------------------
+    def record_time_to_first_chunk(self, source: str, team: str, provider: str,
+                                   model: str, seconds: float) -> None:
+        self.client_time_to_first_chunk.record(
+            seconds, self._base(source, team, provider, model))
+
+    def record_server_ttft(self, source: str, team: str, provider: str,
+                           model: str, seconds: float) -> None:
+        self.server_time_to_first_token.record(
+            seconds, self._base(source, team, provider, model))
+
+    def record_tpot(self, source: str, team: str, provider: str, model: str,
+                    seconds: float) -> None:
+        self.time_per_output_token.record(
+            seconds, self._base(source, team, provider, model))
+
+    def record_queue_wait(self, source: str, team: str, provider: str, model: str,
+                          seconds: float) -> None:
+        self.time_in_queue.record(seconds, self._base(source, team, provider, model))
+
+    def record_output_token_rate(self, source: str, team: str, provider: str,
+                                 model: str, tokens_per_second: float) -> None:
+        self.output_tokens_per_second.record(
+            tokens_per_second, self._base(source, team, provider, model))
+
+    # -- engine gauges (ISSUE 3) -----------------------------------------
+    def set_engine_gauges(self, model: str, *, slot_occupancy: float | None = None,
+                          kv_utilization: float | None = None,
+                          queue_depth: int | None = None,
+                          spec_tokens_per_slot_round: float | None = None) -> None:
+        labels = {"gen_ai_request_model": model}
+        if slot_occupancy is not None:
+            self.engine_slot_occupancy_gauge.set(slot_occupancy, labels)
+        if kv_utilization is not None:
+            self.engine_kv_utilization_gauge.set(kv_utilization, labels)
+        if queue_depth is not None:
+            self.engine_queue_depth_gauge.set(queue_depth, labels)
+        if spec_tokens_per_slot_round is not None:
+            self.engine_spec_acceptance_gauge.set(spec_tokens_per_slot_round, labels)
+
     def expose_prometheus(self) -> str:
         return self.registry.expose()
 
@@ -223,6 +310,10 @@ class OpenTelemetry:
             "gen_ai.client.operation.time_to_first_chunk": self.client_time_to_first_chunk,
             "gen_ai.server.time_to_first_token": self.server_time_to_first_token,
             "gen_ai.execute_tool.duration": self.execute_tool_duration,
+            # Sidecar-pushed token-level streaming metrics (ISSUE 3).
+            "gen_ai.server.time_per_output_token": self.time_per_output_token,
+            "gen_ai.server.time_in_queue": self.time_in_queue,
+            "gen_ai.server.output_tokens_per_second": self.output_tokens_per_second,
         }
 
         for rm in payload.get("resourceMetrics") or []:
@@ -346,4 +437,22 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_drain_event(self, *a, **k) -> None:
+        pass
+
+    def record_time_to_first_chunk(self, *a, **k) -> None:
+        pass
+
+    def record_server_ttft(self, *a, **k) -> None:
+        pass
+
+    def record_tpot(self, *a, **k) -> None:
+        pass
+
+    def record_queue_wait(self, *a, **k) -> None:
+        pass
+
+    def record_output_token_rate(self, *a, **k) -> None:
+        pass
+
+    def set_engine_gauges(self, *a, **k) -> None:
         pass

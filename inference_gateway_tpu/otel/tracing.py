@@ -10,15 +10,24 @@ SURVEY.md §5): spans per request, manual spans for tool execution, W3C
 from __future__ import annotations
 
 import json
-import random
+import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, NamedTuple
+
+_HEX_DIGITS = frozenset("0123456789abcdef")
 
 
 def _rand_hex(nbytes: int) -> str:
-    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+    """Trace/span id bytes from ``os.urandom`` — NOT the global seedable
+    ``random`` module: tests (and reproducible-sampling callers) seed the
+    global RNG, which made concurrently-created span ids collide, and a
+    W3C all-zero id is invalid anyway (the loop guard below)."""
+    while True:
+        out = os.urandom(nbytes).hex()
+        if any(c != "0" for c in out):
+            return out
 
 
 @dataclass
@@ -32,6 +41,9 @@ class Span:
     attributes: dict[str, Any] = field(default_factory=dict)
     status_code: str = "UNSET"
     status_message: str = ""
+    # W3C trace-flags sampled bit, inherited from the incoming context so
+    # a downstream hop never resamples what the edge decided.
+    sampled: bool = True
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
@@ -41,17 +53,46 @@ class Span:
         self.status_message = message
 
     def traceparent(self) -> str:
-        return f"00-{self.trace_id}-{self.span_id}-01"
+        flags = "01" if self.sampled else "00"
+        return f"00-{self.trace_id}-{self.span_id}-{flags}"
 
 
-def parse_traceparent(header: str | None) -> tuple[str, str] | None:
-    """Return (trace_id, span_id) from a traceparent header, or None."""
+class TraceContext(NamedTuple):
+    """Validated W3C traceparent fields."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool
+
+
+def parse_traceparent(header: str | None) -> TraceContext | None:
+    """Validated (trace_id, span_id, sampled) from a traceparent header.
+
+    W3C TraceContext §3.2: lowercase-hex fields only, all-zero trace or
+    parent ids are invalid, version 0xff is invalid, and a version-00
+    header has exactly four fields (future versions may append more).
+    Anything malformed returns None — the caller starts a fresh trace
+    instead of propagating garbage ids downstream.
+    """
     if not header:
         return None
     parts = header.strip().split("-")
-    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+    if len(parts) < 4:
         return None
-    return parts[1], parts[2]
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or not set(version) <= _HEX_DIGITS or version == "ff":
+        return None
+    if version == "00" and len(parts) != 4:
+        return None
+    if len(trace_id) != 32 or not set(trace_id) <= _HEX_DIGITS:
+        return None
+    if len(span_id) != 16 or not set(span_id) <= _HEX_DIGITS:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    if len(flags) != 2 or not set(flags) <= _HEX_DIGITS:
+        return None
+    return TraceContext(trace_id, span_id, bool(int(flags, 16) & 0x01))
 
 
 class Tracer:
@@ -68,21 +109,30 @@ class Tracer:
         self._lock = threading.Lock()
 
     def start_span(self, name: str, parent: Span | None = None,
-                   traceparent: str | None = None) -> Span:
+                   traceparent: str | None = None,
+                   start_ns: int | None = None) -> Span:
+        """New span. ``start_ns`` backdates the start (epoch ns) so phase
+        spans can be materialized from recorded timestamps — the serving
+        sidecar builds queue.wait/prefill/decode spans after the fact
+        from the scheduler's per-request phase clock."""
         ctx = parse_traceparent(traceparent)
+        sampled = True
         if parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
+            sampled = parent.sampled
         elif ctx is not None:
-            trace_id, parent_id = ctx
+            trace_id, parent_id = ctx.trace_id, ctx.span_id
+            sampled = ctx.sampled
         else:
             trace_id, parent_id = _rand_hex(16), ""
         return Span(
             name=name, trace_id=trace_id, span_id=_rand_hex(8), parent_span_id=parent_id,
-            start_ns=time.time_ns(),
+            start_ns=time.time_ns() if start_ns is None else start_ns,
+            sampled=sampled,
         )
 
-    def end_span(self, span: Span) -> None:
-        span.end_ns = time.time_ns()
+    def end_span(self, span: Span, end_ns: int | None = None) -> None:
+        span.end_ns = time.time_ns() if end_ns is None else end_ns
         if not self.enabled:
             return
         with self._lock:

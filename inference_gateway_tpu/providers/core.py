@@ -99,6 +99,14 @@ class Provider:
         h.set("X-MCP-Bypass", "true")
         return h
 
+    @staticmethod
+    def _traceparent(ctx: dict[str, Any] | None) -> str | None:
+        """W3C trace propagation (ISSUE 3): the edge request's span
+        context rides the loopback /proxy hop, so the inner dispatch —
+        and from there the TPU sidecar — joins the SAME trace instead of
+        starting a fresh one (the hop used to drop trace context)."""
+        return (ctx or {}).get("traceparent")
+
     def _prepare_streaming_request(self, req: dict[str, Any]) -> dict[str, Any]:
         out = dict(req)
         out["stream_options"] = {"include_usage": True}
@@ -111,7 +119,8 @@ class Provider:
                           timeout: float | None = None) -> dict[str, Any]:
         url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.models}"
         try:
-            resp = await self.client.get(url, headers=self._headers(ctx), timeout=timeout)
+            resp = await self.client.get(url, headers=self._headers(ctx), timeout=timeout,
+                                         traceparent=self._traceparent(ctx))
         except HTTPClientError as e:
             self.logger.error("failed to list models", e, "provider", self.name)
             raise
@@ -134,7 +143,8 @@ class Provider:
         url = f"/proxy/{self.cfg.id}{self.cfg.endpoints.chat}"
         body = json.dumps(req).encode()
         try:
-            resp = await self.client.post(url, body, headers=self._headers(ctx), timeout=timeout)
+            resp = await self.client.post(url, body, headers=self._headers(ctx), timeout=timeout,
+                                          traceparent=self._traceparent(ctx))
         except HTTPClientError as e:
             self.logger.error("failed to send request", e, "provider", self.name)
             raise
@@ -157,7 +167,7 @@ class Provider:
         stream_req = self._prepare_streaming_request(req)
         body = json.dumps(stream_req).encode()
         resp = await self.client.post(url, body, headers=self._headers(ctx), stream=True,
-                                      timeout=timeout)
+                                      timeout=timeout, traceparent=self._traceparent(ctx))
         if resp.status != 200:
             err_body = b""
             async for line in resp.iter_lines():

@@ -92,6 +92,14 @@ class Resilience:
         if self.otel is not None:
             self.otel.record_failover(alias, from_provider, to_provider)
 
+    def breaker_snapshot(self) -> dict[str, str]:
+        """JSON-able breaker states keyed ``provider/model`` — the
+        /debug/status view of upstream health (ISSUE 3)."""
+        return {
+            f"{provider}/{model}" if model else provider: state
+            for (provider, model), state in sorted(self.breakers.snapshot().items())
+        }
+
     # -- policy helpers --------------------------------------------------
     def healthy(self, deployment: Any) -> bool:
         """Health predicate for pool ordering (Deployment-shaped arg)."""
@@ -126,6 +134,7 @@ class Resilience:
         idempotent: bool = True,
         alias: str = "",
         result_ok: Callable[[Any], bool] | None = None,
+        event: dict[str, Any] | None = None,
     ) -> tuple[Any, Any]:
         """Run ``call`` against the first candidate that works.
 
@@ -139,6 +148,10 @@ class Resilience:
         Raises the last upstream error once candidates are exhausted,
         ``BudgetExceededError`` when the deadline is spent, or
         ``UpstreamUnavailableError`` when every circuit is open.
+
+        ``event`` (a wide-event dict, ISSUE 3) collects what the loop
+        did to the request — retries, failover hops, breaker-open skips
+        — for the access log line.
         """
         if budget is None:
             budget = self.new_budget()
@@ -150,9 +163,14 @@ class Resilience:
             breaker = self.breakers.get(cand.provider, cand.model)
             admitted, took_slot = breaker.admit()
             if not admitted:
+                if event is not None:
+                    event["breaker_skips"] = event.get("breaker_skips", 0) + 1
                 continue
             if prev_provider is not None:
                 self._record_failover(alias, prev_provider, cand.provider)
+                if event is not None:
+                    event.setdefault("failovers", []).append(
+                        f"{prev_provider}->{cand.provider}")
             prev_provider = cand.provider
             attempt = 0
             # True while an admission that CONSUMED a half-open probe slot
@@ -220,6 +238,8 @@ class Resilience:
                             # failover costs nothing.
                             break
                         self._record_retry(cand.provider, cand.model, type(e).__name__)
+                        if event is not None:
+                            event["retries"] = event.get("retries", 0) + 1
                         await self.clock.sleep(delay)
                     else:
                         # ``result_ok`` lets passthrough callers (the

@@ -230,6 +230,33 @@ class OverloadController:
         ``queue_depth``); compared against ``engine_depth_high_water``."""
         self._depth_probes.append(probe)
 
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able admission-ledger state for /debug/status (ISSUE 3):
+        per-class occupancy vs. caps, queue depths, the service-time
+        EWMA behind Retry-After, drain state, and live engine depth
+        probe readings."""
+        probes = []
+        for probe in self._depth_probes:
+            try:
+                probes.append(int(probe()))
+            except Exception:
+                probes.append(None)  # a broken probe is itself a finding
+        return {
+            "enabled": self.enabled,
+            "draining": self.draining,
+            "classes": {
+                name: {
+                    "in_flight": st.in_flight,
+                    "cap": st.cap,
+                    "queue_depth": len(st.waiters),
+                    "queue_cap": st.queue_cap,
+                    "service_time_ewma_s": round(st.service.per_request(), 4),
+                }
+                for name, st in self._classes.items()
+            },
+            "engine_depth_probes": probes,
+        }
+
     def overloaded(self) -> bool:
         """High-water check driving the shed decision: any admission
         queue past its mark, or any engine depth probe past its own."""
@@ -389,6 +416,12 @@ def admission_middleware(overload: OverloadController, logger=None):
         try:
             ticket = await overload.admit(endpoint_class, priority)
         except AdmissionRejectedError as e:
+            event = req.ctx.get("wide_event")
+            if event is not None:
+                # Shed annotation for the wide-event access log (ISSUE
+                # 3): the only downstream cost a rejected request pays.
+                event["shed"] = e.reason
+                event["retry_after_s"] = round(e.retry_after, 3)
             return e.to_response()
         try:
             resp = await nxt(req)

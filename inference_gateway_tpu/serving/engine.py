@@ -1390,6 +1390,19 @@ class Engine:
     def context_window(self) -> int:
         return min(self.config.max_seq_len, self.model_cfg.max_position_embeddings)
 
+    def kv_utilization(self) -> float:
+        """KV-cache pressure in [0, 1]: pages in use / total (paged
+        attention), 0.0 when the cache is a flat full reservation —
+        there is no page pool to exhaust. GIL-atomic int reads, safe to
+        sample from the serving thread without the engine lock (ISSUE 3
+        engine gauges)."""
+        if self.allocator is None:
+            return 0.0
+        total = self.allocator.num_pages
+        if total <= 0:
+            return 0.0
+        return 1.0 - self.allocator.free_page_count() / total
+
     def warmup(self) -> float:
         """Compile the decode program and the smallest prefill bucket."""
         t0 = time.perf_counter()

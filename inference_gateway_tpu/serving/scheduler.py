@@ -17,7 +17,7 @@ import queue
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable
 
 import numpy as np
@@ -50,6 +50,13 @@ class GenRequest:
     request_id: str = ""
     embeds: object = None  # (T, H) multimodal embedding override row
     seed: int | None = None  # reproducible sampling (OpenAI `seed`)
+    # Per-request phase clock (ISSUE 3 observability): epoch-ns stamps for
+    # submit → admit (queue.wait) → first_token (prefill) → finish
+    # (decode), written by the scheduler as the request crosses each
+    # boundary. The serving sidecar materializes trace child spans and
+    # queue-wait/TPOT histograms from these — span timestamps are epoch
+    # ns, hence time_ns() rather than the monotonic clock.
+    phase_ns: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -171,6 +178,7 @@ class Scheduler:
     def submit(self, req: GenRequest) -> str:
         if not req.request_id:
             req.request_id = f"req-{next(self._ids)}"
+        req.phase_ns.setdefault("submit", time.time_ns())
         limit = self.engine.context_window() - 1
         if len(req.prompt_ids) > limit:
             req.prompt_ids = req.prompt_ids[-limit:]
@@ -354,6 +362,7 @@ class Scheduler:
             self._fail_after_decode_error(e)
 
     def _fail_request(self, req: GenRequest) -> None:
+        req.phase_ns.setdefault("finish", time.time_ns())
         try:
             req.callback(0, 0.0, True, "error")
         except Exception:
@@ -409,6 +418,11 @@ class Scheduler:
             self.queue_depth = len(self._waiting)
         if not batch:
             return
+        admit_ns = time.time_ns()
+        for req in batch:
+            # Queue wait ends here: the request owns a slot and its
+            # prefill dispatch is imminent.
+            req.phase_ns.setdefault("admit", admit_ns)
         embeds = [r.embeds for r in batch]
         seeds = [r.seed for r in batch]
         try:
@@ -689,6 +703,8 @@ class Scheduler:
     def _emit(self, st: _SlotState, token: int, logprob: float) -> tuple[bool, str | None]:
         """Send one token to the request's callback; decide termination."""
         req = st.req
+        if "first_token" not in req.phase_ns:
+            req.phase_ns["first_token"] = time.time_ns()  # prefill ends
         eos = self.engine.tokenizer.eos_token_id
         is_stop = token == eos or token in req.stop_token_ids
         hit_max = st.generated >= req.max_tokens
@@ -697,6 +713,7 @@ class Scheduler:
         reason = None
         if finished:
             reason = "stop" if is_stop else "length"
+            req.phase_ns["finish"] = time.time_ns()  # decode ends
         try:
             req.callback(token, logprob, finished, reason)
         except Exception:

@@ -31,19 +31,41 @@ from typing import Any
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio import sse
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.otel.tracing import Tracer
 from inference_gateway_tpu.resilience.overload import ServiceTimeEstimator
 from inference_gateway_tpu.serving.engine import Engine, EngineConfig
 from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler, SchedulerSaturatedError
 from inference_gateway_tpu.serving.tokenizer import DetokenizeState
+
+# OTLP push bucket boundaries (delta histograms; the gateway ingest
+# replays observations at bucket midpoints).
+_PUSH_TTFT_BOUNDS = [0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4]
+_PUSH_TPOT_BOUNDS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0]
+# Cap on each pending-push sample list: with no push URL configured
+# nothing drains them, and a long-lived replica appending one float per
+# generated token must not grow without bound (review finding). 64k
+# samples ≈ far more than any push interval accumulates.
+_MAX_PENDING_SAMPLES = 65536
 
 
 class SidecarServer:
     def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
                  served_model_name: str | None = None, logger: Logger | None = None,
                  metrics_push_url: str | None = None, metrics_push_interval: float = 15.0,
-                 max_queue_depth: int = 0):
+                 max_queue_depth: int = 0, tracer: Tracer | None = None,
+                 otel=None, access_log=None):
         self.engine = engine
         self.logger = logger or new_logger()
+        # Observability wiring (ISSUE 3): a tracer for the sidecar's
+        # queue.wait/prefill/decode child spans (disabled by default —
+        # spans are built only when enabled), an optional co-hosted
+        # OpenTelemetry facade whose Registry receives queue-wait/TPOT
+        # histograms and engine gauges directly (the cross-process path
+        # is the OTLP push loop below), and an optional wide-event
+        # access log (one JSON line per request with phase durations).
+        self.tracer = tracer or Tracer("tpu-sidecar", enabled=False)
+        self.otel = otel
+        self.access_log = access_log
         # The scheduler's failure paths log through this logger —
         # without it a recurring _admit/_release bug would be invisible
         # in the deployed sidecar (round-3 review finding).
@@ -64,6 +86,13 @@ class SidecarServer:
         self.metrics_push_url = metrics_push_url
         self.metrics_push_interval = metrics_push_interval
         self._ttft_samples: list[float] = []
+        # Token-level streaming samples (ISSUE 3): inter-token latency
+        # from the scheduler emit path, queue wait from the per-request
+        # phase clock. Appended from the scheduler thread, swapped out
+        # whole by the push loop — same GIL-atomic list discipline as
+        # _ttft_samples.
+        self._tpot_samples: list[float] = []
+        self._queue_wait_samples: list[float] = []
         self._pushed_decode_tokens = 0
         self._push_task: asyncio.Task | None = None
 
@@ -81,7 +110,7 @@ class SidecarServer:
         if self._own_scheduler:
             self.scheduler.start()
         bound = await self.http.start(host, port)
-        if self.metrics_push_url:
+        if self.metrics_push_url or (self.tracer.enabled and self.tracer.otlp_endpoint):
             self._push_task = asyncio.create_task(self._metrics_push_loop())
         return bound
 
@@ -100,43 +129,94 @@ class SidecarServer:
 
     # -- OTLP metrics push ---------------------------------------------
     def record_ttft(self, seconds: float) -> None:
-        self._ttft_samples.append(seconds)
+        if len(self._ttft_samples) < _MAX_PENDING_SAMPLES:
+            self._ttft_samples.append(seconds)
+        if self.otel is not None:
+            self.otel.record_server_ttft("tpu-sidecar", "", "tpu", self.model_name, seconds)
 
-    def _otlp_payload(self) -> dict[str, Any] | None:
-        """Delta OTLP-JSON payload of TTFT histogram since last push."""
-        samples, self._ttft_samples = self._ttft_samples, []
-        if not samples:
-            return None
-        bounds = [0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4]
+    def record_tpot(self, seconds: float) -> None:
+        """Inter-token latency off the scheduler emit path."""
+        if len(self._tpot_samples) < _MAX_PENDING_SAMPLES:
+            self._tpot_samples.append(seconds)
+        if self.otel is not None:
+            self.otel.record_tpot("tpu-sidecar", "", "tpu", self.model_name, seconds)
+
+    def record_queue_wait(self, seconds: float) -> None:
+        if len(self._queue_wait_samples) < _MAX_PENDING_SAMPLES:
+            self._queue_wait_samples.append(seconds)
+        if self.otel is not None:
+            self.otel.record_queue_wait("tpu-sidecar", "", "tpu", self.model_name, seconds)
+
+    def sample_engine_gauges(self) -> dict[str, float]:
+        """Engine/Scheduler saturation gauges (ISSUE 3): slot occupancy,
+        KV page utilization, queue depth, speculative acceptance. Sampled
+        on request completion and on every /metrics scrape; mirrored into
+        a co-hosted OpenTelemetry Registry when one is wired."""
+        sched = self.scheduler
+        gauges: dict[str, float] = {
+            "slot_occupancy": sched.active_requests() / max(1, self.engine.config.max_slots),
+            "kv_page_utilization": self.engine.kv_utilization(),
+            "queue_depth": float(sched.queue_depth),
+        }
+        spec_rate = None
+        if self.engine.spec and sched.spec_slot_rounds:
+            spec_rate = sched.spec_emitted / sched.spec_slot_rounds
+            gauges["spec_tokens_per_slot_round"] = spec_rate
+        if self.otel is not None:
+            self.otel.set_engine_gauges(
+                self.model_name,
+                slot_occupancy=gauges["slot_occupancy"],
+                kv_utilization=gauges["kv_page_utilization"],
+                queue_depth=sched.queue_depth,
+                spec_tokens_per_slot_round=spec_rate,
+            )
+        return gauges
+
+    @staticmethod
+    def _delta_histogram(name: str, samples: list[float], bounds: list[float],
+                         attrs: list[dict[str, Any]]) -> dict[str, Any]:
         counts = [0] * (len(bounds) + 1)
         for s in samples:
             i = 0
             while i < len(bounds) and s > bounds[i]:
                 i += 1
             counts[i] += 1
+        return {
+            "name": name,
+            "histogram": {
+                "aggregationTemporality": 1,
+                "dataPoints": [{
+                    "bucketCounts": [str(c) for c in counts],
+                    "explicitBounds": bounds,
+                    "sum": sum(samples),
+                    "count": str(len(samples)),
+                    "attributes": attrs,
+                }],
+            },
+        }
+
+    def _otlp_payload(self) -> dict[str, Any] | None:
+        """Delta OTLP-JSON payload of the TTFT, inter-token-latency, and
+        queue-wait histograms accumulated since the last push."""
+        batches = [
+            ("gen_ai.server.time_to_first_token", self._ttft_samples, _PUSH_TTFT_BOUNDS),
+            ("gen_ai.server.time_per_output_token", self._tpot_samples, _PUSH_TPOT_BOUNDS),
+            ("gen_ai.server.time_in_queue", self._queue_wait_samples, _PUSH_TTFT_BOUNDS),
+        ]
+        self._ttft_samples, self._tpot_samples, self._queue_wait_samples = [], [], []
         attrs = [
             {"key": "gen_ai.provider.name", "value": {"stringValue": "tpu"}},
             {"key": "gen_ai.request.model", "value": {"stringValue": self.model_name}},
         ]
+        metrics = [self._delta_histogram(name, samples, bounds, attrs)
+                   for name, samples, bounds in batches if samples]
+        if not metrics:
+            return None
         return {
             "resourceMetrics": [{
                 "resource": {"attributes": [
                     {"key": "service.name", "value": {"stringValue": "tpu-sidecar"}}]},
-                "scopeMetrics": [{
-                    "metrics": [{
-                        "name": "gen_ai.server.time_to_first_token",
-                        "histogram": {
-                            "aggregationTemporality": 1,
-                            "dataPoints": [{
-                                "bucketCounts": [str(c) for c in counts],
-                                "explicitBounds": bounds,
-                                "sum": sum(samples),
-                                "count": str(len(samples)),
-                                "attributes": attrs,
-                            }],
-                        },
-                    }],
-                }],
+                "scopeMetrics": [{"metrics": metrics}],
             }]
         }
 
@@ -146,17 +226,23 @@ class SidecarServer:
         client = HTTPClient()
         while True:
             await asyncio.sleep(self.metrics_push_interval)
+            # Drain pending samples on every cycle even when only trace
+            # export is configured — the cap above bounds the no-loop
+            # case, this keeps the looped case at steady state.
             payload = self._otlp_payload()
-            if payload is None:
-                continue
-            try:
-                await client.post(
-                    self.metrics_push_url,
-                    json.dumps(payload).encode(),
-                    headers={"Content-Type": "application/json", "X-Source": "tpu-sidecar"},
-                )
-            except Exception as e:
-                self.logger.warn("metrics push failed", "error", str(e))
+            if payload is not None and self.metrics_push_url:
+                try:
+                    await client.post(
+                        self.metrics_push_url,
+                        json.dumps(payload).encode(),
+                        headers={"Content-Type": "application/json", "X-Source": "tpu-sidecar"},
+                    )
+                except Exception as e:
+                    self.logger.warn("metrics push failed", "error", str(e))
+            # Standalone-process tracing (ISSUE 3): the phase spans built
+            # in _finalize_request export OTLP/JSON on the same cadence.
+            if self.tracer.enabled and self.tracer.otlp_endpoint:
+                await self.tracer.export_once(client)
 
     # -- handlers ------------------------------------------------------
     HEALTH_STALL_SECONDS = 60.0
@@ -212,6 +298,9 @@ class SidecarServer:
                 m["spec_tokens_per_slot_round"] = round(
                     self.scheduler.spec_emitted / self.scheduler.spec_slot_rounds, 3)
         m["uptime_seconds"] = round(time.monotonic() - self._started, 3)
+        gauges = self.sample_engine_gauges()  # refresh on every scrape
+        m["slot_occupancy"] = round(gauges["slot_occupancy"], 4)
+        m["kv_page_utilization"] = round(gauges["kv_page_utilization"], 4)
         if self.engine.allocator is not None:
             m["kv_pages_total"] = self.engine.allocator.num_pages
             m["kv_pages_free"] = self.engine.allocator.free_page_count()
@@ -322,12 +411,21 @@ class SidecarServer:
         q: asyncio.Queue = asyncio.Queue()
         arrival = time.monotonic()
         first_token_seen = False
+        last_token_t: list[float | None] = [None]
+        traceparent = req.headers.get("traceparent")
 
         def cb(token: int, logprob: float, finished: bool, reason: str | None) -> None:
+            # Runs on the scheduler thread — this IS the emit path, so
+            # the inter-token gaps recorded here are true per-token
+            # latency, not relay-block arrival jitter (ISSUE 3).
             nonlocal first_token_seen
+            now = time.monotonic()
             if not first_token_seen:
                 first_token_seen = True
-                self.record_ttft(time.monotonic() - arrival)
+                self.record_ttft(now - arrival)
+            elif last_token_t[0] is not None:
+                self.record_tpot(now - last_token_t[0])
+            last_token_t[0] = now
             loop.call_soon_threadsafe(q.put_nowait, (token, logprob, finished, reason))
 
         gen.callback = cb
@@ -346,7 +444,7 @@ class SidecarServer:
 
         if stream:
             return StreamingResponse.sse(
-                self._stream_chunks(gen, meta, q, include_usage, arrival))
+                self._stream_chunks(gen, meta, q, include_usage, arrival, traceparent))
 
         # Non-streaming: drain the queue to completion.
         detok = DetokenizeState()
@@ -364,6 +462,8 @@ class SidecarServer:
                 reason = fin_reason or "stop"
                 break
         self._observe_service(time.monotonic() - arrival)
+        self._finalize_request(gen, meta, traceparent, completion_tokens, stream=False,
+                               finish_reason=reason)
         text, reason = self._apply_stop_strings(detok.emitted, meta["stop_strings"], reason)
         choice: dict[str, Any] = {
             "index": 0,
@@ -401,8 +501,73 @@ class SidecarServer:
         backlog = self.scheduler.queue_depth + self.scheduler.active_requests() + 1
         return int(self._service.retry_after(backlog, self.engine.config.max_slots))
 
+    def _finalize_request(self, gen: GenRequest, meta: dict[str, Any],
+                          traceparent: str | None, completion_tokens: int,
+                          stream: bool, finish_reason: str | None) -> None:
+        """Per-request observability epilogue (ISSUE 3): materialize the
+        queue.wait/prefill/decode child spans from the scheduler's phase
+        clock, record the queue-wait sample and output token rate, sample
+        engine gauges, and emit the wide-event access-log line. Durations
+        degrade gracefully — an abandoned stream may lack later stamps."""
+        ph = gen.phase_ns
+        submit, admit = ph.get("submit"), ph.get("admit")
+        first, finish = ph.get("first_token"), ph.get("finish")
+
+        if submit is not None and admit is not None:
+            self.record_queue_wait(max(admit - submit, 0) / 1e9)
+        if (self.otel is not None and first is not None and finish is not None
+                and completion_tokens > 1 and finish > first):
+            self.otel.record_output_token_rate(
+                "tpu-sidecar", "", "tpu", self.model_name,
+                (completion_tokens - 1) / ((finish - first) / 1e9))
+
+        trace_id = ""
+        if self.tracer.enabled and submit is not None:
+            end_ns = finish or ph.get("first_token") or submit
+            root = self.tracer.start_span("tpu_sidecar.chat_completions",
+                                          traceparent=traceparent, start_ns=submit)
+            trace_id = root.trace_id
+            root.set_attribute("gen_ai.request.model", meta["model"])
+            root.set_attribute("gen_ai.provider.name", "tpu")
+            root.set_attribute("request.id", gen.request_id or meta["id"])
+            root.set_attribute("gen_ai.usage.input_tokens", meta["prompt_tokens"])
+            root.set_attribute("gen_ai.usage.output_tokens", completion_tokens)
+            phases = (("queue.wait", submit, admit), ("prefill", admit, first),
+                      ("decode", first, finish))
+            for name, t0, t1 in phases:
+                if t0 is None or t1 is None:
+                    continue
+                child = self.tracer.start_span(name, parent=root, start_ns=t0)
+                self.tracer.end_span(child, end_ns=max(t1, t0))
+            self.tracer.end_span(root, end_ns=end_ns)
+
+        if self.access_log is not None:
+            to_ms = lambda a, b: round((b - a) / 1e6, 3) if a is not None and b is not None else None  # noqa: E731
+            ctx = None
+            if not trace_id:
+                from inference_gateway_tpu.otel.tracing import parse_traceparent
+
+                ctx = parse_traceparent(traceparent)
+            self.access_log.emit({
+                "route": "/v1/chat/completions",
+                "provider": "tpu",
+                "model": meta["model"],
+                "request_id": gen.request_id or meta["id"],
+                "trace_id": trace_id or (ctx.trace_id if ctx else None),
+                "stream": stream,
+                "finish_reason": finish_reason,
+                "input_tokens": meta["prompt_tokens"],
+                "output_tokens": completion_tokens,
+                "queue_wait_ms": to_ms(submit, admit),
+                "prefill_ms": to_ms(admit, first),
+                "decode_ms": to_ms(first, finish),
+            })
+
+        self.sample_engine_gauges()
+
     async def _stream_chunks(self, gen: GenRequest, meta: dict[str, Any], q: asyncio.Queue,
-                             include_usage: bool, arrival: float):
+                             include_usage: bool, arrival: float,
+                             traceparent: str | None = None):
         """OpenAI chat.completion.chunk SSE frames off the decode loop.
         The request is already submitted (admission happens in
         chat_completions, where saturation can still become a 429)."""
@@ -416,65 +581,96 @@ class SidecarServer:
                 "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
             })
 
-        yield chunk({"role": "assistant", "content": ""}, None)
-
         detok = DetokenizeState()
         completion_tokens = 0
         reason = "stop"
-        stop_strings = meta["stop_strings"]
-        emitted_len = 0
-        stopped_early = False
-        while True:
-            token, _logprob, finished, fin_reason = await q.get()
-            completion_tokens += 1
-            if not (finished and fin_reason == "stop"):
-                delta = detok.push(self.engine.tokenizer, token)
-            else:
-                delta = ""
-            if stop_strings and not stopped_early:
-                cut, new_reason = self._apply_stop_strings(detok.emitted, stop_strings, "")
-                if new_reason == "stop":
-                    delta = cut[emitted_len:]
-                    stopped_early = True
-                    reason = "stop"
-                    if delta:
-                        emitted_len += len(delta)
-                        yield chunk({"content": delta}, None)
-                    break
-            if delta and not stopped_early:
-                emitted_len += len(delta)
-                yield chunk({"content": delta}, None)
-            if finished:
-                reason = fin_reason or "stop"
-                break
+        try:
+            yield chunk({"role": "assistant", "content": ""}, None)
 
-        self._observe_service(time.monotonic() - arrival)
-        yield chunk({}, reason)
-        if include_usage:
-            yield sse.format_event({
-                "id": meta["id"],
-                "object": "chat.completion.chunk",
-                "created": meta["created"],
-                "model": meta["model"],
-                "choices": [],
-                "usage": {
-                    "prompt_tokens": meta["prompt_tokens"],
-                    "completion_tokens": completion_tokens,
-                    "total_tokens": meta["prompt_tokens"] + completion_tokens,
-                },
-            })
-        yield sse.DONE_FRAME
+            stop_strings = meta["stop_strings"]
+            emitted_len = 0
+            stopped_early = False
+            while True:
+                token, _logprob, finished, fin_reason = await q.get()
+                completion_tokens += 1
+                if not (finished and fin_reason == "stop"):
+                    delta = detok.push(self.engine.tokenizer, token)
+                else:
+                    delta = ""
+                if stop_strings and not stopped_early:
+                    cut, new_reason = self._apply_stop_strings(detok.emitted, stop_strings, "")
+                    if new_reason == "stop":
+                        delta = cut[emitted_len:]
+                        stopped_early = True
+                        reason = "stop"
+                        if delta:
+                            emitted_len += len(delta)
+                            yield chunk({"content": delta}, None)
+                        break
+                if delta and not stopped_early:
+                    emitted_len += len(delta)
+                    yield chunk({"content": delta}, None)
+                if finished:
+                    reason = fin_reason or "stop"
+                    break
+
+            self._observe_service(time.monotonic() - arrival)
+            yield chunk({}, reason)
+            if include_usage:
+                yield sse.format_event({
+                    "id": meta["id"],
+                    "object": "chat.completion.chunk",
+                    "created": meta["created"],
+                    "model": meta["model"],
+                    "choices": [],
+                    "usage": {
+                        "prompt_tokens": meta["prompt_tokens"],
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": meta["prompt_tokens"] + completion_tokens,
+                    },
+                })
+            yield sse.DONE_FRAME
+        finally:
+            # Runs for completed AND abandoned streams (the server
+            # acloses the generator on dead clients): phase spans, the
+            # queue-wait sample, and the access-log line must not leak.
+            self._finalize_request(gen, meta, traceparent, completion_tokens,
+                                   stream=True, finish_reason=reason)
 
 
 async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                 served_model_name: str | None = None, metrics_push_url: str | None = None) -> None:
-    """Run the sidecar until cancelled (entry point for __main__)."""
+    """Run the sidecar until cancelled (entry point for __main__).
+
+    The standalone sidecar honors the gateway's TELEMETRY_* env surface
+    (ISSUE 3): TELEMETRY_TRACING_ENABLE turns on the phase-span tracer
+    (exported to TELEMETRY_TRACING_OTLP_ENDPOINT on the push cadence),
+    TELEMETRY_ACCESS_LOG the per-request wide-event JSON line."""
+    import os
+
+    from inference_gateway_tpu.config import _get_bool
+
+    def env_on(key: str) -> bool:
+        return _get_bool(os.environ, key, False)
+
     logger = new_logger()
     engine = Engine(config)
     warm = engine.warmup()
     logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)
+    tracer = None
+    if env_on("TELEMETRY_TRACING_ENABLE"):
+        tracer = Tracer(
+            "tpu-sidecar", enabled=True, logger=logger,
+            otlp_endpoint=os.environ.get("TELEMETRY_TRACING_OTLP_ENDPOINT", ""),
+        )
+    access_log = None
+    if env_on("TELEMETRY_ACCESS_LOG"):
+        from inference_gateway_tpu.otel.access_log import AccessLog
+
+        access_log = AccessLog(service="tpu-sidecar")
     server = SidecarServer(engine, served_model_name=served_model_name, logger=logger,
-                           metrics_push_url=metrics_push_url)
+                           metrics_push_url=metrics_push_url, tracer=tracer,
+                           access_log=access_log)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:

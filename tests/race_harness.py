@@ -174,3 +174,68 @@ def instrument(scheduler, recorder: _Recorder | None = None) -> _Recorder:
 def start_instrumented(scheduler) -> None:
     scheduler.start()
     scheduler._slots.owner_thread = scheduler._thread
+
+
+def hammer_registry(registry, writer_threads: int = 8, reader_threads: int = 2,
+                    iters: int = 400) -> list[str]:
+    """Concurrency hammer for the metrics ``Registry`` (ISSUE 3 satellite).
+
+    The registry is mutated from every thread in the process — asyncio
+    handlers, the scheduler thread's emit path, the metrics listener's
+    scrapes — so its locking contract is load-bearing. N writer threads
+    add/set/record against shared instruments (with overlapping label
+    sets, including exposition-hostile label values) while reader threads
+    collect() concurrently. Returns error strings; empty means no
+    exceptions, no torn exposition, and exactly-conserved counter totals.
+    """
+    counter = registry.counter("race.hammer.counter", "hammer", ("k",))
+    gauge = registry.gauge("race.hammer.gauge", "hammer", ("k",))
+    hist = registry.histogram("race.hammer.hist", "hammer", ("k",), (0.1, 1.0, 10.0))
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+    barrier = threading.Barrier(writer_threads + reader_threads)
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    def writer(tid: int) -> None:
+        barrier.wait()
+        labels = {"k": f't{tid % 4}"\\\n'}  # escaping-hostile label value
+        for i in range(iters):
+            try:
+                counter.add(1, labels)
+                gauge.set(i, labels)
+                hist.record((i % 23) / 2.0, labels)
+            except Exception as e:
+                fail(f"writer: {e!r}")
+                return
+
+    def reader() -> None:
+        barrier.wait()
+        for _ in range(iters):
+            try:
+                text = registry.expose()
+                if "race_hammer_counter" not in text:
+                    fail("counter series missing from exposition")
+                    return
+            except Exception as e:
+                fail(f"reader: {e!r}")
+                return
+
+    threads = [threading.Thread(target=writer, args=(t,), name=f"hammer-w{t}", daemon=True)
+               for t in range(writer_threads)]
+    threads += [threading.Thread(target=reader, name=f"hammer-r{t}", daemon=True)
+                for t in range(reader_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        if t.is_alive():
+            fail(f"{t.name} did not finish")
+    total = sum(counter.values().values())
+    if total != writer_threads * iters:
+        fail(f"counter lost updates: {total} != {writer_threads * iters}")
+    if hist.total_count() != writer_threads * iters:
+        fail(f"histogram lost observations: {hist.total_count()} != {writer_threads * iters}")
+    return errors

@@ -32,6 +32,43 @@ def test_record_and_expose():
     assert 'team="team-a"' in text
 
 
+def test_prometheus_label_escaping_roundtrip():
+    """Label values containing backslash, quote, and newline must escape
+    per exposition format 0.0.4 and round-trip through collect() —
+    an unescaped newline tears the exposition into garbage series
+    (ISSUE 3 satellite)."""
+    import re
+
+    from inference_gateway_tpu.otel.metrics import Registry
+
+    evil = 'a\\b"c\nd'
+    r = Registry()
+    c = r.counter("esc.counter", "desc", ("k",))
+    c.add(2, {"k": evil})
+    g = r.gauge("esc.gauge", "desc", ("k",))
+    g.set(1.5, {"k": evil})
+    h = r.histogram("esc.hist", "desc", ("k",), (1.0,))
+    h.record(0.5, {"k": evil})
+    text = r.expose()
+
+    # Every line is a comment or a well-formed sample — no line may be a
+    # fragment produced by a raw newline inside a label value.
+    for line in text.splitlines():
+        if line:
+            assert line.startswith("#") or re.match(r"^esc_\w+\{", line), line
+
+    # The counter sample's label value unescapes back to the original.
+    m = re.search(r'esc_counter\{k="((?:[^"\\]|\\.)*)"\} 2', text)
+    assert m is not None, text
+    unescaped = (m.group(1).replace("\\\\", "\x00").replace('\\"', '"')
+                 .replace("\\n", "\n").replace("\x00", "\\"))
+    assert unescaped == evil
+    # All three instrument kinds carry the same escaped form.
+    assert text.count('k="a\\\\b\\"c\\nd"') >= 3
+    # Histogram series keep their cumulative shape alongside the label.
+    assert re.search(r'esc_hist_bucket\{k="[^\n]*",le="1"\} 1', text)
+
+
 def test_histogram_buckets_cumulative():
     otel = OpenTelemetry()
     for v in (0.005, 0.05, 3.0):
@@ -128,11 +165,67 @@ def test_traceparent_roundtrip():
     root = t.start_span("GET /x")
     header = root.traceparent()
     parsed = parse_traceparent(header)
-    assert parsed == (root.trace_id, root.span_id)
+    assert (parsed.trace_id, parsed.span_id) == (root.trace_id, root.span_id)
+    assert parsed.sampled is True
     child = t.start_span("child", traceparent=header)
     assert child.trace_id == root.trace_id
     assert child.parent_span_id == root.span_id
     assert parse_traceparent("garbage") is None
+
+
+def test_parse_traceparent_w3c_compliance():
+    """W3C §3.2 validation: non-hex and all-zero ids are invalid, as are
+    bad versions; valid headers parse field-exactly (ISSUE 3 satellite)."""
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    ok = parse_traceparent(f"00-{tid}-{sid}-01")
+    assert ok == (tid, sid, True)
+    # Sampled flag off parses as False, other flag bits tolerated.
+    assert parse_traceparent(f"00-{tid}-{sid}-00").sampled is False
+    assert parse_traceparent(f"00-{tid}-{sid}-02").sampled is False
+    # Non-hex trace/span ids (the seed accepted these).
+    assert parse_traceparent(f"00-{'g' * 32}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{'z' * 16}-01") is None
+    # All-zero trace/span ids are explicitly invalid.
+    assert parse_traceparent(f"00-{'0' * 32}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+    # Version ff is invalid; version 00 must have exactly 4 fields;
+    # future versions may carry extra fields.
+    assert parse_traceparent(f"ff-{tid}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{sid}-01-extra") is None
+    assert parse_traceparent(f"01-{tid}-{sid}-01-extra") == (tid, sid, True)
+    # Length/field-count garbage.
+    assert parse_traceparent(f"00-{tid[:-1]}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{sid}") is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent(None) is None
+
+
+def test_sampled_flag_propagates_not_hardcoded():
+    """An unsampled inbound context must stay unsampled on the outbound
+    hop — the seed hardcoded `-01` (ISSUE 3 satellite)."""
+    t = Tracer("svc")
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    span = t.start_span("op", traceparent=f"00-{tid}-b7ad6b7169203331-00")
+    assert span.sampled is False
+    assert span.traceparent().endswith("-00")
+    assert span.trace_id == tid
+    # And a sampled parent yields a sampled child header.
+    child = t.start_span("child", parent=span)
+    assert child.sampled is False
+
+
+def test_span_ids_unique_under_seeded_global_random():
+    """Span id generation must not ride the seedable global RNG: two
+    tracers seeded identically used to produce colliding ids."""
+    import random
+
+    random.seed(1234)
+    a = Tracer("svc").start_span("a")
+    random.seed(1234)
+    b = Tracer("svc").start_span("b")
+    assert a.span_id != b.span_id
+    assert a.trace_id != b.trace_id
+    assert a.trace_id != "0" * 32 and a.span_id != "0" * 16
 
 
 def test_span_export_payload():
@@ -241,6 +334,7 @@ async def test_streaming_usage_scan_survives_block_split_lines():
         def __init__(self):
             self.usage = None
             self.tools = []
+            self.tpot = []
 
         def record_request_duration(self, *a):
             pass
@@ -250,6 +344,15 @@ async def test_streaming_usage_scan_survives_block_split_lines():
 
         def record_tool_call(self, source, team, provider, model, kind, name):
             self.tools.append(name)
+
+        def record_time_to_first_chunk(self, *a):
+            pass
+
+        def record_tpot(self, source, team, provider, model, seconds):
+            self.tpot.append(seconds)
+
+        def record_output_token_rate(self, *a):
+            pass
 
     usage_chunk = (
         b'data: {"choices":[],"usage":{"prompt_tokens":11,"completion_tokens":5}}\n\n'
@@ -302,6 +405,15 @@ async def test_responses_api_tool_calls_recorded():
 
         def record_tool_call(self, source, team, provider, model, kind, name):
             self.tools.append(name)
+
+        def record_time_to_first_chunk(self, *a):
+            pass
+
+        def record_tpot(self, *a):
+            pass
+
+        def record_output_token_rate(self, *a):
+            pass
 
     # Non-streaming: output items of type function_call.
     body = {

@@ -116,6 +116,56 @@ def test_decode_malformed_raises():
         decode_export_metrics_request(b"\x0b")  # wire type 3 (group)
 
 
+def test_decode_any_value_types_and_gauge():
+    """AnyValue bool/negative-int/double decoding plus the gauge body —
+    the point shapes ingest sees from real SDK exporters (ISSUE 3
+    satellite coverage)."""
+    attr_bool = _ld(1, b"flag") + _ld(2, _tag(2, 0) + _varint(1))
+    neg = (1 << 64) - 5  # two's-complement varint for -5
+    attr_int = _ld(1, b"n") + _ld(2, _vint(3, neg))
+    attr_dbl = _ld(1, b"d") + _ld(2, _dbl(4, 2.5))
+    dp = _ld(7, attr_bool) + _ld(7, attr_int) + _ld(7, attr_dbl) + _dbl(4, 1.25)
+    metric = _ld(1, b"some.gauge") + _ld(5, _ld(1, dp))
+    payload = decode_export_metrics_request(_ld(1, _ld(2, _ld(2, metric))))
+    m = payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]
+    point = m["gauge"]["dataPoints"][0]
+    assert point["asDouble"] == 1.25
+    attrs = {a["key"]: a["value"] for a in point["attributes"]}
+    assert attrs["flag"] == {"boolValue": True}
+    assert attrs["n"] == {"intValue": -5}
+    assert attrs["d"] == {"doubleValue": 2.5}
+
+
+def test_decode_unpacked_repeated_histogram_fields():
+    """bucketCounts/explicitBounds sent UNPACKED (one wt1 field per
+    element — legal proto3 for repeated scalars) must decode identically
+    to the packed form."""
+    dp = _f64(6, 1) + _f64(6, 2) + _dbl(7, 0.5) + _f64(4, 3) + _dbl(5, 1.0)
+    metric = _ld(1, b"gen_ai.server.request.duration") + _ld(9, _ld(1, dp) + _vint(2, 1))
+    payload = decode_export_metrics_request(_ld(1, _ld(2, _ld(2, metric))))
+    point = payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]["histogram"]["dataPoints"][0]
+    assert point["bucketCounts"] == [1, 2]
+    assert point["explicitBounds"] == [0.5]
+    assert point["count"] == 3 and point["sum"] == 1.0
+
+
+def test_decode_packed_length_and_truncation_validation():
+    # Packed fixed64 payload whose length is not a multiple of 8.
+    bad_hist = _ld(1, _ld(6, b"\x01\x02\x03")) + _vint(2, 1)
+    metric = _ld(1, b"m") + _ld(9, bad_hist)
+    with pytest.raises(ProtoDecodeError):
+        decode_export_metrics_request(_ld(1, _ld(2, _ld(2, metric))))
+    # fixed64 field with fewer than 8 bytes left.
+    with pytest.raises(ProtoDecodeError):
+        decode_export_metrics_request(_tag(1, 1) + b"\x00\x00")
+    # fixed32 field with fewer than 4 bytes left.
+    with pytest.raises(ProtoDecodeError):
+        decode_export_metrics_request(_tag(1, 5) + b"\x00")
+    # Varint running past the buffer.
+    with pytest.raises(ProtoDecodeError):
+        decode_export_metrics_request(b"\x80\x80")
+
+
 def test_ingest_from_protobuf_matches_json_path():
     otel = OpenTelemetry()
     result = otel.ingest_metrics(decode_export_metrics_request(_sum_request(value=4)), "src")

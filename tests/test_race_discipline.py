@@ -22,6 +22,7 @@ from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
 
 from tests.race_harness import (
     DisciplineViolation,
+    hammer_registry,
     instrument,
     start_instrumented,
 )
@@ -116,3 +117,14 @@ def test_harness_detects_unlocked_allocator_call():
     with pytest.raises(DisciplineViolation):
         eng.allocator.ensure_capacity(0, 16)
     assert rec.violations
+
+
+def test_metrics_registry_survives_concurrent_add_and_collect():
+    """The metrics Registry is hammered from every thread in the process
+    (handler coroutines, the scheduler emit path, metrics scrapes):
+    concurrent add/set/record/collect must lose nothing and never tear
+    the exposition (ISSUE 3 satellite)."""
+    from inference_gateway_tpu.otel.metrics import Registry
+
+    errors = hammer_registry(Registry())
+    assert errors == [], errors
