@@ -291,6 +291,80 @@ async def bench_telemetry_overhead(n: int = 200) -> dict:
     }
 
 
+async def bench_profiling_overhead(n: int = 200) -> dict:
+    """p99 per-request latency with the full ISSUE 4 introspection stack
+    on (continuous profiling + event-loop watchdog + slow-request
+    forensics) vs. telemetry-only — the acceptance gate: continuous
+    introspection must stay under a few percent of p99 or operators will
+    run blind in production."""
+    import io
+
+    async def chat(req: Request) -> Response:
+        return Response.json({
+            "id": "b", "object": "chat.completion", "created": 1, "model": "m",
+            "choices": [{"index": 0, "message": {"role": "assistant", "content": "ok"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 2, "total_tokens": 12},
+        })
+
+    async def run_variant(profiling_on: bool) -> list[float]:
+        r = Router()
+        r.post("/v1/chat/completions", chat)
+        upstream = HTTPServer(r)
+        up_port = await upstream.start("127.0.0.1", 0)
+        env = {
+            "OLLAMA_API_URL": f"http://127.0.0.1:{up_port}/v1",
+            "SERVER_PORT": "0",
+            "TELEMETRY_ENABLE": "true",
+            "TELEMETRY_ACCESS_LOG": "true",
+            "TELEMETRY_METRICS_PORT": "0",
+        }
+        if profiling_on:
+            env.update({
+                "TELEMETRY_PROFILING_ENABLE": "true",
+                "TELEMETRY_PROFILING_CONTINUOUS": "true",
+                "TELEMETRY_PROFILING_HZ": "97",
+                "TELEMETRY_PROFILING_WINDOW": "2s",
+                "TELEMETRY_PROFILING_WATCHDOG": "true",
+                "TELEMETRY_PROFILING_WATCHDOG_INTERVAL": "100ms",
+                "TELEMETRY_SLOW_REQUEST_TOTAL": "10s",
+            })
+        gw = build_gateway(env=env)
+        if gw.access_log is not None:
+            gw.access_log._stream = io.StringIO()  # keep bench stdout parseable
+        port = await gw.start("127.0.0.1", 0)
+        client = HTTPClient()
+        body = json.dumps({"model": "ollama/m",
+                           "messages": [{"role": "user", "content": "x" * 64}]}).encode()
+        for _ in range(10):
+            await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body)
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions", body)
+            assert resp.status == 200
+            lats.append(time.perf_counter() - t0)
+        await gw.shutdown()
+        await upstream.shutdown()
+        return sorted(lats)
+
+    off = await run_variant(False)
+    on = await run_variant(True)
+
+    def p(lats: list[float], q: float) -> float:
+        return round(lats[min(len(lats) - 1, int(len(lats) * q))] * 1000, 3)
+
+    delta = round(p(on, 0.99) - p(off, 0.99), 3)
+    return {
+        "bench": "profiling_overhead",
+        "p50_off_ms": p(off, 0.50), "p50_on_ms": p(on, 0.50),
+        "p99_off_ms": p(off, 0.99), "p99_on_ms": p(on, 0.99),
+        "p99_delta_ms": delta,
+        "p99_delta_pct": round(delta / p(off, 0.99) * 100, 2) if p(off, 0.99) else None,
+        "ops": n,
+    }
+
+
 async def main() -> None:
     results = [
         await bench_chat_completions(),
@@ -300,6 +374,7 @@ async def main() -> None:
         await bench_sse_relay_concurrent(streams=128, n_chunks=200),
         await bench_overload(),
         await bench_telemetry_overhead(),
+        await bench_profiling_overhead(),
     ]
     for r in results:
         print(json.dumps(r))

@@ -74,7 +74,12 @@ def extract_tool_calls(message: dict[str, Any]) -> list[str]:
     ]
 
 
-def telemetry_middleware(otel, logger=None, source: str = "gateway"):
+def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=None):
+    """``slow_log`` (otel/profiling.SlowRequestLog) makes this middleware
+    the gateway-edge forensics feeder: it already measures TTFC, total
+    duration, and token rate for every inference request, so breaches are
+    judged here — independent of whether the access log is enabled."""
+
     async def middleware(req: Request, nxt: Handler) -> Response:
         if req.method != "POST" or req.path not in INFERENCE_PATHS:
             return await nxt(req)
@@ -190,6 +195,7 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                                 if name:
                                     tool_names.append(name)
                     record("", usage, tool_names)
+                    rate = None
                     if (usage and usage[1] > 1 and t_first is not None
                             and t_last is not None and t_last > t_first):
                         # First token anchors the clock: N tokens span
@@ -198,6 +204,19 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
                         otel.record_output_token_rate(source, team, provider, model, rate)
                         if event is not None:
                             event["tokens_per_sec"] = round(rate, 2)
+                    if slow_log is not None:
+                        slow_log.observe_event({
+                            "route": req.path,
+                            "model": model,
+                            "status": resp.status,
+                            "stream": True,
+                            "trace_id": span.trace_id if span is not None else None,
+                            "output_tokens": usage[1] if usage else None,
+                            "ttfc_ms": round((t_first - start) * 1000, 3)
+                            if t_first is not None else None,
+                            "duration_ms": round((time.perf_counter() - start) * 1000, 3),
+                            "tokens_per_sec": rate,
+                        })
 
             resp.chunks = observed()
             return resp
@@ -218,6 +237,16 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway"):
             except ValueError:
                 pass
         record(error_type, usage, tool_names)
+        if slow_log is not None:
+            slow_log.observe_event({
+                "route": req.path,
+                "model": model,
+                "status": resp.status,
+                "stream": False,
+                "trace_id": span.trace_id if span is not None else None,
+                "output_tokens": usage[1] if usage else None,
+                "duration_ms": round((time.perf_counter() - start) * 1000, 3),
+            })
         return resp
 
     return middleware

@@ -57,6 +57,22 @@ _TELEMETRY_OBSERVABILITY_DOC = [
     "gauges). Span tree, instrument table, and example PromQL queries:",
     "[docs/observability.md](docs/observability.md).",
     "",
+    "### Profiling & forensics",
+    "",
+    "`TELEMETRY_PROFILING_*` turns on the performance-introspection",
+    "subsystem: a sampling wall-clock profiler with on-demand",
+    "(`GET /debug/profile?seconds=N&hz=M`, flamegraph-ready collapsed",
+    "stacks) and continuous (bounded ring of recent windows) modes, an",
+    "event-loop stall watchdog (`eventloop.lag` histogram, stall counter,",
+    "wide events carrying the loop thread's mid-stall stack), and the",
+    "sidecar's engine decode-step timeline (`GET /debug/timeline`,",
+    "`engine.step_duration` histogram). `TELEMETRY_SLOW_REQUEST_*`",
+    "thresholds capture breaching requests — phase clock, trace id, and",
+    "the surrounding engine-step window — into a bounded log surfaced in",
+    "`/debug/status`. Everything is zero-overhead when off; how-tos",
+    "(collapsed stacks → flamegraph.pl/speedscope, slow-request schema):",
+    "[docs/observability.md](docs/observability.md).",
+    "",
 ]
 
 
@@ -283,6 +299,21 @@ def check_config_defaults(spec: dict) -> list[str]:
         "TELEMETRY_TRACING_ENABLE": cfg.telemetry.tracing_enable,
         "TELEMETRY_TRACING_OTLP_ENDPOINT": cfg.telemetry.tracing_otlp_endpoint,
         "TELEMETRY_ACCESS_LOG": cfg.telemetry.access_log,
+        "TELEMETRY_ACCESS_LOG_TAIL": cfg.telemetry.access_log_tail,
+        "TELEMETRY_PROFILING_ENABLE": cfg.telemetry.profiling_enable,
+        "TELEMETRY_PROFILING_CONTINUOUS": cfg.telemetry.profiling_continuous,
+        "TELEMETRY_PROFILING_HZ": cfg.telemetry.profiling_hz,
+        "TELEMETRY_PROFILING_WINDOW": cfg.telemetry.profiling_window,
+        "TELEMETRY_PROFILING_WINDOWS": cfg.telemetry.profiling_windows,
+        "TELEMETRY_PROFILING_MAX_STACKS": cfg.telemetry.profiling_max_stacks,
+        "TELEMETRY_PROFILING_WATCHDOG": cfg.telemetry.profiling_watchdog,
+        "TELEMETRY_PROFILING_WATCHDOG_INTERVAL": cfg.telemetry.profiling_watchdog_interval,
+        "TELEMETRY_PROFILING_WATCHDOG_THRESHOLD": cfg.telemetry.profiling_watchdog_threshold,
+        "TELEMETRY_PROFILING_TIMELINE_SIZE": cfg.telemetry.profiling_timeline_size,
+        "TELEMETRY_SLOW_REQUEST_TTFT": cfg.telemetry.slow_request_ttft,
+        "TELEMETRY_SLOW_REQUEST_TPOT": cfg.telemetry.slow_request_tpot,
+        "TELEMETRY_SLOW_REQUEST_TOTAL": cfg.telemetry.slow_request_total,
+        "TELEMETRY_SLOW_REQUEST_LOG_SIZE": cfg.telemetry.slow_request_log_size,
         "MCP_ENABLE": cfg.mcp.enable,
         "MCP_EXPOSE": cfg.mcp.expose,
         "MCP_SERVERS": cfg.mcp.servers,

@@ -54,7 +54,11 @@ def _get_duration(env: Mapping[str, str], key: str, default: str) -> float:
 
 @dataclass
 class TelemetryConfig:
-    """TELEMETRY_* (config.go:46-52)."""
+    """TELEMETRY_* (config.go:46-52), plus the performance-introspection
+    surface (ISSUE 4): TELEMETRY_PROFILING_* (sampling profiler,
+    event-loop watchdog, decode-step timeline) and
+    TELEMETRY_SLOW_REQUEST_* (forensics thresholds; 0 disables a check).
+    """
 
     enable: bool = False
     metrics_push_enable: bool = False
@@ -62,6 +66,21 @@ class TelemetryConfig:
     tracing_enable: bool = False
     tracing_otlp_endpoint: str = "http://localhost:4318"
     access_log: bool = False
+    access_log_tail: int = 256
+    profiling_enable: bool = False
+    profiling_continuous: bool = False
+    profiling_hz: float = 29.0
+    profiling_window: float = 10.0
+    profiling_windows: int = 6
+    profiling_max_stacks: int = 2048
+    profiling_watchdog: bool = False
+    profiling_watchdog_interval: float = 0.25
+    profiling_watchdog_threshold: float = 0.1
+    profiling_timeline_size: int = 512
+    slow_request_ttft: float = 0.0
+    slow_request_tpot: float = 0.0
+    slow_request_total: float = 0.0
+    slow_request_log_size: int = 64
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "TELEMETRY_") -> "TelemetryConfig":
@@ -72,6 +91,21 @@ class TelemetryConfig:
             tracing_enable=_get_bool(env, prefix + "TRACING_ENABLE", False),
             tracing_otlp_endpoint=_get_str(env, prefix + "TRACING_OTLP_ENDPOINT", "http://localhost:4318"),
             access_log=_get_bool(env, prefix + "ACCESS_LOG", False),
+            access_log_tail=_get_int(env, prefix + "ACCESS_LOG_TAIL", 256),
+            profiling_enable=_get_bool(env, prefix + "PROFILING_ENABLE", False),
+            profiling_continuous=_get_bool(env, prefix + "PROFILING_CONTINUOUS", False),
+            profiling_hz=_get_float(env, prefix + "PROFILING_HZ", 29.0),
+            profiling_window=_get_duration(env, prefix + "PROFILING_WINDOW", "10s"),
+            profiling_windows=_get_int(env, prefix + "PROFILING_WINDOWS", 6),
+            profiling_max_stacks=_get_int(env, prefix + "PROFILING_MAX_STACKS", 2048),
+            profiling_watchdog=_get_bool(env, prefix + "PROFILING_WATCHDOG", False),
+            profiling_watchdog_interval=_get_duration(env, prefix + "PROFILING_WATCHDOG_INTERVAL", "250ms"),
+            profiling_watchdog_threshold=_get_duration(env, prefix + "PROFILING_WATCHDOG_THRESHOLD", "100ms"),
+            profiling_timeline_size=_get_int(env, prefix + "PROFILING_TIMELINE_SIZE", 512),
+            slow_request_ttft=_get_duration(env, prefix + "SLOW_REQUEST_TTFT", "0s"),
+            slow_request_tpot=_get_duration(env, prefix + "SLOW_REQUEST_TPOT", "0s"),
+            slow_request_total=_get_duration(env, prefix + "SLOW_REQUEST_TOTAL", "0s"),
+            slow_request_log_size=_get_int(env, prefix + "SLOW_REQUEST_LOG_SIZE", 64),
         )
 
 

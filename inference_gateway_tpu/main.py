@@ -26,6 +26,12 @@ from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio.client import ClientConfig, HTTPClient
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Router
 from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.otel.profiling import (
+    EventLoopWatchdog,
+    SamplingProfiler,
+    SlowRequestLog,
+    handle_profile_query,
+)
 from inference_gateway_tpu.providers import routing
 from inference_gateway_tpu.providers.registry import ProviderRegistry
 from inference_gateway_tpu.resilience import OverloadController, Resilience, admission_middleware
@@ -48,6 +54,9 @@ class Gateway:
     overload: OverloadController | None = None
     resilience: Any = None
     access_log: Any = None
+    profiler: SamplingProfiler | None = None
+    watchdog: EventLoopWatchdog | None = None
+    slow_log: SlowRequestLog | None = None
     port: int = 0
     metrics_port: int = 0
     _tasks: list[asyncio.Task] = field(default_factory=list)
@@ -67,6 +76,13 @@ class Gateway:
         self.port = await self.api_server.start(
             host, port, self.cfg.server.tls_cert_path, self.cfg.server.tls_key_path
         )
+        # Performance introspection (ISSUE 4): the continuous sampler is
+        # a daemon thread, the watchdog heartbeat a loop task — both
+        # started here (the loop exists now) and torn down in shutdown().
+        if self.profiler is not None and self.cfg.telemetry.profiling_continuous:
+            self.profiler.start_continuous()
+        if self.watchdog is not None:
+            self.watchdog.start()
         # Self-addressing: the provider loopback hop targets this listener
         # (main.go:167, client.go:66-75).
         self.client.self_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
@@ -97,6 +113,9 @@ class Gateway:
         sockets torn down."""
         for t in self._tasks:
             t.cancel()
+        if self.watchdog is not None:
+            # The heartbeat would read every drain pause as a stall.
+            await self.watchdog.stop()
         if self.overload is not None:
             self.overload.begin_drain()
         if self.mcp_client is not None:
@@ -107,6 +126,8 @@ class Gateway:
         )
         if self.metrics_server is not None:
             await self.metrics_server.shutdown()
+        if self.profiler is not None:
+            self.profiler.stop()
         self.logger.info("gateway stopped")
 
 
@@ -119,6 +140,9 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     otel = None
     metrics_server = None
     metrics_router = None
+    profiler = None
+    watchdog = None
+    slow_log = None
     if cfg.telemetry.enable:
         otel = OpenTelemetry(
             environment=cfg.environment,
@@ -135,6 +159,27 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         # /debug/status is registered below, once the breaker registry
         # and admission ledger it snapshots exist.
         metrics_server = HTTPServer(metrics_router, logger=logger)
+
+        # Performance introspection (ISSUE 4): a sampling profiler
+        # (on-demand /debug/profile captures; TELEMETRY_PROFILING_CONTINUOUS
+        # keeps a ring of recent windows), an event-loop stall watchdog,
+        # and slow-request forensics at the gateway edge — all off by
+        # default and zero-overhead when off.
+        t = cfg.telemetry
+        if t.profiling_enable or t.profiling_continuous:
+            profiler = SamplingProfiler(
+                hz=t.profiling_hz, window_s=t.profiling_window,
+                windows=t.profiling_windows, max_stacks=t.profiling_max_stacks,
+                logger=logger)
+        slow_log = SlowRequestLog(
+            ttft_s=t.slow_request_ttft, tpot_s=t.slow_request_tpot,
+            total_s=t.slow_request_total, size=t.slow_request_log_size,
+            otel=otel, source="gateway")
+        if t.profiling_watchdog:
+            watchdog = EventLoopWatchdog(
+                otel=otel, interval=t.profiling_watchdog_interval,
+                threshold=t.profiling_watchdog_threshold, source="gateway",
+                logger=logger)
 
     client = HTTPClient(
         ClientConfig(
@@ -191,14 +236,22 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     if cfg.telemetry.access_log:
         from inference_gateway_tpu.otel.access_log import AccessLog, access_log_middleware
 
-        access_log = AccessLog(service=APPLICATION_NAME)
+        access_log = AccessLog(service=APPLICATION_NAME,
+                               tail_size=cfg.telemetry.access_log_tail)
         middlewares.append(access_log_middleware(access_log))
+    if watchdog is not None:
+        # Stall wide events ride the access-log sink when it exists.
+        watchdog.access_log = access_log
     middlewares.append(admission_middleware(overload, logger))
     if otel is not None and cfg.telemetry.tracing_enable:
         middlewares.append(tracing_middleware(otel.tracer))
     middlewares.append(logger_middleware(logger))
     if otel is not None:
-        middlewares.append(telemetry_middleware(otel, logger))
+        # The telemetry middleware doubles as the gateway-edge forensics
+        # feeder: it measures TTFC/duration/rate for every inference
+        # request regardless of whether the access log is on, so the
+        # TELEMETRY_SLOW_REQUEST_* thresholds work standalone.
+        middlewares.append(telemetry_middleware(otel, logger, slow_log=slow_log))
     authenticator = None
     if cfg.auth.enable:
         authenticator = OIDCAuthenticator(
@@ -223,18 +276,27 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     # router + middleware chain instead of a loopback TCP round trip.
     client.inprocess_server = api_server
 
+    if watchdog is not None:
+        # Forensic context stamped onto every stall event: how many live
+        # connections each listener was holding when the loop wedged.
+        watchdog.add_context("api_connections", api_server.connection_count)
+        if metrics_server is not None:
+            watchdog.add_context("metrics_connections", metrics_server.connection_count)
+
     gw = Gateway(
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
         router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
         mcp_client=mcp_client, overload=overload, resilience=resilience,
-        access_log=access_log,
+        access_log=access_log, profiler=profiler, watchdog=watchdog, slow_log=slow_log,
     )
 
     if metrics_router is not None:
         # /debug/status (ISSUE 3): one JSON snapshot for humans and
         # probes — build info, breaker states, the admission ledger, and
         # every live gauge point (engine occupancy/KV pressure when a
-        # sidecar is co-hosted, breaker codes, overload in-flight).
+        # sidecar is co-hosted, breaker codes, overload in-flight) —
+        # extended (ISSUE 4) with profiler/watchdog health and the
+        # slow-request log.
         async def debug_status_handler(req: Request) -> Response:
             status: dict[str, Any] = {
                 "app": APPLICATION_NAME,
@@ -247,9 +309,27 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             }
             if access_log is not None:
                 status["access_log_tail"] = list(access_log.tail)[-8:]
+                status["access_log_dropped"] = access_log.dropped
+            if slow_log is not None:
+                status["slow_requests"] = slow_log.snapshot()
+            if profiler is not None:
+                status["profiling"] = profiler.stats()
+            if watchdog is not None:
+                status["eventloop"] = watchdog.stats()
             return Response.json(status)
 
         metrics_router.get("/debug/status", debug_status_handler)
+
+        # /debug/profile (ISSUE 4): flamegraph-ready collapsed stacks —
+        # on-demand capture (?seconds=N&hz=M) or the continuous ring
+        # (?mode=continuous).
+        async def debug_profile_handler(req: Request) -> Response:
+            status, ctype, body = await handle_profile_query(
+                profiler, seconds=req.query_get("seconds"),
+                hz=req.query_get("hz"), mode=req.query_get("mode"))
+            return Response.text(body, status=status, content_type=ctype)
+
+        metrics_router.get("/debug/profile", debug_profile_handler)
 
     return gw
 

@@ -213,6 +213,12 @@ class HTTPServer:
         self._server: asyncio.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
 
+    def connection_count(self) -> int:
+        """Live connections on this listener — a forensic context probe
+        for the event-loop stall watchdog and /debug/status (a stall at
+        10k connections tells a different story than one at 10)."""
+        return len(self._conns)
+
     # -- lifecycle -----------------------------------------------------
     async def start(self, host: str, port: int, tls_cert: str = "", tls_key: str = "") -> int:
         ssl_ctx = None

@@ -1,3 +1,12 @@
 from inference_gateway_tpu.otel.otel import OpenTelemetry, NoopTelemetry
+from inference_gateway_tpu.otel.profiling import (
+    EventLoopWatchdog,
+    SamplingProfiler,
+    SlowRequestLog,
+    StepTimeline,
+)
 
-__all__ = ["OpenTelemetry", "NoopTelemetry"]
+__all__ = [
+    "OpenTelemetry", "NoopTelemetry",
+    "SamplingProfiler", "EventLoopWatchdog", "StepTimeline", "SlowRequestLog",
+]

@@ -27,10 +27,18 @@ class AccessLog:
     """JSON-lines wide-event sink with a bounded in-memory tail."""
 
     def __init__(self, stream: TextIO | None = None, service: str = "gateway",
-                 tail_size: int = 256) -> None:
+                 tail_size: int = 256, slow_log=None) -> None:
         self._stream = stream if stream is not None else sys.stdout
         self.service = service
-        self.tail: deque[dict[str, Any]] = deque(maxlen=tail_size)
+        self.tail: deque[dict[str, Any]] = deque(maxlen=max(int(tail_size), 1))
+        # Events pushed out of the bounded tail (the stream itself is
+        # never truncated) — surfaced in /debug/status so "the request
+        # isn't in the tail" is distinguishable from "it never ran".
+        self.dropped = 0
+        # Optional SlowRequestLog (otel/profiling.py): every emitted wide
+        # event is also judged against the slow-request thresholds, so
+        # the gateway edge gets forensics without a second middleware.
+        self.slow_log = slow_log
         self._lock = threading.Lock()
 
     def emit(self, event: dict[str, Any]) -> None:
@@ -40,12 +48,19 @@ class AccessLog:
         event.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + "Z")
         line = json.dumps(event, default=str, separators=(",", ":"))
         with self._lock:
+            if len(self.tail) == self.tail.maxlen:
+                self.dropped += 1
             self.tail.append(event)
             try:
                 self._stream.write(line + "\n")
                 self._stream.flush()
             except Exception:
                 pass  # a closed stream must never fail a request
+        if self.slow_log is not None:
+            try:
+                self.slow_log.observe_event(event)
+            except Exception:
+                pass  # forensics must never fail a request
 
 
 def access_log_middleware(access_log: AccessLog):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from dataclasses import dataclass, field
 
 LabelValues = tuple[str, ...]
@@ -58,19 +59,48 @@ class Counter:
 @dataclass
 class Gauge:
     """Last-value instrument (Prometheus gauge) — e.g. circuit-breaker
-    state per (provider, model)."""
+    state per (provider, model).
+
+    Unlike counters, gauge label sets describe *current* state, so stale
+    sets lie: a drained endpoint class or torn-down engine would stay on
+    /metrics forever (ISSUE 4 satellite). ``remove()`` deletes a label
+    set explicitly; a non-zero ``ttl`` lets ``Registry.expose()`` sweep
+    sets that have not been written recently."""
 
     name: str
     description: str
     label_names: tuple[str, ...]
     unit: str = ""
+    ttl: float = 0.0
     _values: dict[LabelValues, float] = field(default_factory=dict)
+    _updated: dict[LabelValues, float] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def set(self, value: float, labels: dict[str, str] | None = None) -> None:
         key = tuple((labels or {}).get(n, "") for n in self.label_names)
         with self._lock:
             self._values[key] = value
+            self._updated[key] = time.monotonic()
+
+    def remove(self, labels: dict[str, str] | None = None) -> bool:
+        """Drop one label set (e.g. on drain or engine teardown). True
+        when the set existed."""
+        key = tuple((labels or {}).get(n, "") for n in self.label_names)
+        with self._lock:
+            self._updated.pop(key, None)
+            return self._values.pop(key, None) is not None
+
+    def sweep(self, now: float | None = None) -> int:
+        """Drop label sets older than ``ttl``; returns how many."""
+        if self.ttl <= 0:
+            return 0
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stale = [k for k, t in self._updated.items() if now - t > self.ttl]
+            for k in stale:
+                self._values.pop(k, None)
+                self._updated.pop(k, None)
+        return len(stale)
 
     def values(self) -> dict[LabelValues, float]:
         with self._lock:
@@ -153,8 +183,9 @@ class Registry:
             self._instruments.append(c)
         return c
 
-    def gauge(self, name: str, description: str, label_names: tuple[str, ...], unit: str = "") -> Gauge:
-        g = Gauge(name, description, label_names, unit)
+    def gauge(self, name: str, description: str, label_names: tuple[str, ...],
+              unit: str = "", ttl: float = 0.0) -> Gauge:
+        g = Gauge(name, description, label_names, unit, ttl)
         with self._lock:
             self._instruments.append(g)
         return g
@@ -169,9 +200,15 @@ class Registry:
         return h
 
     def expose(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4. Gauges with a TTL
+        sweep their stale label sets on every scrape, so current-state
+        series for departed entities age out of the exposition."""
         with self._lock:
             instruments = list(self._instruments)
+        now = time.monotonic()
+        for i in instruments:
+            if isinstance(i, Gauge):
+                i.sweep(now)
         return "\n".join(i.collect() for i in instruments) + "\n"
 
     def gauge_snapshot(self) -> dict[str, dict[str, float]]:

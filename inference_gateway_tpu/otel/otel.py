@@ -27,6 +27,14 @@ TOKEN_BOUNDARIES = (1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576
 TPOT_BOUNDARIES = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 # Output throughput per stream, tokens/second.
 TOKEN_RATE_BOUNDARIES = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0)
+# Asyncio scheduling lag (ISSUE 4 watchdog): healthy loops wake the
+# heartbeat within a millisecond; a relay saturation stall is 10-100ms+.
+EVENTLOOP_LAG_BOUNDARIES = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# Engine step durations (ISSUE 4 timeline): kernel times are tens of µs
+# on TPU, milliseconds through a remote-device tunnel, and a fused chunk
+# of decode steps lands in the tens-of-ms band.
+ENGINE_STEP_BOUNDARIES = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                          0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
 _BASE_LABELS = ("source", "team", "gen_ai_operation_name", "gen_ai_provider_name", "gen_ai_request_model")
 
@@ -167,6 +175,29 @@ class OpenTelemetry:
             "Speculative decoding acceptance: emitted tokens per slot round",
             ("gen_ai_request_model",),
         )
+        # Performance-introspection instruments (ISSUE 4): event-loop
+        # scheduling health from the watchdog heartbeat, per-step engine
+        # timing from the decode timeline, and slow-request breaches.
+        self.eventloop_lag = r.histogram(
+            "eventloop.lag",
+            "Asyncio scheduling lag observed by the watchdog heartbeat",
+            ("source",), EVENTLOOP_LAG_BOUNDARIES, unit="s",
+        )
+        self.eventloop_stall_counter = r.counter(
+            "eventloop.stalls",
+            "Event-loop stalls: heartbeat lag above the watchdog threshold",
+            ("source",), unit="{stall}",
+        )
+        self.engine_step_duration = r.histogram(
+            "engine.step_duration",
+            "Engine step wall time by kind (prefill/decode/spec/spec_ngram)",
+            ("gen_ai_request_model", "kind"), ENGINE_STEP_BOUNDARIES, unit="s",
+        )
+        self.slow_request_counter = r.counter(
+            "inference_gateway.slow_requests",
+            "Requests breaching the configured TTFT/TPOT/total latency thresholds",
+            ("source", "breach"), unit="{request}",
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -280,6 +311,35 @@ class OpenTelemetry:
             self.engine_queue_depth_gauge.set(queue_depth, labels)
         if spec_tokens_per_slot_round is not None:
             self.engine_spec_acceptance_gauge.set(spec_tokens_per_slot_round, labels)
+
+    def remove_engine_gauges(self, model: str) -> None:
+        """Engine teardown: drop the model's saturation series so a gone
+        engine stops being exposed as current state (ISSUE 4 satellite)."""
+        labels = {"gen_ai_request_model": model}
+        for gauge in (self.engine_slot_occupancy_gauge, self.engine_kv_utilization_gauge,
+                      self.engine_queue_depth_gauge, self.engine_spec_acceptance_gauge):
+            gauge.remove(labels)
+
+    def remove_overload_gauges(self, endpoint_class: str) -> None:
+        """Drain completion: the admission ledger's per-class series stop
+        describing anything once the gateway is out of rotation."""
+        labels = {"endpoint_class": endpoint_class}
+        self.overload_in_flight_gauge.remove(labels)
+        self.overload_queue_gauge.remove(labels)
+
+    # -- performance introspection (ISSUE 4) -----------------------------
+    def record_eventloop_lag(self, source: str, seconds: float) -> None:
+        self.eventloop_lag.record(seconds, {"source": source})
+
+    def record_eventloop_stall(self, source: str) -> None:
+        self.eventloop_stall_counter.add(1, {"source": source})
+
+    def record_engine_step(self, model: str, kind: str, seconds: float) -> None:
+        self.engine_step_duration.record(
+            seconds, {"gen_ai_request_model": model, "kind": kind})
+
+    def record_slow_request(self, source: str, breach: str) -> None:
+        self.slow_request_counter.add(1, {"source": source, "breach": breach})
 
     def expose_prometheus(self) -> str:
         return self.registry.expose()
@@ -455,4 +515,22 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def set_engine_gauges(self, *a, **k) -> None:
+        pass
+
+    def remove_engine_gauges(self, *a, **k) -> None:
+        pass
+
+    def remove_overload_gauges(self, *a, **k) -> None:
+        pass
+
+    def record_eventloop_lag(self, *a, **k) -> None:
+        pass
+
+    def record_eventloop_stall(self, *a, **k) -> None:
+        pass
+
+    def record_engine_step(self, *a, **k) -> None:
+        pass
+
+    def record_slow_request(self, *a, **k) -> None:
         pass

@@ -1,0 +1,657 @@
+"""Performance introspection (ISSUE 4 tentpole).
+
+PR 3 made the gateway *report* latency; this module explains *where the
+time went* when a number regresses, with four cooperating pieces:
+
+- ``SamplingProfiler`` — a wall-clock sampling profiler over
+  ``sys._current_frames()``: a daemon thread samples every live thread at
+  a configurable Hz and aggregates into bounded collapsed-stack counts
+  (flamegraph.pl / speedscope input format). Two modes share the core:
+  on-demand capture (``GET /debug/profile?seconds=N&hz=M``) and an
+  always-on continuous mode keeping a ring of recent windows.
+- ``EventLoopWatchdog`` — asyncio scheduling-lag heartbeat. The relay
+  hot path lives and dies on loop latency (BENCH_r05: 58k chunks/s at
+  128 streams vs 84k at 32); the heartbeat measures how late the loop
+  woke it into the ``eventloop.lag`` histogram, and lag beyond the
+  threshold is a *stall*: counted, wide-evented through the access-log
+  sink with the loop thread's stack. A companion daemon thread snapshots
+  that stack WHILE the loop is wedged — the heartbeat itself can only
+  run after the stall ended, so without the thread every stall event
+  would name the watchdog's own frame.
+- ``StepTimeline`` — bounded ring of engine step records (wall time,
+  prefill/decode/spec kind, batch occupancy, tokens emitted, KV
+  utilization) written by the scheduler thread and served at
+  ``GET /debug/timeline``; each record also lands in the
+  ``engine.step_duration`` histogram.
+- ``SlowRequestLog`` — requests breaching configurable TTFT/TPOT/total
+  thresholds get their phase clock, trace id, and the surrounding
+  engine-step window captured into a bounded log surfaced in
+  ``/debug/status``.
+
+Everything is zero-overhead-when-off (no thread, no task, a single
+``is None`` check on the hot paths) and testable with zero real sleeps:
+the watchdog takes the PR 1 clock, the profiler/timeline/slow-log are
+plain data structures driven by the caller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from inference_gateway_tpu.resilience.clock import MonotonicClock
+
+# Aggregation bucket for stacks beyond the per-window unique-stack bound:
+# the profiler's memory is O(max_stacks), never O(distinct stacks).
+OVERFLOW_STACK = "__overflow__"
+
+# /debug/profile guard rails: a capture blocks one executor thread.
+MAX_CAPTURE_SECONDS = 60.0
+MAX_CAPTURE_HZ = 1000.0
+
+
+class CaptureBusyError(RuntimeError):
+    """An on-demand capture is already running on this profiler. The
+    metrics listener is unauthenticated, so without this guard N
+    concurrent 60s /debug/profile requests would pin N threads of the
+    process-wide default executor — starving DNS lookups and every other
+    run_in_executor user for a minute."""
+
+
+def _format_stack(frame, thread_name: str, max_depth: int = 64) -> str:
+    """One sample in collapsed form: ``thread:NAME;root;...;leaf`` with
+    ``pkg/file.py:func`` frame labels (greppable, flamegraph-ready)."""
+    frames: list[str] = []
+    depth = 0
+    while frame is not None and depth < max_depth:
+        code = frame.f_code
+        fname = code.co_filename.replace("\\", "/")
+        short = "/".join(fname.rsplit("/", 2)[-2:])
+        frames.append(f"{short}:{code.co_name}")
+        frame = frame.f_back
+        depth += 1
+    frames.append(f"thread:{thread_name}")
+    frames.reverse()
+    return ";".join(frames)
+
+
+class StackWindow:
+    """Bounded collapsed-stack counts for one sampling window."""
+
+    __slots__ = ("started", "samples", "counts", "max_stacks")
+
+    def __init__(self, max_stacks: int) -> None:
+        self.started = time.time()
+        self.samples = 0
+        self.counts: dict[str, int] = {}
+        self.max_stacks = max_stacks
+
+    def add(self, stack: str) -> None:
+        counts = self.counts
+        if stack in counts:
+            counts[stack] += 1
+        elif len(counts) < self.max_stacks:
+            counts[stack] = 1
+        else:
+            counts[OVERFLOW_STACK] = counts.get(OVERFLOW_STACK, 0) + 1
+        self.samples += 1
+
+
+def render_collapsed(counts: dict[str, int]) -> str:
+    """flamegraph.pl / speedscope input: one ``stack count`` line per
+    distinct stack, hottest first."""
+    lines = [f"{stack} {n}" for stack, n in
+             sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class SamplingProfiler:
+    """Wall-clock sampling profiler over ``sys._current_frames()``.
+
+    ``profile(seconds, hz)`` is the blocking on-demand core (run it via
+    ``capture`` from async handlers so the event loop — one of the
+    profiled threads — keeps serving). ``start_continuous()`` spawns a
+    daemon thread sampling at ``hz`` into the current window, rotating
+    into a bounded ring every ``window_s`` seconds; ``snapshot()`` merges
+    the ring for flamegraph-over-the-last-N-minutes queries. Lifecycle is
+    lock-guarded and idempotent so concurrent start/sample/stop (the
+    race-harness hammer) cannot leak threads or tear windows.
+    """
+
+    def __init__(self, hz: float = 29.0, window_s: float = 10.0, windows: int = 6,
+                 max_stacks: int = 2048, logger=None) -> None:
+        self.hz = max(float(hz), 0.1)
+        self.window_s = max(float(window_s), 0.1)
+        self.max_stacks = max(int(max_stacks), 16)
+        self.logger = logger
+        self._ring: deque[StackWindow] = deque(maxlen=max(int(windows), 1))
+        self._current: StackWindow | None = None
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # At most ONE on-demand capture per profiler occupies the shared
+        # default executor (CaptureBusyError above).
+        self._capture_busy = threading.Lock()
+
+    # -- sampling core -------------------------------------------------
+    @staticmethod
+    def sample_into(window: StackWindow, exclude: frozenset[int] = frozenset()) -> None:
+        """One sample of every live thread except ``exclude`` (a sampler
+        must not profile itself into the hottest stack)."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid in exclude:
+                continue
+            window.add(_format_stack(frame, names.get(tid, f"tid-{tid}")))
+
+    def profile(self, seconds: float, hz: float | None = None) -> StackWindow:
+        """Blocking on-demand capture into a fresh window."""
+        seconds = min(max(float(seconds), 0.01), MAX_CAPTURE_SECONDS)
+        hz = min(max(float(hz if hz is not None else self.hz), 0.1), MAX_CAPTURE_HZ)
+        window = StackWindow(self.max_stacks)
+        me = frozenset((threading.get_ident(),))
+        period = 1.0 / hz
+        deadline = time.monotonic() + seconds
+        next_t = time.monotonic()
+        while True:
+            self.sample_into(window, exclude=me)
+            next_t += period
+            now = time.monotonic()
+            if now >= deadline:
+                return window
+            if next_t > now:
+                time.sleep(min(next_t, deadline) - now)
+
+    async def capture(self, seconds: float, hz: float | None = None) -> StackWindow:
+        """On-demand capture off-loop, so the profiled event loop keeps
+        running (and shows up in its own profile). Raises
+        ``CaptureBusyError`` when a capture is already in flight."""
+        if not self._capture_busy.acquire(blocking=False):
+            raise CaptureBusyError("a profile capture is already running")
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.profile, seconds, hz)
+        finally:
+            self._capture_busy.release()
+
+    # -- continuous mode -----------------------------------------------
+    def start_continuous(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            self._current = StackWindow(self.max_stacks)
+            self._thread = threading.Thread(
+                target=self._run, name="profiler-sampler", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread = self._thread
+            self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5.0)
+        with self._lock:
+            if self._thread is thread:
+                self._thread = None
+                if self._current is not None and self._current.samples:
+                    self._ring.append(self._current)
+                self._current = None
+
+    def _run(self) -> None:
+        stop = self._stop
+        me = frozenset((threading.get_ident(),))
+        period = 1.0 / self.hz
+        rotate_at = time.monotonic() + self.window_s
+        while not stop.wait(period):
+            try:
+                with self._lock:
+                    window = self._current
+                    if window is None:
+                        break
+                    if time.monotonic() >= rotate_at:
+                        if window.samples:
+                            self._ring.append(window)
+                        window = self._current = StackWindow(self.max_stacks)
+                        rotate_at = time.monotonic() + self.window_s
+                self.sample_into(window, exclude=me)
+            except Exception as e:  # pragma: no cover - defensive
+                if self.logger is not None:
+                    self.logger.error("profiler sample failed", e)
+
+    @property
+    def continuous(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    def snapshot(self) -> dict[str, int]:
+        """Merged collapsed-stack counts over the ring + current window.
+
+        The live window is copied with ``dict()`` (GIL-atomic in C)
+        before merging — Python-level iteration over a dict the sampler
+        thread is concurrently inserting into would raise."""
+        with self._lock:
+            counts_list = [dict(w.counts) for w in self._ring]
+            if self._current is not None:
+                counts_list.append(dict(self._current.counts))
+        merged: dict[str, int] = {}
+        for counts in counts_list:
+            for stack, n in counts.items():
+                merged[stack] = merged.get(stack, 0) + n
+        return merged
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            windows = list(self._ring)
+            current = self._current
+        samples = sum(w.samples for w in windows) + (current.samples if current else 0)
+        return {
+            "continuous": self.continuous,
+            "hz": self.hz,
+            "window_seconds": self.window_s,
+            "windows_retained": len(windows) + (1 if current else 0),
+            "samples": samples,
+        }
+
+
+async def handle_profile_query(profiler: SamplingProfiler | None, *, seconds: str = "",
+                               hz: str = "", mode: str = "") -> tuple[int, str, str]:
+    """Shared ``/debug/profile`` logic for the metrics listener and the
+    sidecar: returns ``(status, content_type, body)`` so neither endpoint
+    layer imports the other's Response type."""
+    if profiler is None:
+        return (404, "application/json",
+                '{"error": "profiling disabled (TELEMETRY_PROFILING_ENABLE)"}')
+    if mode == "continuous":
+        counts = profiler.snapshot()
+        if not counts:
+            return (404, "application/json",
+                    '{"error": "no continuous profile yet (TELEMETRY_PROFILING_CONTINUOUS)"}')
+        return (200, "text/plain; charset=utf-8", render_collapsed(counts))
+    try:
+        secs = float(seconds) if seconds else 1.0
+        rate = float(hz) if hz else profiler.hz
+    except ValueError:
+        return (400, "application/json", '{"error": "seconds and hz must be numbers"}')
+    try:
+        window = await profiler.capture(secs, rate)
+    except CaptureBusyError:
+        return (409, "application/json",
+                '{"error": "a profile capture is already running; retry when it finishes"}')
+    return (200, "text/plain; charset=utf-8", render_collapsed(window.counts))
+
+
+# ---------------------------------------------------------------------------
+# Event-loop stall watchdog
+# ---------------------------------------------------------------------------
+class EventLoopWatchdog:
+    """Asyncio scheduling-lag heartbeat with mid-stall stack capture.
+
+    The heartbeat coroutine sleeps ``interval`` on the injected clock and
+    records how late the loop woke it into ``eventloop.lag``; lag beyond
+    ``threshold`` increments ``eventloop.stall`` and emits one wide event
+    through the access-log sink (falling back to the logger) carrying the
+    lag, the loop thread's stack, and any registered context probes
+    (e.g. live connection counts). With the production clock a companion
+    daemon thread watches the heartbeat timestamps and snapshots the
+    loop thread's stack while it is actually wedged; with a VirtualClock
+    (tests) the thread stays off and the whole state machine runs with
+    zero real sleeps.
+    """
+
+    def __init__(self, otel=None, access_log=None, interval: float = 0.25,
+                 threshold: float = 0.1, clock=None, source: str = "gateway",
+                 logger=None) -> None:
+        self.otel = otel
+        self.access_log = access_log
+        self.interval = max(float(interval), 0.01)
+        self.threshold = max(float(threshold), 0.001)
+        self.clock = clock or MonotonicClock()
+        self.source = source
+        self.logger = logger
+        self.stalls = 0
+        self.beats = 0
+        self.last_lag = 0.0
+        self.last_stall: dict[str, Any] | None = None
+        self._probes: list[tuple[str, Callable[[], Any]]] = []
+        self._task: asyncio.Task | None = None
+        self._thread: threading.Thread | None = None
+        self._thread_stop = threading.Event()
+        self._loop_tid: int | None = None
+        self._beat_wall = time.monotonic()
+        # (captured_at_wall, collapsed_stack) written by the snapshot
+        # thread while the loop is wedged, consumed by the next beat.
+        self._pending_stack: tuple[float, str] | None = None
+
+    def add_context(self, name: str, probe: Callable[[], Any]) -> None:
+        """Attach a forensic probe (e.g. a server's connection count)
+        whose value is stamped onto every stall event."""
+        self._probes.append((name, probe))
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._task is not None and not self._task.done():
+            return
+        self._task = asyncio.get_running_loop().create_task(
+            self._heartbeat(), name="eventloop-watchdog")
+        if isinstance(self.clock, MonotonicClock):
+            self._thread_stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._watch, name="watchdog-sampler", daemon=True)
+            self._thread.start()
+
+    async def stop(self) -> None:
+        self._thread_stop.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        thread = self._thread
+        if thread is not None:
+            # join() would block the loop; the thread polls stop_event at
+            # interval/2 cadence and is a daemon — detach and let it exit.
+            self._thread = None
+
+    # -- heartbeat (on the watched loop) -------------------------------
+    async def _heartbeat(self) -> None:
+        self._loop_tid = threading.get_ident()
+        while True:
+            t0 = self.clock.now()
+            self._beat_wall = time.monotonic()
+            await self.clock.sleep(self.interval)
+            lag = max(self.clock.now() - t0 - self.interval, 0.0)
+            self.beats += 1
+            self.last_lag = lag
+            if self.otel is not None:
+                self.otel.record_eventloop_lag(self.source, lag)
+            if lag > self.threshold:
+                self._on_stall(lag)
+
+    def _on_stall(self, lag: float) -> None:
+        self.stalls += 1
+        if self.otel is not None:
+            self.otel.record_eventloop_stall(self.source)
+        stack = None
+        pending, self._pending_stack = self._pending_stack, None
+        if pending is not None and pending[0] >= self._beat_wall:
+            stack = pending[1]  # captured while the loop was wedged
+        event: dict[str, Any] = {
+            "log": "stall",
+            "kind": "eventloop.stall",
+            "source": self.source,
+            "lag_s": round(lag, 4),
+            "threshold_s": self.threshold,
+            "stack": stack,
+        }
+        for name, probe in self._probes:
+            try:
+                event[name] = probe()
+            except Exception:
+                event[name] = None
+        self.last_stall = event
+        if self.access_log is not None:
+            self.access_log.emit(event)
+        elif self.logger is not None:
+            self.logger.warn("event loop stall", "lag_s", round(lag, 4),
+                             "stack", stack or "<missed>")
+
+    # -- mid-stall snapshots (companion thread, real clock only) -------
+    def _watch(self) -> None:
+        stop = self._thread_stop
+        while not stop.wait(self.interval / 2):
+            overdue = time.monotonic() - self._beat_wall
+            if overdue <= self.interval + self.threshold:
+                continue
+            tid = self._loop_tid
+            if tid is None:
+                continue
+            frame = sys._current_frames().get(tid)
+            if frame is not None:
+                self._pending_stack = (
+                    time.monotonic(), _format_stack(frame, "event-loop"))
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "watchdog": self._task is not None and not self._task.done(),
+            "interval_s": self.interval,
+            "threshold_s": self.threshold,
+            "beats": self.beats,
+            "stalls": self.stalls,
+            "last_lag_s": round(self.last_lag, 4),
+            "last_stall": self.last_stall,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Engine decode-step timeline
+# ---------------------------------------------------------------------------
+class StepTimeline:
+    """Bounded ring of per-engine-step records written by the scheduler
+    thread: what the batch was doing, step by step, when a latency number
+    regressed. Readers (``/debug/timeline``, slow-request forensics)
+    copy under the lock; the writer pays one dict + deque append per
+    engine *chunk*, not per token."""
+
+    def __init__(self, size: int = 512, otel=None, model: str = "") -> None:
+        self._ring: deque[dict[str, Any]] = deque(maxlen=max(int(size), 8))
+        self._lock = threading.Lock()
+        self.otel = otel
+        self.model = model
+        self.steps = 0
+        self.records = 0
+
+    def record(self, kind: str, duration_s: float, *, n_steps: int = 1, batch: int = 0,
+               tokens: int = 0, kv_utilization: float = 0.0, queue_depth: int = 0) -> None:
+        rec = {
+            "ts": time.time(),
+            "kind": kind,
+            "duration_ms": round(duration_s * 1000, 3),
+            "steps": n_steps,
+            "batch": batch,
+            "tokens": tokens,
+            "kv_utilization": round(kv_utilization, 4),
+            "queue_depth": queue_depth,
+        }
+        with self._lock:
+            self._ring.append(rec)
+            self.steps += n_steps
+            self.records += 1
+        if self.otel is not None:
+            self.otel.record_engine_step(self.model, kind, duration_s)
+
+    def tail(self, n: int | None = None) -> list[dict[str, Any]]:
+        with self._lock:
+            entries = list(self._ring)
+        return entries[-n:] if n else entries
+
+    def window(self, start_ts: float, end_ts: float, margin: float = 0.25) -> list[dict[str, Any]]:
+        """Records overlapping [start_ts - margin, end_ts + margin]
+        (epoch seconds) — the engine-step context around one request."""
+        lo, hi = start_ts - margin, end_ts + margin
+        with self._lock:
+            return [r for r in self._ring if lo <= r["ts"] <= hi]
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            retained = len(self._ring)
+            last = self._ring[-1] if retained else None
+        return {"steps": self.steps, "records": self.records,
+                "retained": retained, "last": last}
+
+
+# ---------------------------------------------------------------------------
+# Slow-request forensics
+# ---------------------------------------------------------------------------
+class SlowRequestLog:
+    """Bounded log of requests that breached latency thresholds.
+
+    Two feeders: the sidecar's ``observe_phases`` (scheduler phase clock
+    in epoch ns, plus the surrounding engine-step window from an attached
+    ``StepTimeline``) and the gateway edge's ``observe_event`` (an
+    event dict shaped like the wide-event access log line — fed by the
+    telemetry middleware's own per-request measurements, so forensics
+    work with the access log off; an ``AccessLog`` can also be wired as
+    a feeder). Thresholds of 0 disable that check; with all three at 0
+    the log is inert.
+    """
+
+    def __init__(self, ttft_s: float = 0.0, tpot_s: float = 0.0, total_s: float = 0.0,
+                 size: int = 64, timeline: StepTimeline | None = None,
+                 otel=None, source: str = "gateway") -> None:
+        self.ttft_s = max(float(ttft_s), 0.0)
+        self.tpot_s = max(float(tpot_s), 0.0)
+        self.total_s = max(float(total_s), 0.0)
+        self.timeline = timeline
+        self.otel = otel
+        self.source = source
+        self._ring: deque[dict[str, Any]] = deque(maxlen=max(int(size), 1))
+        self._lock = threading.Lock()
+        self.observed = 0
+        self.breached = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.ttft_s or self.tpot_s or self.total_s)
+
+    def _breaches(self, ttft: float | None, tpot: float | None,
+                  total: float | None) -> list[str]:
+        out = []
+        if self.ttft_s and ttft is not None and ttft > self.ttft_s:
+            out.append("ttft")
+        if self.tpot_s and tpot is not None and tpot > self.tpot_s:
+            out.append("tpot")
+        if self.total_s and total is not None and total > self.total_s:
+            out.append("total")
+        return out
+
+    def _append(self, rec: dict[str, Any], breaches: list[str]) -> None:
+        rec["breach"] = breaches
+        with self._lock:
+            self._ring.append(rec)
+            self.breached += 1
+        if self.otel is not None:
+            for b in breaches:
+                self.otel.record_slow_request(self.source, b)
+
+    def observe_phases(self, *, request_id: str, trace_id: str, model: str,
+                       phase_ns: dict[str, int], output_tokens: int,
+                       stream: bool, finish_reason: str | None) -> dict[str, Any] | None:
+        """Sidecar feeder: judge one finished request by its phase clock;
+        on breach capture the clock, the trace id, and the engine-step
+        window the request decoded inside."""
+        if not self.enabled:
+            return None
+        self.observed += 1
+        submit, admit = phase_ns.get("submit"), phase_ns.get("admit")
+        first, finish = phase_ns.get("first_token"), phase_ns.get("finish")
+        ttft = (first - submit) / 1e9 if submit is not None and first is not None else None
+        total = (finish - submit) / 1e9 if submit is not None and finish is not None else None
+        tpot = None
+        if first is not None and finish is not None and output_tokens > 1:
+            tpot = (finish - first) / 1e9 / (output_tokens - 1)
+        breaches = self._breaches(ttft, tpot, total)
+        if not breaches:
+            return None
+        to_ms = lambda a, b: round((b - a) / 1e6, 3) if a is not None and b is not None else None
+        rec: dict[str, Any] = {
+            "ts": time.time(),
+            "source": self.source,
+            "request_id": request_id,
+            "trace_id": trace_id or None,
+            "model": model,
+            "stream": stream,
+            "finish_reason": finish_reason,
+            "output_tokens": output_tokens,
+            "ttft_ms": to_ms(submit, first),
+            "total_ms": to_ms(submit, finish),
+            "tpot_ms": round(tpot * 1000, 3) if tpot is not None else None,
+            "phases_ms": {
+                "queue_wait": to_ms(submit, admit),
+                "prefill": to_ms(admit, first),
+                "decode": to_ms(first, finish),
+            },
+        }
+        if self.timeline is not None and submit is not None:
+            end = finish or first or submit
+            rec["engine_steps"] = self.timeline.window(submit / 1e9, end / 1e9)
+        self._append(rec, breaches)
+        return rec
+
+    def observe_event(self, event: dict[str, Any]) -> dict[str, Any] | None:
+        """Gateway-edge feeder: judge the wide event the access log just
+        emitted (TTFC as the edge TTFT view, duration as total, derived
+        per-token gap as TPOT)."""
+        if not self.enabled or event.get("kind") == "eventloop.stall":
+            return None
+        self.observed += 1
+        ttfc_ms = event.get("ttfc_ms")
+        duration_ms = event.get("duration_ms")
+        ttft = ttfc_ms / 1000 if isinstance(ttfc_ms, (int, float)) else None
+        total = duration_ms / 1000 if isinstance(duration_ms, (int, float)) else None
+        tpot = None
+        tps = event.get("tokens_per_sec")
+        if isinstance(tps, (int, float)) and tps > 0:
+            tpot = 1.0 / tps
+        breaches = self._breaches(ttft, tpot, total)
+        if not breaches:
+            return None
+        rec = {
+            "ts": time.time(),
+            "source": self.source,
+            "request_id": event.get("request_id"),
+            "trace_id": event.get("trace_id"),
+            "model": event.get("model"),
+            "route": event.get("route"),
+            "status": event.get("status"),
+            "stream": event.get("stream"),
+            "output_tokens": event.get("output_tokens"),
+            "ttft_ms": ttfc_ms,
+            "total_ms": duration_ms,
+            "tpot_ms": round(tpot * 1000, 3) if tpot is not None else None,
+        }
+        self._append(rec, breaches)
+        return rec
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            entries = list(self._ring)
+        return {
+            "thresholds": {"ttft_s": self.ttft_s, "tpot_s": self.tpot_s,
+                           "total_s": self.total_s},
+            "observed": self.observed,
+            "breached": self.breached,
+            "entries": entries,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Guarded device-trace capture
+# ---------------------------------------------------------------------------
+def jax_trace_capture(log_dir: str, seconds: float = 2.0) -> dict[str, Any]:
+    """Record a ``jax.profiler`` device trace into ``log_dir`` when a TPU
+    backend is live; a harmless no-op (with the reason) anywhere else.
+    Blocking — call via an executor from async handlers."""
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception as e:
+        return {"captured": False, "reason": f"jax unavailable: {e}"}
+    if platform != "tpu":
+        return {"captured": False, "reason": f"device platform {platform!r} is not tpu"}
+    try:
+        import jax.profiler
+
+        jax.profiler.start_trace(log_dir)
+        time.sleep(min(max(float(seconds), 0.1), MAX_CAPTURE_SECONDS))
+        jax.profiler.stop_trace()
+    except Exception as e:
+        return {"captured": False, "reason": f"trace failed: {e}"}
+    return {"captured": True, "log_dir": log_dir, "seconds": seconds}

@@ -357,6 +357,11 @@ class OverloadController:
         # wait_idle re-checks and re-arms, and a deadline overrun is only
         # observable at a wakeup when time is virtual.
         self._idle_event.set()
+        # A straggler finishing AFTER a timed-out drain re-set its gauge
+        # above; once the last one lands the series are dropped here, so
+        # the removal survives releases in any order.
+        if self.draining and self.total_in_flight() == 0:
+            self._drop_gauges()
 
     # -- graceful drain ------------------------------------------------
     def begin_drain(self) -> None:
@@ -387,6 +392,9 @@ class OverloadController:
         while self.total_in_flight() > 0:
             remaining = deadline - (self.clock.now() - start)
             if remaining <= 0:
+                # Timed out WITH work still in flight: the per-class
+                # series still describe live state — _release_slot drops
+                # them when the last straggler finishes.
                 self._record_drain("timed_out")
                 return False
             self._idle_event.clear()
@@ -396,7 +404,17 @@ class OverloadController:
                 self._record_drain("timed_out")
                 return False
         self._record_drain("completed")
+        self._drop_gauges()
         return True
+
+    def _drop_gauges(self) -> None:
+        """Drain is terminal for this process: its per-class admission
+        series stop describing live state — remove the label sets so a
+        final scrape doesn't freeze them on /metrics forever (ISSUE 4
+        gauge-staleness satellite)."""
+        if self.otel is not None:
+            for st in self._classes.values():
+                self.otel.remove_overload_gauges(st.name)
 
 
 def admission_middleware(overload: OverloadController, logger=None):

@@ -170,6 +170,12 @@ class Scheduler:
         # sidecar /health endpoint flags "degraded" when requests are
         # active but no step has completed recently (wedged device).
         self.last_step_time = time.monotonic()
+        # Optional decode-step timeline (ISSUE 4, otel/profiling.py
+        # StepTimeline): every processed prefill/decode/spec step is
+        # recorded with its wall time, kind, batch occupancy, tokens
+        # emitted, and KV utilization. None (the default) keeps the hot
+        # path at a single attribute check per chunk.
+        self.timeline = None
 
     def active_requests(self) -> int:
         return len(self._slots)
@@ -453,6 +459,7 @@ class Scheduler:
 
     def _process_prefill(self, p: "_PendingPrefill") -> None:
         """Materialize a prefill's first tokens and stream them out."""
+        t0 = time.perf_counter() if self.timeline is not None else 0.0
         try:
             results = self.engine.prefill_fetch(p.handle)
         except Exception as e:
@@ -478,6 +485,9 @@ class Scheduler:
             if finished:
                 del self._slots[slot]
                 self._release_guarded(slot, reason)
+        if self.timeline is not None:
+            self._record_step("prefill", t0, n_steps=1, batch=len(p.items),
+                              tokens=len(results))
 
     def _submit_chunk(self, chain: bool) -> "_Inflight | None":
         """Dispatch one fused decode chunk without waiting for it.
@@ -565,12 +575,15 @@ class Scheduler:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
 
+        t0 = time.perf_counter() if self.timeline is not None else 0.0
+        before_emitted = self.spec_emitted
         out, logprobs, counts = self.engine.spec_round(
             catchup, catchup_len, catchup_pos, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
         self.last_step_time = time.monotonic()
         self.spec_rounds += 1
         self.spec_slot_rounds += len(self._slots)
+        batch = len(self._slots)
 
         for slot in list(self._slots):
             st = self._slots[slot]
@@ -595,6 +608,9 @@ class Scheduler:
                 st.draft_len = P + min(n, K)
                 st.catchup = tuple(int(t) for t in out[slot, max(n - 2, 0):n]) \
                     if n == K + 1 else (int(out[slot, n - 1]),)
+        if self.timeline is not None:
+            self._record_step("spec", t0, n_steps=1, batch=batch,
+                              tokens=self.spec_emitted - before_emitted)
 
     def _spec_step_ngram(self) -> None:
         """One prompt-lookup round: host proposes K continuation tokens
@@ -624,12 +640,15 @@ class Scheduler:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
 
+        t0 = time.perf_counter() if self.timeline is not None else 0.0
+        before_emitted = self.spec_emitted
         out, logprobs, counts = self.engine.spec_round_ngram(
             pending, positions, draft, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
         self.last_step_time = time.monotonic()
         self.spec_rounds += 1
         self.spec_slot_rounds += len(self._slots)
+        batch = len(self._slots)
 
         for slot in list(self._slots):
             st = self._slots[slot]
@@ -646,6 +665,22 @@ class Scheduler:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
                     break
+        if self.timeline is not None:
+            self._record_step("spec_ngram", t0, n_steps=1, batch=batch,
+                              tokens=self.spec_emitted - before_emitted)
+
+    def _record_step(self, kind: str, t0: float, *, n_steps: int, batch: int,
+                     tokens: int) -> None:
+        """One decode-timeline record (ISSUE 4): duration covers fetch +
+        host-side emission — the full per-step cost a request observes.
+        kv_utilization/queue_depth reads are GIL-atomic, lock-free."""
+        try:
+            self.timeline.record(
+                kind, time.perf_counter() - t0, n_steps=n_steps, batch=batch,
+                tokens=tokens, kv_utilization=self.engine.kv_utilization(),
+                queue_depth=self.queue_depth)
+        except Exception as e:
+            self.logger.error("timeline record failed", e)
 
     def _process_chunk(self, inf: "_Inflight") -> None:
         """Fetch a submitted chunk's token block and stream it out.
@@ -658,6 +693,7 @@ class Scheduler:
         occupant's (already finished) stream.
         """
         self._normal_steps += inf.n_steps  # engine steps, for the spec probe cadence
+        t0 = time.perf_counter() if self.timeline is not None else 0.0
         try:
             toks, logprobs = self.engine.decode_chunk_fetch(inf.handle)
         except Exception as e:
@@ -670,6 +706,7 @@ class Scheduler:
             return
         self.last_step_time = time.monotonic()
 
+        emitted = 0
         for slot, snap_st in inf.states.items():
             st = self._slots.get(slot)
             if st is not snap_st:
@@ -679,6 +716,7 @@ class Scheduler:
                 st.pending_token = int(toks[j, slot])
                 st.pending_logprob = float(logprobs[j, slot])
                 st.generated += 1
+                emitted += 1
                 if self.engine.spec_ngram:
                     # Keep prompt-lookup history fresh while adaptive
                     # speculation is parked in the normal loop, so a
@@ -689,6 +727,9 @@ class Scheduler:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
                     break
+        if self.timeline is not None:
+            self._record_step("decode", t0, n_steps=inf.n_steps,
+                              batch=len(inf.states), tokens=emitted)
 
     def _release_guarded(self, slot: int, reason: str | None) -> None:
         """Release on the normal finish path: an allocator bookkeeping

@@ -31,7 +31,13 @@ from typing import Any
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio import sse
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
-from inference_gateway_tpu.otel.tracing import Tracer
+from inference_gateway_tpu.otel.profiling import (
+    SlowRequestLog,
+    StepTimeline,
+    handle_profile_query,
+    jax_trace_capture,
+)
+from inference_gateway_tpu.otel.tracing import Tracer, parse_traceparent
 from inference_gateway_tpu.resilience.overload import ServiceTimeEstimator
 from inference_gateway_tpu.serving.engine import Engine, EngineConfig
 from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler, SchedulerSaturatedError
@@ -53,7 +59,9 @@ class SidecarServer:
                  served_model_name: str | None = None, logger: Logger | None = None,
                  metrics_push_url: str | None = None, metrics_push_interval: float = 15.0,
                  max_queue_depth: int = 0, tracer: Tracer | None = None,
-                 otel=None, access_log=None):
+                 otel=None, access_log=None, timeline: StepTimeline | None = None,
+                 timeline_size: int = 512, slow_log: SlowRequestLog | None = None,
+                 profiler=None, watchdog=None):
         self.engine = engine
         self.logger = logger or new_logger()
         # Observability wiring (ISSUE 3): a tracer for the sidecar's
@@ -79,6 +87,21 @@ class SidecarServer:
         self.model_name = served_model_name or engine.config.model
         self.created = int(time.time())
         self._started = time.monotonic()
+        # Performance introspection (ISSUE 4): a decode-step timeline on
+        # the scheduler thread (GET /debug/timeline; timeline_size=0
+        # disables), slow-request forensics fed by the phase clock in
+        # _finalize_request, and optional sampling profiler / event-loop
+        # watchdog instances owned by serve() in the standalone sidecar.
+        if timeline is None and timeline_size > 0:
+            timeline = StepTimeline(timeline_size, otel=otel, model=self.model_name)
+        self.timeline = timeline
+        if self.scheduler.timeline is None:
+            self.scheduler.timeline = timeline
+        if slow_log is not None and slow_log.timeline is None:
+            slow_log.timeline = timeline
+        self.slow_log = slow_log
+        self.profiler = profiler
+        self.watchdog = watchdog
         self.router = self._build_router()
         self.http = HTTPServer(self.router, logger=self.logger)
         # OTLP push: decode-loop metrics flow into the gateway's
@@ -104,11 +127,17 @@ class SidecarServer:
         r.post("/v1/chat/completions", self.chat_completions)
         r.get("/props", self.props)
         r.get("/metrics", self.metrics)
+        r.get("/debug/timeline", self.debug_timeline)
+        r.get("/debug/status", self.debug_status)
+        r.get("/debug/profile", self.debug_profile)
+        r.get("/debug/jax_trace", self.debug_jax_trace)
         return r
 
     async def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:
         if self._own_scheduler:
             self.scheduler.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
         bound = await self.http.start(host, port)
         if self.metrics_push_url or (self.tracer.enabled and self.tracer.otlp_endpoint):
             self._push_task = asyncio.create_task(self._metrics_push_loop())
@@ -117,9 +146,18 @@ class SidecarServer:
     async def shutdown(self) -> None:
         if self._push_task is not None:
             self._push_task.cancel()
+        if self.watchdog is not None:
+            await self.watchdog.stop()
         await self.http.shutdown()
         if self._own_scheduler:
             self.scheduler.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
+        if self.otel is not None:
+            # Engine teardown: this replica's saturation gauges describe
+            # nothing now — drop the label sets instead of freezing them
+            # on /metrics (ISSUE 4 satellite).
+            self.otel.remove_engine_gauges(self.model_name)
 
     def depth_probe(self) -> int:
         """Engine saturation signal for a co-hosted gateway's
@@ -332,6 +370,68 @@ class SidecarServer:
             lines.append(f"{name} {val}")
         return Response.text("\n".join(lines) + "\n", content_type="text/plain; version=0.0.4")
 
+    # -- performance introspection (ISSUE 4) ---------------------------
+    async def debug_timeline(self, req: Request) -> Response:
+        """GET /debug/timeline — the engine decode-step ring: per-step
+        wall time, kind, batch occupancy, tokens, KV utilization.
+        ``?n=`` bounds the tail returned."""
+        if self.timeline is None:
+            return Response.json(
+                {"error": "timeline disabled (TELEMETRY_PROFILING_TIMELINE_SIZE=0)"},
+                status=404)
+        try:
+            n = int(req.query_get("n", "0") or 0)
+        except ValueError:
+            return Response.json({"error": "n must be an integer"}, status=400)
+        stats = self.timeline.stats()
+        return Response.json({
+            "model": self.model_name,
+            "steps": stats["steps"],
+            "records": stats["records"],
+            "entries": self.timeline.tail(n if n > 0 else None),
+        })
+
+    async def debug_status(self, req: Request) -> Response:
+        """GET /debug/status — one JSON snapshot of the sidecar's
+        introspection state: engine occupancy, timeline summary, the
+        slow-request log, and profiler/watchdog health."""
+        status: dict[str, Any] = {
+            "model": self.model_name,
+            "uptime_seconds": round(time.monotonic() - self._started, 3),
+            "active_requests": self.scheduler.active_requests(),
+            "queue_depth": self.scheduler.queue_depth,
+        }
+        if self.timeline is not None:
+            status["timeline"] = self.timeline.stats()
+        if self.slow_log is not None:
+            status["slow_requests"] = self.slow_log.snapshot()
+        if self.profiler is not None:
+            status["profiling"] = self.profiler.stats()
+        if self.watchdog is not None:
+            status["eventloop"] = self.watchdog.stats()
+        return Response.json(status)
+
+    async def debug_profile(self, req: Request) -> Response:
+        """GET /debug/profile?seconds=N&hz=M — on-demand collapsed-stack
+        capture (``?mode=continuous`` reads the ring instead)."""
+        status, ctype, body = await handle_profile_query(
+            self.profiler, seconds=req.query_get("seconds"),
+            hz=req.query_get("hz"), mode=req.query_get("mode"))
+        return Response.text(body, status=status, content_type=ctype)
+
+    async def debug_jax_trace(self, req: Request) -> Response:
+        """GET /debug/jax_trace?seconds=N&dir=PATH — guarded
+        ``jax.profiler.trace`` device capture; a no-op (with the reason)
+        off-TPU."""
+        try:
+            seconds = float(req.query_get("seconds", "2") or 2.0)
+        except ValueError:
+            return Response.json({"error": "seconds must be a number"}, status=400)
+        log_dir = req.query_get("dir", "/tmp/jax-trace")
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, jax_trace_capture, log_dir, seconds)
+        return Response.json(result, status=200 if result.get("captured") else 409)
+
     # ------------------------------------------------------------------
     def _decode_images(self, messages: list[dict[str, Any]]) -> list:
         """Pull image_url parts (data: URLs) into vision-ready arrays."""
@@ -541,19 +641,18 @@ class SidecarServer:
                 self.tracer.end_span(child, end_ns=max(t1, t0))
             self.tracer.end_span(root, end_ns=end_ns)
 
+        if not trace_id:
+            ctx = parse_traceparent(traceparent)
+            trace_id = ctx.trace_id if ctx else ""
+
         if self.access_log is not None:
             to_ms = lambda a, b: round((b - a) / 1e6, 3) if a is not None and b is not None else None  # noqa: E731
-            ctx = None
-            if not trace_id:
-                from inference_gateway_tpu.otel.tracing import parse_traceparent
-
-                ctx = parse_traceparent(traceparent)
             self.access_log.emit({
                 "route": "/v1/chat/completions",
                 "provider": "tpu",
                 "model": meta["model"],
                 "request_id": gen.request_id or meta["id"],
-                "trace_id": trace_id or (ctx.trace_id if ctx else None),
+                "trace_id": trace_id or None,
                 "stream": stream,
                 "finish_reason": finish_reason,
                 "input_tokens": meta["prompt_tokens"],
@@ -562,6 +661,16 @@ class SidecarServer:
                 "prefill_ms": to_ms(admit, first),
                 "decode_ms": to_ms(first, finish),
             })
+
+        if self.slow_log is not None:
+            # Forensics (ISSUE 4): a threshold breach captures the phase
+            # clock, trace id, and the engine-step window the request
+            # decoded inside — enough to answer "where did the time go"
+            # without re-running anything.
+            self.slow_log.observe_phases(
+                request_id=gen.request_id or meta["id"], trace_id=trace_id,
+                model=meta["model"], phase_ns=ph, output_tokens=completion_tokens,
+                stream=stream, finish_reason=finish_reason)
 
         self.sample_engine_gauges()
 
@@ -642,35 +751,58 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                 served_model_name: str | None = None, metrics_push_url: str | None = None) -> None:
     """Run the sidecar until cancelled (entry point for __main__).
 
-    The standalone sidecar honors the gateway's TELEMETRY_* env surface
-    (ISSUE 3): TELEMETRY_TRACING_ENABLE turns on the phase-span tracer
-    (exported to TELEMETRY_TRACING_OTLP_ENDPOINT on the push cadence),
-    TELEMETRY_ACCESS_LOG the per-request wide-event JSON line."""
+    The standalone sidecar honors the gateway's TELEMETRY_* env surface:
+    TELEMETRY_TRACING_ENABLE turns on the phase-span tracer (exported to
+    TELEMETRY_TRACING_OTLP_ENDPOINT on the push cadence),
+    TELEMETRY_ACCESS_LOG the per-request wide-event JSON line, and the
+    ISSUE 4 introspection knobs — TELEMETRY_PROFILING_* (sampling
+    profiler, event-loop watchdog, decode-step timeline) and
+    TELEMETRY_SLOW_REQUEST_* (forensics thresholds)."""
     import os
 
-    from inference_gateway_tpu.config import _get_bool
+    from inference_gateway_tpu.config import TelemetryConfig
 
-    def env_on(key: str) -> bool:
-        return _get_bool(os.environ, key, False)
-
+    tcfg = TelemetryConfig.load(os.environ)
     logger = new_logger()
     engine = Engine(config)
     warm = engine.warmup()
     logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)
     tracer = None
-    if env_on("TELEMETRY_TRACING_ENABLE"):
-        tracer = Tracer(
-            "tpu-sidecar", enabled=True, logger=logger,
-            otlp_endpoint=os.environ.get("TELEMETRY_TRACING_OTLP_ENDPOINT", ""),
-        )
+    if tcfg.tracing_enable:
+        tracer = Tracer("tpu-sidecar", enabled=True, logger=logger,
+                        otlp_endpoint=tcfg.tracing_otlp_endpoint)
     access_log = None
-    if env_on("TELEMETRY_ACCESS_LOG"):
+    if tcfg.access_log:
         from inference_gateway_tpu.otel.access_log import AccessLog
 
-        access_log = AccessLog(service="tpu-sidecar")
+        access_log = AccessLog(service="tpu-sidecar", tail_size=tcfg.access_log_tail)
+    profiler = None
+    if tcfg.profiling_enable or tcfg.profiling_continuous:
+        from inference_gateway_tpu.otel.profiling import SamplingProfiler
+
+        profiler = SamplingProfiler(
+            hz=tcfg.profiling_hz, window_s=tcfg.profiling_window,
+            windows=tcfg.profiling_windows, max_stacks=tcfg.profiling_max_stacks,
+            logger=logger)
+        if tcfg.profiling_continuous:
+            profiler.start_continuous()
+    watchdog = None
+    if tcfg.profiling_watchdog:
+        from inference_gateway_tpu.otel.profiling import EventLoopWatchdog
+
+        watchdog = EventLoopWatchdog(
+            access_log=access_log, interval=tcfg.profiling_watchdog_interval,
+            threshold=tcfg.profiling_watchdog_threshold, source="tpu-sidecar",
+            logger=logger)
+    slow_log = SlowRequestLog(
+        ttft_s=tcfg.slow_request_ttft, tpot_s=tcfg.slow_request_tpot,
+        total_s=tcfg.slow_request_total, size=tcfg.slow_request_log_size,
+        source="tpu-sidecar")
     server = SidecarServer(engine, served_model_name=served_model_name, logger=logger,
                            metrics_push_url=metrics_push_url, tracer=tracer,
-                           access_log=access_log)
+                           access_log=access_log,
+                           timeline_size=tcfg.profiling_timeline_size,
+                           slow_log=slow_log, profiler=profiler, watchdog=watchdog)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:

@@ -239,3 +239,74 @@ def hammer_registry(registry, writer_threads: int = 8, reader_threads: int = 2,
     if hist.total_count() != writer_threads * iters:
         fail(f"histogram lost observations: {hist.total_count()} != {writer_threads * iters}")
     return errors
+
+
+def hammer_profiler(lifecycle_threads: int = 3, reader_threads: int = 3,
+                    iters: int = 25) -> list[str]:
+    """Concurrency hammer for the sampling profiler (ISSUE 4 satellite).
+
+    The profiler's lifecycle is driven from asyncio handlers, shutdown
+    paths, and its own sampler thread simultaneously, so concurrent
+    start/sample/stop must neither raise, tear a window, nor leak a
+    sampler thread. N lifecycle threads cycle start_continuous/stop and
+    run blocking on-demand captures while reader threads hit snapshot()
+    and stats(). Returns error strings; the caller also asserts no
+    sampler thread survives the final stop().
+    """
+    from inference_gateway_tpu.otel.profiling import SamplingProfiler
+
+    prof = SamplingProfiler(hz=397.0, window_s=0.02, windows=4, max_stacks=128)
+    # Another fixture's continuous profiler may be live in this process;
+    # only threads spawned during the hammer count as leaks.
+    pre_existing = {t for t in threading.enumerate() if t.name == "profiler-sampler"}
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+    barrier = threading.Barrier(lifecycle_threads + reader_threads)
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    def lifecycle(tid: int) -> None:
+        barrier.wait()
+        for i in range(iters):
+            try:
+                if (i + tid) % 3 == 0:
+                    prof.start_continuous()
+                elif (i + tid) % 3 == 1:
+                    window = prof.profile(0.002, hz=397.0)
+                    if window.samples <= 0:
+                        fail("on-demand capture took no samples")
+                        return
+                else:
+                    prof.stop()
+            except Exception as e:
+                fail(f"lifecycle: {e!r}")
+                return
+
+    def reader() -> None:
+        barrier.wait()
+        for _ in range(iters * 2):
+            try:
+                prof.snapshot()
+                prof.stats()
+            except Exception as e:
+                fail(f"reader: {e!r}")
+                return
+
+    threads = [threading.Thread(target=lifecycle, args=(t,), name=f"prof-l{t}", daemon=True)
+               for t in range(lifecycle_threads)]
+    threads += [threading.Thread(target=reader, name=f"prof-r{t}", daemon=True)
+                for t in range(reader_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        if t.is_alive():
+            fail(f"{t.name} did not finish")
+    prof.stop()
+    leaked = [t for t in threading.enumerate()
+              if t.name == "profiler-sampler" and t not in pre_existing]
+    if leaked:
+        fail(f"sampler thread leaked after stop(): {[t.name for t in leaked]}")
+    return errors

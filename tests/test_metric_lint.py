@@ -1,0 +1,108 @@
+"""Instrument-registration lint + noop drift guard (ISSUE 4 satellites).
+
+Walks every instrument registered in ``OpenTelemetry.__init__`` and
+asserts the conventions the Prometheus exposition depends on: names
+sanitize idempotently into valid Prometheus identifiers, no duplicate
+registrations, label names exposition-safe, histogram boundaries
+strictly increasing, and unit-suffix naming conventions. Separately
+asserts ``NoopTelemetry`` overrides every public recorder — PR 3 added
+five recorders by hand, and a new one silently running the real
+implementation in noop mode is exactly the regression this guards.
+"""
+
+import re
+
+from inference_gateway_tpu.otel.metrics import Counter, Gauge, Histogram, _sanitize_name
+from inference_gateway_tpu.otel.otel import NoopTelemetry, OpenTelemetry
+
+_PROM_NAME = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# Suffixes the histogram exposition appends; a counter/gauge ending in
+# one would collide with some histogram's series.
+_RESERVED_SUFFIXES = ("_sum", "_count", "_bucket")
+
+
+def _instruments():
+    return list(OpenTelemetry().registry._instruments)
+
+
+def test_every_instrument_name_is_prometheus_safe_and_sanitize_idempotent():
+    for inst in _instruments():
+        pname = _sanitize_name(inst.name)
+        assert _PROM_NAME.match(pname), f"{inst.name!r} sanitizes to invalid {pname!r}"
+        assert _sanitize_name(pname) == pname, f"_sanitize_name not idempotent on {inst.name!r}"
+
+
+def test_no_duplicate_instrument_registrations():
+    names = [inst.name for inst in _instruments()]
+    assert len(names) == len(set(names)), (
+        f"duplicate registrations: {[n for n in names if names.count(n) > 1]}")
+    # Sanitized names must stay distinct too — two metrics may not merge
+    # in the exposition even if their raw names differ.
+    sanitized = [_sanitize_name(n) for n in names]
+    assert len(sanitized) == len(set(sanitized))
+
+
+def test_label_names_are_exposition_safe():
+    for inst in _instruments():
+        for label in inst.label_names:
+            assert _PROM_NAME.match(label), f"{inst.name}: bad label {label!r}"
+            assert _sanitize_name(label) == label, (
+                f"{inst.name}: label {label!r} changes under sanitization")
+            assert not label.startswith("__"), (
+                f"{inst.name}: label {label!r} uses the reserved __ prefix")
+
+
+def test_histogram_boundaries_strictly_increasing_and_positive():
+    for inst in _instruments():
+        if not isinstance(inst, Histogram):
+            continue
+        bounds = inst.boundaries
+        assert bounds, f"{inst.name}: histogram without boundaries"
+        assert all(b > 0 for b in bounds), f"{inst.name}: non-positive boundary"
+        assert all(a < b for a, b in zip(bounds, bounds[1:])), (
+            f"{inst.name}: boundaries not strictly increasing: {bounds}")
+
+
+def test_unit_suffix_conventions():
+    for inst in _instruments():
+        pname = _sanitize_name(inst.name)
+        if isinstance(inst, (Counter, Gauge)):
+            assert not pname.endswith(_RESERVED_SUFFIXES), (
+                f"{inst.name}: name collides with histogram exposition suffixes")
+        if isinstance(inst, Histogram) and inst.unit == "s":
+            assert any(tok in pname for tok in ("duration", "time", "lag", "latency", "wait")), (
+                f"{inst.name}: seconds histogram should name a duration/time/lag")
+        if isinstance(inst, Counter):
+            assert inst.unit.startswith("{") or inst.unit == "", (
+                f"{inst.name}: counters count discrete events; unit {inst.unit!r}")
+
+
+def test_noop_telemetry_overrides_every_recorder():
+    """Drift guard: every public record_*/set_*/remove_* method on
+    OpenTelemetry must be explicitly overridden by NoopTelemetry, or
+    telemetry-off deployments silently pay for (and expose) it."""
+    recorders = [
+        name for name, val in vars(OpenTelemetry).items()
+        if callable(val) and name.startswith(("record_", "set_", "remove_"))
+    ]
+    assert len(recorders) >= 20, f"recorder scan looks broken: {recorders}"
+    missing = [n for n in recorders if n not in vars(NoopTelemetry)]
+    assert not missing, (
+        f"NoopTelemetry does not override {missing}; a noop gateway would "
+        "run the real recorder (allocating label sets) for these")
+
+
+def test_noop_recorders_record_nothing():
+    noop = NoopTelemetry()
+    noop.record_token_usage("s", "t", "p", "m", 10, 10)
+    noop.record_request_duration("s", "t", "p", "m", "", 1.0)
+    noop.record_eventloop_lag("s", 1.0)
+    noop.record_eventloop_stall("s")
+    noop.record_engine_step("m", "decode", 0.001)
+    noop.record_slow_request("s", "total")
+    noop.set_engine_gauges("m", slot_occupancy=1.0)
+    assert noop.token_usage.total_count() == 0
+    assert noop.eventloop_lag.total_count() == 0
+    assert noop.engine_step_duration.total_count() == 0
+    assert sum(noop.slow_request_counter.values().values()) == 0
+    assert noop.engine_slot_occupancy_gauge.values() == {}

@@ -469,3 +469,75 @@ async def test_responses_api_tool_calls_recorded():
     async for _ in resp.chunks:
         pass
     assert otel2.tools == ["mcp_get_time"]
+
+
+# ---------------------------------------------------------------------------
+# Gauge label-set staleness (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+def test_gauge_remove_drops_label_set():
+    from inference_gateway_tpu.otel.metrics import Registry
+
+    r = Registry()
+    g = r.gauge("svc.current", "current state", ("model",))
+    g.set(1.0, {"model": "a"})
+    g.set(2.0, {"model": "b"})
+    assert g.remove({"model": "a"}) is True
+    assert g.remove({"model": "a"}) is False  # idempotent
+    assert list(g.values()) == [("b",)]
+    text = r.expose()
+    assert 'svc_current{model="b"} 2' in text
+    assert 'model="a"' not in text
+
+
+def test_gauge_ttl_sweep_on_expose():
+    import time as _time
+
+    from inference_gateway_tpu.otel.metrics import Registry
+
+    r = Registry()
+    g = r.gauge("svc.ephemeral", "ttl'd state", ("k",), ttl=60.0)
+    g.set(1.0, {"k": "stale"})
+    # Backdate the write past the TTL; expose() must sweep it.
+    key = tuple(g._values)[0]
+    g._updated[key] = _time.monotonic() - 120.0
+    g.set(2.0, {"k": "fresh"})
+    text = r.expose()
+    assert 'k="fresh"' in text and 'k="stale"' not in text
+    assert list(g.values()) == [("fresh",)]
+    # ttl=0 gauges are never swept
+    g0 = r.gauge("svc.forever", "unbounded", ("k",))
+    g0.set(1.0, {"k": "old"})
+    g0._updated[tuple(g0._values)[0]] = _time.monotonic() - 1e6
+    assert 'k="old"' in r.expose()
+
+
+def test_engine_and_overload_gauge_removal():
+    otel = OpenTelemetry()
+    otel.set_engine_gauges("m1", slot_occupancy=0.5, kv_utilization=0.25,
+                           queue_depth=3, spec_tokens_per_slot_round=1.5)
+    otel.set_overload_in_flight("streaming", 7)
+    otel.set_overload_queue_depth("streaming", 2)
+    assert otel.engine_slot_occupancy_gauge.values()
+    otel.remove_engine_gauges("m1")
+    for g in (otel.engine_slot_occupancy_gauge, otel.engine_kv_utilization_gauge,
+              otel.engine_queue_depth_gauge, otel.engine_spec_acceptance_gauge):
+        assert g.values() == {}, g.name
+    otel.remove_overload_gauges("streaming")
+    assert otel.overload_in_flight_gauge.values() == {}
+    assert otel.overload_queue_gauge.values() == {}
+
+
+async def test_drain_completion_drops_admission_gauges():
+    from inference_gateway_tpu.resilience.clock import VirtualClock
+    from inference_gateway_tpu.resilience.overload import OverloadController
+
+    otel = OpenTelemetry()
+    ctrl = OverloadController(None, otel=otel, clock=VirtualClock())
+    ticket = await ctrl.admit("streaming", 1)
+    assert otel.overload_in_flight_gauge.values()
+    ctrl.begin_drain()
+    ticket.release()
+    assert await ctrl.wait_idle(1.0) is True
+    # Terminal drain: the per-class series no longer describe live state.
+    assert otel.overload_in_flight_gauge.values() == {}
+    assert otel.overload_queue_gauge.values() == {}

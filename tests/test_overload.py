@@ -344,11 +344,16 @@ async def test_overload_metrics_exposed():
     hold = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
     with pytest.raises(AdmissionRejectedError):
         await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE)
+    text = otel.expose_prometheus()
+    assert 'inference_gateway_overload_in_flight{endpoint_class="streaming"} 1' in text
     ctrl.begin_drain()
     hold.release()
     assert await ctrl.wait_idle(1.0)
     text = otel.expose_prometheus()
-    assert 'inference_gateway_overload_in_flight{endpoint_class="streaming"} 0' in text
+    # Drain completion is terminal: the per-class current-state series
+    # are REMOVED (not frozen at 0) so a final scrape doesn't keep
+    # exposing a drained gateway forever (ISSUE 4 gauge staleness).
+    assert 'inference_gateway_overload_in_flight{endpoint_class="streaming"}' not in text
     assert 'inference_gateway_overload_shed' in text
     assert 'reason="capacity"' in text
     assert 'inference_gateway_overload_drain_events{phase="begun"} 1' in text

@@ -1,0 +1,369 @@
+"""Performance-introspection unit + lifecycle tests (ISSUE 4).
+
+The profiler/timeline/slow-log are plain data structures exercised
+directly; the watchdog runs on the PR 1 VirtualClock so its stall state
+machine is tested with zero real sleeps. Lifecycle tests extend the
+test_logger_lifecycle discipline: sampler and watchdog threads/tasks
+must shut down cleanly on Gateway.shutdown(), and the race-harness
+hammer drives concurrent start/sample/stop without leaks.
+"""
+
+import asyncio
+import io
+import threading
+import time
+
+from inference_gateway_tpu.main import build_gateway
+from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.otel.access_log import AccessLog
+from inference_gateway_tpu.otel.profiling import (
+    OVERFLOW_STACK,
+    EventLoopWatchdog,
+    SamplingProfiler,
+    SlowRequestLog,
+    StackWindow,
+    StepTimeline,
+    jax_trace_capture,
+    render_collapsed,
+)
+from inference_gateway_tpu.resilience.clock import VirtualClock
+
+from tests.race_harness import hammer_profiler
+
+
+# ---------------------------------------------------------------------------
+# Sampling profiler
+# ---------------------------------------------------------------------------
+def test_profiler_names_a_running_frame():
+    stop = threading.Event()
+
+    def _spin_for_profile():
+        while not stop.wait(0.0005):
+            pass
+
+    t = threading.Thread(target=_spin_for_profile, name="spinner", daemon=True)
+    t.start()
+    try:
+        prof = SamplingProfiler(hz=499.0)
+        window = prof.profile(0.25, hz=499.0)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert window.samples > 10
+    text = render_collapsed(window.counts)
+    assert "_spin_for_profile" in text
+    assert "thread:spinner" in text
+    # Collapsed format: every line is "stack count" with ;-joined frames.
+    for line in text.strip().splitlines():
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) > 0 and stack
+
+
+def test_stack_window_bounds_distinct_stacks():
+    w = StackWindow(max_stacks=16)
+    for i in range(100):
+        w.add(f"thread:x;frame{i}")
+    assert w.samples == 100
+    assert len(w.counts) <= 17  # 16 + overflow bucket
+    assert w.counts[OVERFLOW_STACK] == 100 - 16
+    assert sum(w.counts.values()) == 100
+
+
+def test_continuous_mode_rotates_bounded_ring_and_stops_clean():
+    prof = SamplingProfiler(hz=199.0, window_s=0.04, windows=3, max_stacks=256)
+    prof.start_continuous()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not prof.snapshot():
+            assert time.monotonic() < deadline, "continuous sampler never sampled"
+            time.sleep(0.01)
+        time.sleep(0.2)  # several window rotations
+        stats = prof.stats()
+        assert stats["continuous"] is True
+        assert 1 <= stats["windows_retained"] <= 4  # ring of 3 + current
+        assert stats["samples"] > 0
+    finally:
+        prof.stop()
+    assert prof.continuous is False
+    assert not [t for t in threading.enumerate() if t is prof._thread]
+    # snapshot still readable after stop (final window folded into ring)
+    assert prof.snapshot()
+
+
+def test_profiler_survives_concurrent_start_sample_stop():
+    assert hammer_profiler() == []
+
+
+# ---------------------------------------------------------------------------
+# Event-loop stall watchdog (zero real sleeps: VirtualClock)
+# ---------------------------------------------------------------------------
+async def test_watchdog_records_lag_and_stall_on_virtual_clock():
+    clock = VirtualClock()
+    otel = OpenTelemetry()
+    sink = AccessLog(stream=io.StringIO(), service="test")
+    wd = EventLoopWatchdog(otel=otel, access_log=sink, interval=0.25,
+                           threshold=0.1, clock=clock, source="test")
+    wd.add_context("conns", lambda: 7)
+    wd.start()
+    assert wd._thread is None  # virtual clock: no mid-stall snapshot thread
+    for _ in range(4):  # healthy beats: lag 0
+        await asyncio.sleep(0)
+    clock.advance(5.0)  # the loop "was wedged" for 5 virtual seconds
+    for _ in range(6):
+        await asyncio.sleep(0)
+    await wd.stop()
+    assert wd.beats >= 1
+    assert wd.stalls >= 1
+    assert otel.eventloop_lag.total_count() >= 1
+    assert sum(otel.eventloop_stall_counter.values().values()) >= 1
+    event = next(e for e in sink.tail if e.get("kind") == "eventloop.stall")
+    assert event["lag_s"] >= 4.9
+    assert event["source"] == "test"
+    assert event["conns"] == 7
+    assert wd.last_stall is not None and wd.last_stall["lag_s"] >= 4.9
+
+
+async def test_watchdog_quiet_loop_no_stalls():
+    clock = VirtualClock()
+    otel = OpenTelemetry()
+    wd = EventLoopWatchdog(otel=otel, interval=0.25, threshold=0.1,
+                           clock=clock, source="test")
+    wd.start()
+    for _ in range(8):
+        await asyncio.sleep(0)
+    await wd.stop()
+    assert wd.beats >= 2
+    assert wd.stalls == 0
+    assert sum(otel.eventloop_stall_counter.values().values()) == 0
+
+
+async def test_watchdog_start_stop_idempotent():
+    wd = EventLoopWatchdog(clock=VirtualClock())
+    wd.start()
+    task = wd._task
+    wd.start()  # second start is a no-op
+    assert wd._task is task
+    await wd.stop()
+    await wd.stop()
+    assert wd._task is None
+
+
+# ---------------------------------------------------------------------------
+# Decode-step timeline
+# ---------------------------------------------------------------------------
+def test_step_timeline_records_and_windows():
+    otel = OpenTelemetry()
+    tl = StepTimeline(size=8, otel=otel, model="m1")
+    t_before = time.time()
+    tl.record("prefill", 0.002, n_steps=1, batch=2, tokens=2, kv_utilization=0.5,
+              queue_depth=1)
+    for _ in range(10):
+        tl.record("decode", 0.001, n_steps=4, batch=2, tokens=8)
+    assert tl.steps == 1 + 40
+    assert tl.records == 11
+    assert len(tl.tail()) == 8  # bounded ring
+    assert tl.tail(2)[-1]["kind"] == "decode"
+    # window: everything recorded in the last second
+    win = tl.window(t_before, time.time())
+    assert win and all(r["ts"] >= t_before - 0.25 for r in win)
+    assert tl.window(t_before - 100, t_before - 99) == []
+    # engine.step_duration histogram fed per record
+    assert otel.engine_step_duration.total_count() == 11
+    stats = tl.stats()
+    assert stats["retained"] == 8 and stats["last"]["kind"] == "decode"
+
+
+# ---------------------------------------------------------------------------
+# Slow-request forensics
+# ---------------------------------------------------------------------------
+def _phase_ns(base_s: float, queue=0.5, prefill=0.5, decode=1.0) -> dict:
+    submit = int(base_s * 1e9)
+    admit = submit + int(queue * 1e9)
+    first = admit + int(prefill * 1e9)
+    finish = first + int(decode * 1e9)
+    return {"submit": submit, "admit": admit, "first_token": first, "finish": finish}
+
+
+def test_slow_log_disabled_by_default():
+    log = SlowRequestLog()
+    assert not log.enabled
+    assert log.observe_phases(request_id="r", trace_id="t", model="m",
+                              phase_ns=_phase_ns(time.time()), output_tokens=5,
+                              stream=False, finish_reason="stop") is None
+    assert log.snapshot()["entries"] == []
+
+
+def test_slow_log_captures_breach_with_engine_step_window():
+    otel = OpenTelemetry()
+    tl = StepTimeline(size=16, model="m")
+    log = SlowRequestLog(ttft_s=0.5, tpot_s=0.0, total_s=1.5, size=4,
+                         timeline=tl, otel=otel, source="tpu-sidecar")
+    now = time.time()
+    tl.record("decode", 0.001, n_steps=4, batch=1, tokens=4)
+    # ttft = 1.0s > 0.5s, total = 2.0s > 1.5s → both breach
+    rec = log.observe_phases(request_id="req-1", trace_id="abc123", model="m",
+                             phase_ns=_phase_ns(now - 1.0), output_tokens=5,
+                             stream=True, finish_reason="stop")
+    assert rec is not None
+    assert set(rec["breach"]) == {"ttft", "total"}
+    assert rec["trace_id"] == "abc123"
+    assert rec["phases_ms"]["queue_wait"] == 500.0
+    assert rec["engine_steps"], "surrounding engine-step window missing"
+    counts = otel.slow_request_counter.values()
+    assert sum(counts.values()) == 2  # one per breach kind
+    # fast request: no capture
+    assert log.observe_phases(request_id="req-2", trace_id="", model="m",
+                              phase_ns=_phase_ns(now, 0.01, 0.01, 0.01),
+                              output_tokens=5, stream=True,
+                              finish_reason="stop") is None
+    snap = log.snapshot()
+    assert snap["breached"] == 1 and snap["observed"] == 2
+
+
+def test_slow_log_bounded_ring():
+    log = SlowRequestLog(total_s=0.001, size=3)
+    for i in range(10):
+        log.observe_phases(request_id=f"r{i}", trace_id="", model="m",
+                           phase_ns=_phase_ns(time.time() - 3), output_tokens=2,
+                           stream=False, finish_reason="stop")
+    snap = log.snapshot()
+    assert len(snap["entries"]) == 3 and snap["breached"] == 10
+    assert snap["entries"][-1]["request_id"] == "r9"
+
+
+def test_slow_log_observes_gateway_wide_events():
+    log = SlowRequestLog(ttft_s=0.1, total_s=1.0, size=4, source="gateway")
+    rec = log.observe_event({"route": "/v1/chat/completions", "trace_id": "t1",
+                             "ttfc_ms": 250.0, "duration_ms": 400.0,
+                             "tokens_per_sec": 100.0, "status": 200})
+    assert rec is not None and rec["breach"] == ["ttft"]
+    assert log.observe_event({"route": "/v1/chat/completions",
+                              "ttfc_ms": 5.0, "duration_ms": 20.0}) is None
+    # stall wide events pass through the same sink but are never judged
+    assert log.observe_event({"kind": "eventloop.stall", "duration_ms": 9e9}) is None
+
+
+def test_access_log_feeds_slow_log_and_counts_drops():
+    slow = SlowRequestLog(total_s=0.1, size=4)
+    log = AccessLog(stream=io.StringIO(), tail_size=2, slow_log=slow)
+    for i in range(5):
+        log.emit({"route": "/x", "duration_ms": 500.0, "request_id": f"r{i}"})
+    assert log.dropped == 3  # 5 events, tail of 2
+    assert slow.breached == 5
+
+
+# ---------------------------------------------------------------------------
+# Guarded jax trace capture
+# ---------------------------------------------------------------------------
+def test_jax_trace_capture_noops_off_tpu(tmp_path):
+    result = jax_trace_capture(str(tmp_path), seconds=0.1)
+    assert result["captured"] is False
+    assert "tpu" in result["reason"]
+
+
+# ---------------------------------------------------------------------------
+# Gateway lifecycle: threads/tasks shut down cleanly
+# ---------------------------------------------------------------------------
+def test_gateway_shutdown_stops_profiler_and_watchdog(aloop):
+    env = {
+        "TPU_API_URL": "http://127.0.0.1:1/v1",
+        "OLLAMA_API_URL": "http://127.0.0.1:1/v1",
+        "LLAMACPP_API_URL": "http://127.0.0.1:1/v1",
+        "SERVER_PORT": "0",
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        "TELEMETRY_PROFILING_ENABLE": "true",
+        "TELEMETRY_PROFILING_CONTINUOUS": "true",
+        "TELEMETRY_PROFILING_HZ": "97",
+        "TELEMETRY_PROFILING_WINDOW": "500ms",
+        "TELEMETRY_PROFILING_WATCHDOG": "true",
+        "TELEMETRY_PROFILING_WATCHDOG_INTERVAL": "50ms",
+    }
+    gw = build_gateway(env=env)
+    assert gw.profiler is not None and gw.watchdog is not None
+    aloop.run(gw.start("127.0.0.1", 0))
+    assert gw.profiler.continuous
+    watchdog_task = gw.watchdog._task
+    assert watchdog_task is not None and not watchdog_task.done()
+    spawned = [t for t in threading.enumerate()
+               if t.name in ("profiler-sampler", "watchdog-sampler")]
+    assert spawned, "profiling threads never started"
+    aloop.run(gw.shutdown())
+    assert not gw.profiler.continuous
+    assert gw.watchdog._task is None and watchdog_task.done()
+    deadline = time.monotonic() + 5.0
+    for t in spawned:
+        t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        assert not t.is_alive(), f"{t.name} leaked past Gateway.shutdown()"
+
+
+# ---------------------------------------------------------------------------
+# Review-round fixes
+# ---------------------------------------------------------------------------
+async def test_capture_busy_returns_409_not_a_second_thread():
+    from inference_gateway_tpu.otel.profiling import CaptureBusyError, handle_profile_query
+
+    prof = SamplingProfiler(hz=97.0)
+    first = asyncio.ensure_future(prof.capture(0.3, hz=97.0))
+    await asyncio.sleep(0.05)  # let the capture occupy the guard
+    status, _, body = await handle_profile_query(prof, seconds="0.2", hz="97")
+    assert status == 409 and "already running" in body
+    try:
+        await prof.capture(0.1)
+    except CaptureBusyError:
+        pass
+    else:
+        raise AssertionError("second concurrent capture was admitted")
+    window = await first
+    assert window.samples > 0
+    # guard released: captures work again
+    status, _, _ = await handle_profile_query(prof, seconds="0.05", hz="97")
+    assert status == 200
+
+
+async def test_telemetry_middleware_feeds_slow_log_without_access_log():
+    """The gateway-edge forensics feeder is the telemetry middleware, so
+    TELEMETRY_SLOW_REQUEST_* thresholds work with the access log off."""
+    import json as _json
+
+    from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware
+    from inference_gateway_tpu.netio.server import Headers, Request, Response
+
+    slow = SlowRequestLog(total_s=0.0001, size=4, source="gateway")
+    mw = telemetry_middleware(OpenTelemetry(), slow_log=slow)
+
+    async def handler(req):
+        return Response.json({
+            "id": "x", "object": "chat.completion", "created": 1, "model": "m",
+            "choices": [{"index": 0, "message": {"role": "assistant", "content": "ok"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 2, "total_tokens": 5},
+        })
+
+    req = Request(method="POST", path="/v1/chat/completions", query={},
+                  headers=Headers(), body=_json.dumps(
+                      {"model": "ollama/m", "messages": []}).encode())
+    resp = await mw(req, handler)
+    assert resp.status == 200
+    assert slow.breached == 1
+    entry = slow.snapshot()["entries"][-1]
+    assert entry["breach"] == ["total"] and entry["model"] == "ollama/m"
+    assert entry["output_tokens"] == 2 and entry["stream"] is False
+
+
+async def test_timed_out_drain_drops_gauges_only_after_last_release():
+    from inference_gateway_tpu.resilience.clock import VirtualClock
+    from inference_gateway_tpu.resilience.overload import OverloadController
+
+    otel = OpenTelemetry()
+    ctrl = OverloadController(None, otel=otel, clock=VirtualClock())
+    straggler = await ctrl.admit("streaming", 1)
+    ctrl.begin_drain()
+    # Zero deadline: times out immediately with the straggler in flight.
+    assert await ctrl.wait_idle(0.0) is False
+    # Series still describe live state while the straggler runs...
+    assert otel.overload_in_flight_gauge.values()
+    straggler.release()
+    # ...and are removed (not frozen at 0) once it finishes.
+    assert otel.overload_in_flight_gauge.values() == {}
+    assert otel.overload_queue_gauge.values() == {}
