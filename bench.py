@@ -526,10 +526,29 @@ def tokens_per_dollar() -> dict:
 
 
 def relay_numbers() -> dict:
-    """Gateway relay throughput from benchmarks/RESULTS.md (measured on
-    the build container; regenerate with benchmarks/gateway_bench.py)."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "RESULTS.md")
+    """Gateway relay throughput — measured LIVE this run (CPU-only,
+    benchmarks/gateway_bench.py --relay-fanout in a subprocess) so the
+    BENCH trajectory tracks the streaming fast path; falls back to the
+    committed benchmarks/RESULTS.md table when the live run fails."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        _progress("live relay fan-out bench (subprocess, CPU)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "benchmarks", "gateway_bench.py"),
+             "--relay-fanout"],
+            capture_output=True, text=True, timeout=420, cwd=here, env=env,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT="):
+                out = json.loads(line[len("RESULT="):])
+                out["source"] = "live"
+                return out
+        _progress(f"relay bench produced no RESULT line: {(r.stderr or r.stdout)[-200:]}")
+    except Exception as e:
+        _progress(f"live relay bench failed: {type(e).__name__}: {e}")
+
+    path = os.path.join(here, "benchmarks", "RESULTS.md")
     out = {}
     try:
         text = open(path).read()
@@ -541,6 +560,7 @@ def relay_numbers() -> dict:
             m = re.search(re.escape(label) + r".*?\|[^|]*\|\s*\**([\d,]+) chunks/s", text)
             if m:
                 out[key] = int(m.group(1).replace(",", ""))
+        out["source"] = "RESULTS.md (stale)"
     except OSError:
         pass
     return out

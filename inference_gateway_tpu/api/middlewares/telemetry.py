@@ -146,8 +146,18 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
                                 # the sidecar's emit-path TPOT is exact.
                                 n_gaps += 1
                                 if n_gaps >= 2:
+                                    # The relay delivers coalesced BLOCKS
+                                    # that may carry many SSE frames: an
+                                    # N-frame block arriving after gap g
+                                    # approximates N tokens at g/N each
+                                    # (one cheap bytes.count, no JSON on
+                                    # the hot path). Line-anchored so
+                                    # "data:" INSIDE token text doesn't
+                                    # inflate the frame count.
+                                    frames = (chunk.count(b"\ndata:")
+                                              + chunk.startswith(b"data:")) or 1
                                     otel.record_tpot(source, team, provider, model,
-                                                     now - t_last)
+                                                     (now - t_last) / frames)
                             t_last = now
                             ring.append(chunk)
                         yield chunk

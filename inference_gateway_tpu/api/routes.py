@@ -602,23 +602,26 @@ class RouterImpl:
         content_type = resp.headers.get("Content-Type") or ""
         if not is_streaming or not content_type.startswith("text/event-stream"):
             if is_streaming:
-                chunks = b""
+                # List-accumulate + join once: `bytes += block` re-copies
+                # the whole prefix per block — O(n²) on large streamed
+                # non-SSE bodies.
+                parts = []
                 async for block in resp.iter_raw():
-                    chunks += block
-                body_out = chunks
+                    parts.append(block)
+                body_out = b"".join(parts)
             else:
                 body_out = resp.body
             out = Response(status=resp.status, body=body_out)
             out.headers.set("Content-Type", content_type or "application/json")
             return out
 
-        async def relay():
-            # Block-level passthrough: SSE framing is preserved verbatim;
-            # the telemetry usage scan splits lines itself.
-            async for block in resp.iter_raw():
-                yield block
-
-        return StreamingResponse.sse(self.resilience.guard_stream(relay()))
+        # Block-level passthrough, no wrapper generator: iter_raw already
+        # coalesces every buffered upstream byte into one block per read
+        # (SSE framing preserved verbatim; the telemetry usage scan
+        # splits lines itself), and the server's write path batches
+        # blocks into one transport write per loop pass — an extra
+        # passthrough coroutine frame per block bought nothing.
+        return StreamingResponse.sse(self.resilience.guard_stream(resp.iter_raw()))
 
     # ------------------------------------------------------------------
     async def list_tools_handler(self, req: Request) -> Response:
@@ -748,16 +751,15 @@ class RouterImpl:
             return error_json(f"Failed to reach upstream server: {e}", 502)
 
         if is_streaming and resp.status == 200:
-            async def relay():
-                async for block in resp.iter_raw():
-                    yield block
-
-            return StreamingResponse.sse(relay())
+            # Direct passthrough of iter_raw's coalesced blocks — the
+            # write path downstream batches them per loop pass.
+            return StreamingResponse.sse(resp.iter_raw())
 
         if is_streaming:
-            body_out = b""
+            parts = []
             async for block in resp.iter_raw():
-                body_out += block
+                parts.append(block)
+            body_out = b"".join(parts)
         else:
             body_out = resp.body
         if self.cfg.environment == "development":

@@ -76,6 +76,25 @@ _TELEMETRY_OBSERVABILITY_DOC = [
 ]
 
 
+# Emitted under the Serving section of Configurations.md: the streaming
+# data-plane fast path (ISSUE 5) in one paragraph.
+_SERVING_DATA_PLANE_DOC = [
+    "### Streaming data plane",
+    "",
+    "`SERVER_STREAM_COALESCE` (on by default) batches SSE chunk writes into",
+    "one transport write per event-loop pass — client-visible bytes are",
+    "identical, only the number of `send()` syscalls changes. The TPU",
+    "sidecar serializes the chunk envelope once per request and splices",
+    "per-token deltas in (no per-token `json.dumps`); its scheduler hands",
+    "each decode step's tokens to the event loop in one wakeup.",
+    "`SERVING_EMIT_COALESCE_MS` additionally merges same-step tokens into",
+    "one frame — fewer chunks/s for a bounded time-to-first-content bump;",
+    "per-token TPOT histograms are recorded before framing and are",
+    "unaffected. Design + trade-offs: [docs/performance.md](docs/performance.md).",
+    "",
+]
+
+
 # Emitted under the Resilience section of Configurations.md: what clients
 # observe in each degraded mode (ISSUE 1 satellite).
 _RESILIENCE_FAILURE_MODES = [
@@ -142,6 +161,8 @@ def generate_configurations_md(spec: dict) -> str:
         out.append("")
         if section == "telemetry":
             out.extend(_TELEMETRY_OBSERVABILITY_DOC)
+        elif section == "serving":
+            out.extend(_SERVING_DATA_PLANE_DOC)
         elif section == "resilience":
             out.extend(_RESILIENCE_FAILURE_MODES)
         elif section == "overload":
@@ -345,6 +366,8 @@ def check_config_defaults(spec: dict) -> list[str]:
         "SERVER_IDLE_TIMEOUT": cfg.server.idle_timeout,
         "SERVER_TLS_CERT_PATH": cfg.server.tls_cert_path,
         "SERVER_TLS_KEY_PATH": cfg.server.tls_key_path,
+        "SERVER_STREAM_COALESCE": cfg.server.stream_coalesce,
+        "SERVING_EMIT_COALESCE_MS": cfg.serving.emit_coalesce,
         "CLIENT_TIMEOUT": cfg.client.timeout,
         "CLIENT_MAX_IDLE_CONNS": cfg.client.max_idle_conns,
         "CLIENT_MAX_IDLE_CONNS_PER_HOST": cfg.client.max_idle_conns_per_host,

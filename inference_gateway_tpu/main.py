@@ -270,6 +270,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         write_timeout=cfg.server.write_timeout,
         idle_timeout=cfg.server.idle_timeout,
         logger=logger,
+        stream_coalesce=cfg.server.stream_coalesce,
     )
     # Self-addressed (relative-URL) requests — the provider layer's
     # /proxy/ double hop — dispatch in-process through this server's

@@ -47,6 +47,14 @@ class GenRequest:
     top_p: float = 1.0
     stop_token_ids: frozenset[int] = frozenset()
     callback: TokenCallback = lambda *a: None
+    # Optional step-boundary hook (streaming fast path): called on the
+    # scheduler thread after every engine step in which ``callback``
+    # received at least one token for this request (and after a failure
+    # callback). A consumer buffering tokens in ``callback`` can hand
+    # them to the event loop HERE — one call_soon_threadsafe (a loop
+    # wakeup, i.e. a socketpair write syscall) per decode step instead
+    # of one per token. None keeps the per-token contract unchanged.
+    flush_callback: Callable[[], None] | None = None
     request_id: str = ""
     embeds: object = None  # (T, H) multimodal embedding override row
     seed: int | None = None  # reproducible sampling (OpenAI `seed`)
@@ -367,12 +375,24 @@ class Scheduler:
             # it kill the scheduler thread.
             self._fail_after_decode_error(e)
 
+    @staticmethod
+    def _flush_emits(req: GenRequest) -> None:
+        """Step-boundary flush for token-batching consumers; a dead
+        client's flush must never kill the batch (same contract as
+        ``callback``)."""
+        if req.flush_callback is not None:
+            try:
+                req.flush_callback()
+            except Exception:
+                pass
+
     def _fail_request(self, req: GenRequest) -> None:
         req.phase_ns.setdefault("finish", time.time_ns())
         try:
             req.callback(0, 0.0, True, "error")
         except Exception:
             pass
+        self._flush_emits(req)
 
     def _fail_slot(self, slot: int, reason: str = "error") -> None:
         """Fail + release ONE slot, guarding each step: cleanup of one
@@ -485,6 +505,7 @@ class Scheduler:
             if finished:
                 del self._slots[slot]
                 self._release_guarded(slot, reason)
+            self._flush_emits(req)
         if self.timeline is not None:
             self._record_step("prefill", t0, n_steps=1, batch=len(p.items),
                               tokens=len(results))
@@ -608,6 +629,8 @@ class Scheduler:
                 st.draft_len = P + min(n, K)
                 st.catchup = tuple(int(t) for t in out[slot, max(n - 2, 0):n]) \
                     if n == K + 1 else (int(out[slot, n - 1]),)
+            if n:
+                self._flush_emits(st.req)
         if self.timeline is not None:
             self._record_step("spec", t0, n_steps=1, batch=batch,
                               tokens=self.spec_emitted - before_emitted)
@@ -665,6 +688,8 @@ class Scheduler:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
                     break
+            if n:
+                self._flush_emits(st.req)
         if self.timeline is not None:
             self._record_step("spec_ngram", t0, n_steps=1, batch=batch,
                               tokens=self.spec_emitted - before_emitted)
@@ -711,6 +736,7 @@ class Scheduler:
             st = self._slots.get(slot)
             if st is not snap_st:
                 continue  # finished, failed, or slot re-admitted mid-flight
+            slot_emitted = emitted
             for j in range(toks.shape[0]):
                 st.pos += 1
                 st.pending_token = int(toks[j, slot])
@@ -727,6 +753,11 @@ class Scheduler:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
                     break
+            if emitted > slot_emitted:
+                # One flush per request per CHUNK: a pipelined
+                # decode_chunk's whole token block reaches the event
+                # loop as one wakeup instead of n_steps of them.
+                self._flush_emits(st.req)
         if self.timeline is not None:
             self._record_step("decode", t0, n_steps=inf.n_steps,
                               batch=len(inf.states), tokens=emitted)

@@ -53,6 +53,11 @@ _PUSH_TPOT_BOUNDS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.
 # samples ≈ far more than any push interval accumulates.
 _MAX_PENDING_SAMPLES = 65536
 
+# Per-token JSON string escaping for the template-based SSE fast path —
+# the exact escaper json.dumps(ensure_ascii=True) uses (C-accelerated),
+# so spliced frames stay byte-identical to full-envelope serialization.
+_json_escape = json.encoder.encode_basestring_ascii
+
 
 class SidecarServer:
     def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
@@ -61,7 +66,8 @@ class SidecarServer:
                  max_queue_depth: int = 0, tracer: Tracer | None = None,
                  otel=None, access_log=None, timeline: StepTimeline | None = None,
                  timeline_size: int = 512, slow_log: SlowRequestLog | None = None,
-                 profiler=None, watchdog=None):
+                 profiler=None, watchdog=None, emit_coalesce: float = 0.0,
+                 stream_coalesce: bool = True):
         self.engine = engine
         self.logger = logger or new_logger()
         # Observability wiring (ISSUE 3): a tracer for the sidecar's
@@ -102,8 +108,17 @@ class SidecarServer:
         self.slow_log = slow_log
         self.profiler = profiler
         self.watchdog = watchdog
+        # Streaming fast path (SERVING_EMIT_COALESCE_MS): tokens sampled
+        # within this window (seconds; in practice: the same decode step)
+        # merge into ONE SSE frame. 0 (the default) keeps the one-frame-
+        # per-token OpenAI wire shape byte-identical; the per-token TPOT
+        # truth is recorded on the scheduler thread either way.
+        self.emit_coalesce = emit_coalesce
         self.router = self._build_router()
-        self.http = HTTPServer(self.router, logger=self.logger)
+        # SERVER_STREAM_COALESCE applies to the sidecar listener too —
+        # the documented off-switch must work on BOTH SSE hops.
+        self.http = HTTPServer(self.router, logger=self.logger,
+                               stream_coalesce=stream_coalesce)
         # OTLP push: decode-loop metrics flow into the gateway's
         # POST /v1/metrics (SURVEY.md §7 stage 7).
         self.metrics_push_url = metrics_push_url
@@ -513,11 +528,15 @@ class SidecarServer:
         first_token_seen = False
         last_token_t: list[float | None] = [None]
         traceparent = req.headers.get("traceparent")
+        pending: list[tuple[int, float, bool, str | None]] = []
 
         def cb(token: int, logprob: float, finished: bool, reason: str | None) -> None:
             # Runs on the scheduler thread — this IS the emit path, so
             # the inter-token gaps recorded here are true per-token
-            # latency, not relay-block arrival jitter (ISSUE 3).
+            # latency, not relay-block arrival jitter (ISSUE 3). Tokens
+            # buffer locally; flush() hands the step's whole batch to the
+            # event loop in ONE call_soon_threadsafe (one loop-wakeup
+            # syscall per decode step, not per token).
             nonlocal first_token_seen
             now = time.monotonic()
             if not first_token_seen:
@@ -526,9 +545,17 @@ class SidecarServer:
             elif last_token_t[0] is not None:
                 self.record_tpot(now - last_token_t[0])
             last_token_t[0] = now
-            loop.call_soon_threadsafe(q.put_nowait, (token, logprob, finished, reason))
+            pending.append((token, logprob, finished, reason))
+
+        def flush() -> None:
+            # Scheduler thread, step boundary. copy+clear under the GIL.
+            if pending:
+                batch = pending.copy()
+                pending.clear()
+                loop.call_soon_threadsafe(q.put_nowait, batch)
 
         gen.callback = cb
+        gen.flush_callback = flush
         want_logprobs = bool(body.get("logprobs"))
 
         # Bounded admission: a full scheduler queue sheds with 429 +
@@ -546,21 +573,24 @@ class SidecarServer:
             return StreamingResponse.sse(
                 self._stream_chunks(gen, meta, q, include_usage, arrival, traceparent))
 
-        # Non-streaming: drain the queue to completion.
+        # Non-streaming: drain the queue (one item per decode step, each
+        # a batch of tokens) to completion.
         detok = DetokenizeState()
         completion_tokens = 0
         reason = "stop"
+        done = False
         logprob_content: list[dict[str, Any]] = []
-        while True:
-            token, logprob, finished, fin_reason = await q.get()
-            if not (finished and fin_reason == "stop"):
-                delta = detok.push(self.engine.tokenizer, token)
-                if want_logprobs:
-                    logprob_content.append({"token": delta, "logprob": logprob})
-            completion_tokens += 1
-            if finished:
-                reason = fin_reason or "stop"
-                break
+        while not done:
+            for token, logprob, finished, fin_reason in await q.get():
+                if not (finished and fin_reason == "stop"):
+                    delta = detok.push(self.engine.tokenizer, token)
+                    if want_logprobs:
+                        logprob_content.append({"token": delta, "logprob": logprob})
+                completion_tokens += 1
+                if finished:
+                    reason = fin_reason or "stop"
+                    done = True
+                    break
         self._observe_service(time.monotonic() - arrival)
         self._finalize_request(gen, meta, traceparent, completion_tokens, stream=False,
                                finish_reason=reason)
@@ -679,7 +709,15 @@ class SidecarServer:
                              traceparent: str | None = None):
         """OpenAI chat.completion.chunk SSE frames off the decode loop.
         The request is already submitted (admission happens in
-        chat_completions, where saturation can still become a 429)."""
+        chat_completions, where saturation can still become a 429).
+
+        Zero-re-serialization: the invariant chunk envelope
+        (id/object/created/model/choices scaffold) is serialized ONCE per
+        request; each content frame splices only the JSON-escaped delta
+        text between the two halves — byte-identical to a full
+        ``json.dumps`` of the envelope, without paying it per token
+        (pinned by tests/test_stream_fastpath.py). Rare frames (role
+        preamble, finish, usage) still go through format_event."""
 
         def chunk(delta: dict[str, Any], finish: str | None) -> bytes:
             return sse.format_event({
@@ -690,6 +728,23 @@ class SidecarServer:
                 "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
             })
 
+        prefix = (
+            'data: {"id":%s,"object":"chat.completion.chunk","created":%d,'
+            '"model":%s,"choices":[{"index":0,"delta":{"content":'
+            % (json.dumps(meta["id"]), meta["created"], json.dumps(meta["model"]))
+        ).encode()
+        suffix = b'},"finish_reason":null}]}\n\n'
+
+        def content_frame(text: str) -> bytes:
+            return prefix + _json_escape(text).encode() + suffix
+
+        # SERVING_EMIT_COALESCE_MS: merge every token produced within the
+        # window into one frame (the queue already delivers one BATCH per
+        # decode step, so the window mostly just accepts a whole step's
+        # tokens at once instead of emitting one frame per token).
+        coalesce_s = self.emit_coalesce
+        loop = asyncio.get_running_loop()
+
         detok = DetokenizeState()
         completion_tokens = 0
         reason = "stop"
@@ -699,29 +754,52 @@ class SidecarServer:
             stop_strings = meta["stop_strings"]
             emitted_len = 0
             stopped_early = False
-            while True:
-                token, _logprob, finished, fin_reason = await q.get()
-                completion_tokens += 1
-                if not (finished and fin_reason == "stop"):
-                    delta = detok.push(self.engine.tokenizer, token)
-                else:
-                    delta = ""
-                if stop_strings and not stopped_early:
-                    cut, new_reason = self._apply_stop_strings(detok.emitted, stop_strings, "")
-                    if new_reason == "stop":
-                        delta = cut[emitted_len:]
-                        stopped_early = True
-                        reason = "stop"
-                        if delta:
-                            emitted_len += len(delta)
-                            yield chunk({"content": delta}, None)
+            done = False
+            while not done:
+                batch = list(await q.get())
+                if coalesce_s > 0 and not batch[-1][2]:  # last item not finished
+                    deadline = loop.time() + coalesce_s
+                    while not batch[-1][2]:
+                        remaining = deadline - loop.time()
+                        if remaining <= 0:
+                            break
+                        try:
+                            batch.extend(await asyncio.wait_for(q.get(), remaining))
+                        except asyncio.TimeoutError:
+                            break
+                parts: list[str] = []
+                for token, _logprob, finished, fin_reason in batch:
+                    completion_tokens += 1
+                    if not (finished and fin_reason == "stop"):
+                        delta = detok.push(self.engine.tokenizer, token)
+                    else:
+                        delta = ""
+                    if stop_strings and not stopped_early:
+                        cut, new_reason = self._apply_stop_strings(detok.emitted, stop_strings, "")
+                        if new_reason == "stop":
+                            delta = cut[emitted_len:]
+                            stopped_early = True
+                            reason = "stop"
+                            if delta:
+                                emitted_len += len(delta)
+                                if coalesce_s > 0:
+                                    parts.append(delta)
+                                else:
+                                    yield content_frame(delta)
+                            done = True
+                            break
+                    if delta and not stopped_early:
+                        emitted_len += len(delta)
+                        if coalesce_s > 0:
+                            parts.append(delta)
+                        else:
+                            yield content_frame(delta)
+                    if finished:
+                        reason = fin_reason or "stop"
+                        done = True
                         break
-                if delta and not stopped_early:
-                    emitted_len += len(delta)
-                    yield chunk({"content": delta}, None)
-                if finished:
-                    reason = fin_reason or "stop"
-                    break
+                if parts:
+                    yield content_frame("".join(parts))
 
             self._observe_service(time.monotonic() - arrival)
             yield chunk({}, reason)
@@ -760,9 +838,11 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
     TELEMETRY_SLOW_REQUEST_* (forensics thresholds)."""
     import os
 
-    from inference_gateway_tpu.config import TelemetryConfig
+    from inference_gateway_tpu.config import ServerConfig, ServingConfig, TelemetryConfig
 
     tcfg = TelemetryConfig.load(os.environ)
+    svcfg = ServingConfig.load(os.environ)
+    scfg = ServerConfig.load(os.environ)
     logger = new_logger()
     engine = Engine(config)
     warm = engine.warmup()
@@ -802,7 +882,9 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                            metrics_push_url=metrics_push_url, tracer=tracer,
                            access_log=access_log,
                            timeline_size=tcfg.profiling_timeline_size,
-                           slow_log=slow_log, profiler=profiler, watchdog=watchdog)
+                           slow_log=slow_log, profiler=profiler, watchdog=watchdog,
+                           emit_coalesce=svcfg.emit_coalesce,
+                           stream_coalesce=scfg.stream_coalesce)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:
