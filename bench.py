@@ -262,11 +262,24 @@ async def _ttft_load(engine, n_streams: int, max_tokens: int = 8) -> dict:
         return (ttft if ttft is not None else float("inf")), total
 
     results = await asyncio.gather(*[one() for _ in range(n_streams)], return_exceptions=True)
+    # Compute-efficiency capture (ISSUE 6): while the real sidecar is up
+    # on the real chip, pull /debug/roofline so the measured-vs-analytic
+    # aggregates land in the round's TPU_MEASURED artifact — stale
+    # rounds can then be spotted by the missing `measured: true`.
+    roofline = None
+    try:
+        from inference_gateway_tpu.netio.client import HTTPClient
+
+        resp = await HTTPClient().get(f"http://127.0.0.1:{port}/debug/roofline")
+        roofline = json.loads(resp.body)
+    except Exception as e:
+        roofline = {"error": f"{type(e).__name__}: {e}"}
     await server.shutdown()
     ttfts = sorted(r[0] for r in results if isinstance(r, tuple) and np.isfinite(r[0]))
     errors = n_streams - len(ttfts)
     if not ttfts:
-        return {"error": "no stream produced a first token", "failed_streams": errors}
+        return {"error": "no stream produced a first token", "failed_streams": errors,
+                "roofline": roofline}
     pick = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)]
     return {
         "n_streams": n_streams,
@@ -274,6 +287,7 @@ async def _ttft_load(engine, n_streams: int, max_tokens: int = 8) -> dict:
         "ttft_p99_ms": round(pick(0.99) * 1e3, 1),
         "ttft_max_ms": round(ttfts[-1] * 1e3, 1),
         "failed_streams": errors,
+        "roofline": roofline,
     }
 
 
@@ -610,7 +624,7 @@ def stamp_measured_artifact(result: dict) -> None:
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "note": "live on-chip measurement stamped by bench.py at success time",
     }
-    path = os.path.join(_measured_dir(), "TPU_MEASURED_r05.json")
+    path = os.path.join(_measured_dir(), "TPU_MEASURED_r06.json")
     try:
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
@@ -631,6 +645,20 @@ def baseline_extras() -> dict:
         extras["analytic"] = analytic_model()
     except Exception as e:
         extras["analytic_error"] = f"{type(e).__name__}: {e}"
+    try:
+        # Compute-efficiency trajectory key (ISSUE 6): mfu_analytic is
+        # CPU arithmetic and moves EVERY round; mfu_measured is filled
+        # by the on-chip path only (never synthesized off-TPU).
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        from gateway_bench import compute_efficiency_analytic
+
+        eff = compute_efficiency_analytic(
+            os.environ.get("BENCH_PROFILE", "v5e-1-llama-3-8b-int4"))
+        eff["mfu_measured"] = None
+        extras["compute_efficiency"] = eff
+    except Exception as e:
+        extras["compute_efficiency_error"] = f"{type(e).__name__}: {e}"
     extras["relay"] = relay_numbers()
     extras["last_measured_on_chip"] = last_measured_on_chip()
     try:
@@ -792,6 +820,10 @@ def main() -> None:
         "n_params": n_params,
         "prompt_len": prompt_len,
     })
+    # The measured half of the efficiency trajectory (ISSUE 6): only a
+    # live on-chip run may ever write this key.
+    _PARTIAL["extra"].setdefault("compute_efficiency", {})["mfu_measured"] = (
+        round(mfu * 100, 2))
     roof = (_PARTIAL["extra"].get("analytic") or {}).get(profile.name, {})
     if roof.get("tokens_per_sec_per_chip_roofline"):
         _PARTIAL["extra"]["pct_of_roofline"] = round(

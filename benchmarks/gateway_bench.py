@@ -575,6 +575,132 @@ async def bench_profiling_overhead(n: int = 200) -> dict:
     }
 
 
+def compute_efficiency_analytic(profile_name: str = "v5e-8-llama-3-8b") -> dict:
+    """Analytic compute-efficiency point for a committed profile (ISSUE
+    6): decode-step roofline and the MFU a roofline-perfect engine would
+    post at full batch / mean occupancy. Pure CPU arithmetic from the
+    model config + chip datasheet, so the BENCH trajectory's
+    ``mfu_analytic`` moves every round — even the rounds where no TPU
+    window opens (the r04–r05 failure mode)."""
+    from inference_gateway_tpu.otel.perf_accounting import StepCostModel
+    from inference_gateway_tpu.serving.profiles import PROFILES
+
+    p = PROFILES[profile_name]
+    m = StepCostModel.from_profile(p)
+    # Mean occupancy assumption matches bench.py analytic_model():
+    # max_seq_len/4 live tokens per slot.
+    ctx = p.max_slots * (p.max_seq_len // 4)
+    step = m.decode(batch=p.max_slots, context_tokens=ctx)
+    return {
+        "profile": p.name,
+        "mfu_analytic": round(100.0 * step.flops / (step.roofline_s * m.peak_flops_total), 2),
+        "decode_step_ms_roofline": round(step.roofline_s * 1e3, 3),
+        "bound": step.bound,
+        "tokens_per_sec_per_chip_roofline": round(
+            p.max_slots / step.roofline_s / p.n_chips),
+    }
+
+
+async def bench_compute_efficiency(requests: int = 3, max_tokens: int = 16) -> dict:
+    """The efficiency-trajectory scenario (ISSUE 6): ``mfu_analytic``
+    from the flagship profile's cost model (CPU, every round) plus an
+    end-to-end pass through a real sidecar's accounting —
+    ``/debug/roofline`` must serve per-kind measured-vs-analytic
+    aggregates, and off-TPU the window numbers must be framed
+    ``measured: false`` (``mfu_measured`` stays None until a TPU window
+    opens)."""
+    from inference_gateway_tpu.otel.otel import OpenTelemetry
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    out = {"bench": "compute_efficiency"}
+    out.update(compute_efficiency_analytic())
+
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=128,
+                                 dtype="float32", max_prefill_batch=2, use_mesh=False))
+    sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                            otel=OpenTelemetry())
+    port = await sidecar.start("127.0.0.1", 0)
+    client = HTTPClient()
+    body = json.dumps({"model": "test-tiny", "stream": True, "max_tokens": max_tokens,
+                       "messages": [{"role": "user", "content": "efficiency probe"}]}).encode()
+    for _ in range(requests):
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                 body, stream=True)
+        async for _ in resp.iter_raw():
+            pass
+    resp = await client.get(f"http://127.0.0.1:{port}/debug/roofline")
+    report = json.loads(resp.body)
+    await sidecar.shutdown()
+
+    decode = report.get("per_kind", {}).get("decode", {})
+    out.update({
+        "measured": report["measured"],
+        # Percent, matching mfu_analytic and bench.py's on-chip key —
+        # the window gauge itself is a 0..1 fraction.
+        "mfu_measured": round(report["window"]["mfu"] * 100, 2)
+        if report["measured"] else None,
+        "host_gap_decode": decode.get("gap_factor"),
+        "wasted_tokens": sum(report["window"]["wasted_tokens"].values()),
+    })
+    return out
+
+
+async def bench_accounting_overhead(n: int = 60, max_tokens: int = 24) -> dict:
+    """p99 streamed-request latency through the real sidecar with
+    compute-efficiency accounting on vs off — the ISSUE 6 acceptance
+    gate: pricing every engine chunk must stay inside the noise (<5%
+    p99) or it would not survive as an always-on default."""
+    from inference_gateway_tpu.otel.otel import OpenTelemetry
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    async def run_variant(accounting_on: bool) -> list[float]:
+        engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=128,
+                                     dtype="float32", max_prefill_batch=2,
+                                     use_mesh=False))
+        # Identical telemetry base in both variants — the delta must
+        # isolate the accounting, not the otel registry underneath it.
+        sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                                otel=OpenTelemetry(),
+                                accounting_enable=accounting_on)
+        port = await sidecar.start("127.0.0.1", 0)
+        client = HTTPClient()
+        body = json.dumps({
+            "model": "test-tiny", "stream": True, "max_tokens": max_tokens,
+            "messages": [{"role": "user", "content": "overhead probe"}]}).encode()
+
+        async def one() -> float:
+            t0 = time.perf_counter()
+            resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                     body, stream=True)
+            async for _ in resp.iter_raw():
+                pass
+            return time.perf_counter() - t0
+
+        for _ in range(5):
+            await one()
+        lats = sorted([await one() for _ in range(n)])
+        await sidecar.shutdown()
+        return lats
+
+    off = await run_variant(False)
+    on = await run_variant(True)
+
+    def p(lats: list[float], q: float) -> float:
+        return round(lats[min(len(lats) - 1, int(len(lats) * q))] * 1000, 3)
+
+    delta = round(p(on, 0.99) - p(off, 0.99), 3)
+    return {
+        "bench": "accounting_overhead",
+        "p50_off_ms": p(off, 0.50), "p50_on_ms": p(on, 0.50),
+        "p99_off_ms": p(off, 0.99), "p99_on_ms": p(on, 0.99),
+        "p99_delta_ms": delta,
+        "p99_delta_pct": round(delta / p(off, 0.99) * 100, 2) if p(off, 0.99) else None,
+        "ops": n,
+    }
+
+
 async def main() -> None:
     results = [
         await bench_chat_completions(),
@@ -600,6 +726,8 @@ async def main() -> None:
         await bench_overload(),
         await bench_telemetry_overhead(),
         await bench_profiling_overhead(),
+        await bench_compute_efficiency(),
+        await bench_accounting_overhead(),
     ]
     for r in results:
         print(json.dumps(r))

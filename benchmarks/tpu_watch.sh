@@ -5,8 +5,14 @@
 # Loops for up to WATCH_HOURS (default 11): every cycle, probe the chip
 # with a killable subprocess matmul; when it answers, immediately run
 # bench.py with a generous deadline so the live number is stamped to
-# benchmarks/TPU_MEASURED_r05.json. Stops after the first stale-free
+# benchmarks/TPU_MEASURED_r06.json. Stops after the first stale-free
 # bench emit (a second window would only re-measure the same build).
+#
+# ISSUE 6: a live window must also capture the compute-efficiency
+# evidence — mfu_measured plus the sidecar's /debug/roofline aggregates
+# ride bench.py's extras into the artifact; their ABSENCE from a "live"
+# capture is logged loudly so a stale-efficiency round (r04–r05) can't
+# recur silently.
 set -u
 cd "$(dirname "$0")/.."
 WATCH_HOURS="${WATCH_HOURS:-11}"
@@ -29,6 +35,14 @@ print("PROBE_OK", d[0].platform, len(d))
        grep -q '"value"' benchmarks/bench_live_out.json && \
        ! grep -q '"value": 0.0' benchmarks/bench_live_out.json; then
       echo "[watch $(date -u +%H:%M:%S)] live bench captured — done" >> "$LOG"
+      if grep -q '"mfu_measured": [0-9]' benchmarks/TPU_MEASURED_r06.json 2>/dev/null; then
+        echo "[watch $(date -u +%H:%M:%S)] mfu_measured captured in artifact" >> "$LOG"
+      else
+        echo "[watch $(date -u +%H:%M:%S)] WARNING: live artifact has no mfu_measured — efficiency trajectory still stale" >> "$LOG"
+      fi
+      if ! grep -q '"roofline"' benchmarks/TPU_MEASURED_r06.json 2>/dev/null; then
+        echo "[watch $(date -u +%H:%M:%S)] WARNING: live artifact has no /debug/roofline capture" >> "$LOG"
+      fi
       exit 0
     fi
     echo "[watch $(date -u +%H:%M:%S)] bench did not produce a live number; keep watching" >> "$LOG"

@@ -73,6 +73,23 @@ _TELEMETRY_OBSERVABILITY_DOC = [
     "(collapsed stacks → flamegraph.pl/speedscope, slow-request schema):",
     "[docs/observability.md](docs/observability.md).",
     "",
+    "### Compute efficiency",
+    "",
+    "`TELEMETRY_ACCOUNTING_*` (on by default) prices every engine step",
+    "against the chip's analytic roofline, computed from nothing but the",
+    "model config and the chip datasheet: live `engine.mfu`,",
+    "`engine.goodput_mfu` (useful tokens only), and",
+    "`engine.hbm_bandwidth_util` gauges over a rolling window,",
+    "per-step-kind `engine.step_roofline_ratio{kind}` gap factors, and",
+    "`engine.wasted_tokens{reason}` attribution (speculation rejections,",
+    "chunk overrun, disconnected clients, shed-after-prefill). The",
+    "sidecar's `GET /debug/roofline` aggregates measured-vs-analytic per",
+    "step kind (p50/p99 step ms, achieved TFLOP/s and GB/s, compute- vs",
+    "bandwidth-bound verdict); off-TPU the report is framed",
+    "`measured: false` so host wall clock is never mistaken for kernel",
+    "time. Schema and reading guide:",
+    "[docs/observability.md](docs/observability.md).",
+    "",
 ]
 
 
@@ -335,6 +352,9 @@ def check_config_defaults(spec: dict) -> list[str]:
         "TELEMETRY_SLOW_REQUEST_TPOT": cfg.telemetry.slow_request_tpot,
         "TELEMETRY_SLOW_REQUEST_TOTAL": cfg.telemetry.slow_request_total,
         "TELEMETRY_SLOW_REQUEST_LOG_SIZE": cfg.telemetry.slow_request_log_size,
+        "TELEMETRY_ACCOUNTING_ENABLE": cfg.telemetry.accounting_enable,
+        "TELEMETRY_ACCOUNTING_WINDOW": cfg.telemetry.accounting_window,
+        "TELEMETRY_ACCOUNTING_CHIP": cfg.telemetry.accounting_chip,
         "MCP_ENABLE": cfg.mcp.enable,
         "MCP_EXPOSE": cfg.mcp.expose,
         "MCP_SERVERS": cfg.mcp.servers,

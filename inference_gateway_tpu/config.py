@@ -57,7 +57,10 @@ class TelemetryConfig:
     """TELEMETRY_* (config.go:46-52), plus the performance-introspection
     surface (ISSUE 4): TELEMETRY_PROFILING_* (sampling profiler,
     event-loop watchdog, decode-step timeline) and
-    TELEMETRY_SLOW_REQUEST_* (forensics thresholds; 0 disables a check).
+    TELEMETRY_SLOW_REQUEST_* (forensics thresholds; 0 disables a check),
+    plus compute-efficiency accounting (ISSUE 6):
+    TELEMETRY_ACCOUNTING_* (live MFU / roofline pricing of every engine
+    step; on by default, zero-overhead when off).
     """
 
     enable: bool = False
@@ -81,6 +84,9 @@ class TelemetryConfig:
     slow_request_tpot: float = 0.0
     slow_request_total: float = 0.0
     slow_request_log_size: int = 64
+    accounting_enable: bool = True
+    accounting_window: float = 10.0
+    accounting_chip: str = ""
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "TELEMETRY_") -> "TelemetryConfig":
@@ -106,6 +112,9 @@ class TelemetryConfig:
             slow_request_tpot=_get_duration(env, prefix + "SLOW_REQUEST_TPOT", "0s"),
             slow_request_total=_get_duration(env, prefix + "SLOW_REQUEST_TOTAL", "0s"),
             slow_request_log_size=_get_int(env, prefix + "SLOW_REQUEST_LOG_SIZE", 64),
+            accounting_enable=_get_bool(env, prefix + "ACCOUNTING_ENABLE", True),
+            accounting_window=_get_duration(env, prefix + "ACCOUNTING_WINDOW", "10s"),
+            accounting_chip=_get_str(env, prefix + "ACCOUNTING_CHIP", ""),
         )
 
 

@@ -448,7 +448,8 @@ class StepTimeline:
         self.records = 0
 
     def record(self, kind: str, duration_s: float, *, n_steps: int = 1, batch: int = 0,
-               tokens: int = 0, kv_utilization: float = 0.0, queue_depth: int = 0) -> None:
+               tokens: int = 0, kv_utilization: float = 0.0, queue_depth: int = 0,
+               cost: dict[str, Any] | None = None) -> None:
         rec = {
             "ts": time.time(),
             "kind": kind,
@@ -459,6 +460,11 @@ class StepTimeline:
             "kv_utilization": round(kv_utilization, 4),
             "queue_depth": queue_depth,
         }
+        if cost:
+            # Analytic step cost from the accounting layer (ISSUE 6):
+            # flops / hbm_bytes / roofline_ms / bound ride every record
+            # so /debug/roofline can aggregate measured-vs-analytic.
+            rec.update(cost)
         with self._lock:
             self._ring.append(rec)
             self.steps += n_steps

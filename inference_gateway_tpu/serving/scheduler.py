@@ -58,6 +58,11 @@ class GenRequest:
     request_id: str = ""
     embeds: object = None  # (T, H) multimodal embedding override row
     seed: int | None = None  # reproducible sampling (OpenAI `seed`)
+    # Set by the serving edge when the client abandoned the stream (ISSUE
+    # 6 wasted-work attribution): the scheduler keeps decoding to the
+    # finish condition, but every further token is billed to
+    # engine.wasted_tokens{reason="disconnected"} instead of goodput.
+    disconnected: bool = False
     # Per-request phase clock (ISSUE 3 observability): epoch-ns stamps for
     # submit → admit (queue.wait) → first_token (prefill) → finish
     # (decode), written by the scheduler as the request crosses each
@@ -184,6 +189,16 @@ class Scheduler:
         # emitted, and KV utilization. None (the default) keeps the hot
         # path at a single attribute check per chunk.
         self.timeline = None
+        # Optional compute-efficiency accounting (ISSUE 6,
+        # otel/perf_accounting.PerfAccounting): prices every recorded
+        # step (flops/bytes/roofline merged into the timeline record)
+        # and attributes wasted work. Same None-is-free discipline.
+        self.accounting = None
+        # Timeline failure damping (ISSUE 6 satellite): a broken record
+        # path must not logger.error once per engine step forever —
+        # consecutive failures are rate-limited and the timeline is
+        # disabled outright after _TIMELINE_MAX_FAILURES in a row.
+        self._timeline_failures = 0
 
     def active_requests(self) -> int:
         return len(self._slots)
@@ -402,6 +417,14 @@ class Scheduler:
         st = self._slots.pop(slot, None)
         if st is not None:
             self._fail_request(st.req)
+            # The prompt was prefilled and some tokens may have been
+            # decoded, but the stream ends in "error": all of it was
+            # work no client benefits from (ISSUE 6). The generated
+            # tokens were emitted — and so counted as delivered — before
+            # the failure; the prompt tokens never were.
+            self._wasted("shed_after_prefill",
+                         len(st.req.prompt_ids) + st.generated,
+                         delivered=st.generated)
         try:
             self._release(slot, reason)
         except Exception as e:
@@ -479,7 +502,7 @@ class Scheduler:
 
     def _process_prefill(self, p: "_PendingPrefill") -> None:
         """Materialize a prefill's first tokens and stream them out."""
-        t0 = time.perf_counter() if self.timeline is not None else 0.0
+        t0 = time.perf_counter() if self._observing else 0.0
         try:
             results = self.engine.prefill_fetch(p.handle)
         except Exception as e:
@@ -506,9 +529,12 @@ class Scheduler:
                 del self._slots[slot]
                 self._release_guarded(slot, reason)
             self._flush_emits(req)
-        if self.timeline is not None:
+        if self._observing:
+            prompt_lens = [len(req.prompt_ids) for req, _slot in p.items]
             self._record_step("prefill", t0, n_steps=1, batch=len(p.items),
-                              tokens=len(results))
+                              tokens=len(results),
+                              work_tokens=sum(prompt_lens),
+                              sq_tokens=sum(t * t for t in prompt_lens))
 
     def _submit_chunk(self, chain: bool) -> "_Inflight | None":
         """Dispatch one fused decode chunk without waiting for it.
@@ -596,7 +622,9 @@ class Scheduler:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
 
-        t0 = time.perf_counter() if self.timeline is not None else 0.0
+        observing = self._observing
+        t0 = time.perf_counter() if observing else 0.0
+        ctx = sum(st.pos for st in self._slots.values()) if observing else 0
         before_emitted = self.spec_emitted
         out, logprobs, counts = self.engine.spec_round(
             catchup, catchup_len, catchup_pos, active, temps, top_ps,
@@ -611,6 +639,7 @@ class Scheduler:
             n = int(counts[slot])
             P = st.pos
             finished = False
+            delivered = 0
             for j in range(n):
                 st.pos += 1
                 st.pending_token = int(out[slot, j])
@@ -620,20 +649,28 @@ class Scheduler:
                 # request's trailing accepted tokens are discarded and
                 # must not inflate the acceptance telemetry).
                 self.spec_emitted += 1
+                delivered += 1
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
                     break
+            if self.accounting is not None:
+                # The verify forward priced K+1 positions: the target
+                # rejected K+1-n of them, and accepted tokens past a
+                # finish are discarded (ISSUE 6 wasted-work attribution).
+                self._wasted("spec_rejected", K + 1 - n)
+                self._wasted("chunk_overrun", n - delivered)
             if not finished:
                 st.draft_len = P + min(n, K)
                 st.catchup = tuple(int(t) for t in out[slot, max(n - 2, 0):n]) \
                     if n == K + 1 else (int(out[slot, n - 1]),)
             if n:
                 self._flush_emits(st.req)
-        if self.timeline is not None:
+        if observing:
             self._record_step("spec", t0, n_steps=1, batch=batch,
-                              tokens=self.spec_emitted - before_emitted)
+                              tokens=self.spec_emitted - before_emitted,
+                              context_tokens=ctx)
 
     def _spec_step_ngram(self) -> None:
         """One prompt-lookup round: host proposes K continuation tokens
@@ -663,7 +700,9 @@ class Scheduler:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
 
-        t0 = time.perf_counter() if self.timeline is not None else 0.0
+        observing = self._observing
+        t0 = time.perf_counter() if observing else 0.0
+        ctx = sum(st.pos for st in self._slots.values()) if observing else 0
         before_emitted = self.spec_emitted
         out, logprobs, counts = self.engine.spec_round_ngram(
             pending, positions, draft, active, temps, top_ps,
@@ -676,36 +715,92 @@ class Scheduler:
         for slot in list(self._slots):
             st = self._slots[slot]
             n = int(counts[slot])
+            delivered = 0
             for j in range(n):
                 st.pos += 1
                 st.pending_token = int(out[slot, j])
                 st.pending_logprob = float(logprobs[slot, j])
                 st.generated += 1
                 self.spec_emitted += 1
+                delivered += 1
                 st.history.append(st.pending_token)
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
                     break
+            if self.accounting is not None:
+                self._wasted("spec_rejected", K + 1 - n)
+                self._wasted("chunk_overrun", n - delivered)
             if n:
                 self._flush_emits(st.req)
-        if self.timeline is not None:
+        if observing:
             self._record_step("spec_ngram", t0, n_steps=1, batch=batch,
-                              tokens=self.spec_emitted - before_emitted)
+                              tokens=self.spec_emitted - before_emitted,
+                              context_tokens=ctx)
+
+    # Timeline failure damping (ISSUE 6 satellite): log the 1st and every
+    # 50th consecutive failure, give up entirely after 8 in a row.
+    _TIMELINE_LOG_EVERY = 50
+    _TIMELINE_MAX_FAILURES = 8
+
+    @property
+    def _observing(self) -> bool:
+        """Whether any per-step observer (timeline, accounting) is
+        attached — the single hot-path gate for t0 stamping and
+        context-token summing."""
+        return self.timeline is not None or self.accounting is not None
 
     def _record_step(self, kind: str, t0: float, *, n_steps: int, batch: int,
-                     tokens: int) -> None:
+                     tokens: int, work_tokens: int = 0, context_tokens: int = 0,
+                     sq_tokens: int = 0) -> None:
         """One decode-timeline record (ISSUE 4): duration covers fetch +
         host-side emission — the full per-step cost a request observes.
-        kv_utilization/queue_depth reads are GIL-atomic, lock-free."""
+        kv_utilization/queue_depth reads are GIL-atomic, lock-free. With
+        accounting attached (ISSUE 6) the step is also priced — flops,
+        HBM bytes, and roofline ms ride the same timeline record.
+
+        A failing observer must never spam the log once per engine step
+        forever (the pre-ISSUE-6 behavior): consecutive failures are
+        rate-limited, and after _TIMELINE_MAX_FAILURES in a row both
+        observers are detached — serving continues, observability
+        reports its own death exactly once."""
+        duration = time.perf_counter() - t0
         try:
-            self.timeline.record(
-                kind, time.perf_counter() - t0, n_steps=n_steps, batch=batch,
-                tokens=tokens, kv_utilization=self.engine.kv_utilization(),
-                queue_depth=self.queue_depth)
+            cost = None
+            if self.accounting is not None:
+                cost = self.accounting.on_step(
+                    kind, duration, batch=batch, n_steps=n_steps, tokens=tokens,
+                    work_tokens=work_tokens, context_tokens=context_tokens,
+                    sq_tokens=sq_tokens)
+            if self.timeline is not None:
+                self.timeline.record(
+                    kind, duration, n_steps=n_steps, batch=batch,
+                    tokens=tokens, kv_utilization=self.engine.kv_utilization(),
+                    queue_depth=self.queue_depth, cost=cost)
+            self._timeline_failures = 0
         except Exception as e:
-            self.logger.error("timeline record failed", e)
+            self._timeline_failures += 1
+            n = self._timeline_failures
+            if n >= self._TIMELINE_MAX_FAILURES:
+                self.logger.error(
+                    "timeline/accounting disabled after repeated record failures",
+                    e, "consecutive", n)
+                self.timeline = None
+                self.accounting = None
+            elif n == 1 or n % self._TIMELINE_LOG_EVERY == 0:
+                self.logger.error("timeline record failed", e, "consecutive", n)
+
+    def _wasted(self, reason: str, tokens: int, delivered: int = 0) -> None:
+        """Attribute wasted work without ever letting accounting
+        bookkeeping hurt the serving loop. ``delivered`` marks the
+        subset already counted as delivered tokens (goodput subtracts
+        only those)."""
+        if self.accounting is not None and tokens > 0:
+            try:
+                self.accounting.record_wasted(reason, tokens, delivered=delivered)
+            except Exception:
+                pass
 
     def _process_chunk(self, inf: "_Inflight") -> None:
         """Fetch a submitted chunk's token block and stream it out.
@@ -718,7 +813,8 @@ class Scheduler:
         occupant's (already finished) stream.
         """
         self._normal_steps += inf.n_steps  # engine steps, for the spec probe cadence
-        t0 = time.perf_counter() if self.timeline is not None else 0.0
+        observing = self._observing
+        t0 = time.perf_counter() if observing else 0.0
         try:
             toks, logprobs = self.engine.decode_chunk_fetch(inf.handle)
         except Exception as e:
@@ -731,11 +827,18 @@ class Scheduler:
             return
         self.last_step_time = time.monotonic()
 
+        ctx = sum(s.pos for s in inf.states.values()) if observing else 0
         emitted = 0
+        overrun = 0
         for slot, snap_st in inf.states.items():
             st = self._slots.get(slot)
             if st is not snap_st:
-                continue  # finished, failed, or slot re-admitted mid-flight
+                # Finished, failed, or re-admitted mid-flight: every row
+                # this chunk computed for the slot served a stream that
+                # already ended (bounded wasted work by design — now
+                # *attributed*, ISSUE 6).
+                overrun += toks.shape[0]
+                continue
             slot_emitted = emitted
             for j in range(toks.shape[0]):
                 st.pos += 1
@@ -752,15 +855,18 @@ class Scheduler:
                 if finished:
                     del self._slots[slot]
                     self._release_guarded(slot, reason)
+                    overrun += toks.shape[0] - (j + 1)
                     break
             if emitted > slot_emitted:
                 # One flush per request per CHUNK: a pipelined
                 # decode_chunk's whole token block reaches the event
                 # loop as one wakeup instead of n_steps of them.
                 self._flush_emits(st.req)
-        if self.timeline is not None:
+        self._wasted("chunk_overrun", overrun)
+        if observing:
             self._record_step("decode", t0, n_steps=inf.n_steps,
-                              batch=len(inf.states), tokens=emitted)
+                              batch=len(inf.states), tokens=emitted,
+                              context_tokens=ctx)
 
     def _release_guarded(self, slot: int, reason: str | None) -> None:
         """Release on the normal finish path: an allocator bookkeeping
@@ -790,6 +896,13 @@ class Scheduler:
             req.callback(token, logprob, finished, reason)
         except Exception:
             pass  # a dead client must not kill the batch
+        if req.disconnected:
+            # The serving edge marked the stream abandoned: the engine
+            # still decodes to the finish condition, but nobody reads
+            # these tokens (ISSUE 6 wasted-work attribution). Each one
+            # was just counted as delivered — flag it so goodput
+            # subtracts it again.
+            self._wasted("disconnected", 1, delivered=1)
         return finished, reason
 
     def _release(self, slot: int, reason: str | None) -> None:

@@ -31,6 +31,11 @@ from typing import Any
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio import sse
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.otel.perf_accounting import (
+    PerfAccounting,
+    StepCostModel,
+    roofline_report,
+)
 from inference_gateway_tpu.otel.profiling import (
     SlowRequestLog,
     StepTimeline,
@@ -67,7 +72,11 @@ class SidecarServer:
                  otel=None, access_log=None, timeline: StepTimeline | None = None,
                  timeline_size: int = 512, slow_log: SlowRequestLog | None = None,
                  profiler=None, watchdog=None, emit_coalesce: float = 0.0,
-                 stream_coalesce: bool = True):
+                 stream_coalesce: bool = True,
+                 accounting: PerfAccounting | None = None,
+                 accounting_enable: bool = True,
+                 accounting_window: float = 10.0,
+                 accounting_chip: str | None = None):
         self.engine = engine
         self.logger = logger or new_logger()
         # Observability wiring (ISSUE 3): a tracer for the sidecar's
@@ -108,6 +117,23 @@ class SidecarServer:
         self.slow_log = slow_log
         self.profiler = profiler
         self.watchdog = watchdog
+        # Compute-efficiency accounting (ISSUE 6): price every engine
+        # step against the chip roofline (TELEMETRY_ACCOUNTING_ENABLE;
+        # on by default — the analytic side must move every round, not
+        # just when someone remembers to turn it on). Disabled, neither
+        # the scheduler nor the emit path pays anything.
+        if accounting is None and accounting_enable:
+            try:
+                accounting = PerfAccounting(
+                    StepCostModel.from_engine(engine, chip=accounting_chip),
+                    otel=otel, model=self.model_name, window_s=accounting_window)
+            except Exception as e:
+                # An unknown model config must degrade to "no accounting",
+                # never block serving.
+                self.logger.warn("perf accounting disabled", "error", str(e))
+        self.accounting = accounting
+        if self.scheduler.accounting is None:
+            self.scheduler.accounting = accounting
         # Streaming fast path (SERVING_EMIT_COALESCE_MS): tokens sampled
         # within this window (seconds; in practice: the same decode step)
         # merge into ONE SSE frame. 0 (the default) keeps the one-frame-
@@ -143,6 +169,7 @@ class SidecarServer:
         r.get("/props", self.props)
         r.get("/metrics", self.metrics)
         r.get("/debug/timeline", self.debug_timeline)
+        r.get("/debug/roofline", self.debug_roofline)
         r.get("/debug/status", self.debug_status)
         r.get("/debug/profile", self.debug_profile)
         r.get("/debug/jax_trace", self.debug_jax_trace)
@@ -171,8 +198,10 @@ class SidecarServer:
         if self.otel is not None:
             # Engine teardown: this replica's saturation gauges describe
             # nothing now — drop the label sets instead of freezing them
-            # on /metrics (ISSUE 4 satellite).
+            # on /metrics (ISSUE 4 satellite). Efficiency gauges (ISSUE
+            # 6) follow the same current-state semantics.
             self.otel.remove_engine_gauges(self.model_name)
+            self.otel.remove_efficiency_gauges(self.model_name)
 
     def depth_probe(self) -> int:
         """Engine saturation signal for a co-hosted gateway's
@@ -263,6 +292,17 @@ class SidecarServer:
         ]
         metrics = [self._delta_histogram(name, samples, bounds, attrs)
                    for name, samples, bounds in batches if samples]
+        if self.accounting is not None:
+            # The mfu snapshot rides every push (ISSUE 6): last-value
+            # gauges the gateway ingest maps onto engine.mfu & friends.
+            eff = self.accounting.snapshot()
+            for name, val in (("engine.mfu", eff["mfu"]),
+                              ("engine.goodput_mfu", eff["goodput_mfu"]),
+                              ("engine.hbm_bandwidth_util", eff["hbm_bandwidth_util"])):
+                metrics.append({
+                    "name": name,
+                    "gauge": {"dataPoints": [{"asDouble": val, "attributes": attrs}]},
+                })
         if not metrics:
             return None
         return {
@@ -359,6 +399,15 @@ class SidecarServer:
             m["kv_pages_free"] = self.engine.allocator.free_page_count()
         if self.engine.prefix_cache is not None:
             m["prefix_cache"] = self.engine.prefix_cache.stats()
+        if self.accounting is not None:
+            # The mfu snapshot every scrape carries (ISSUE 6): flattened
+            # numerics so the Prometheus text path exports them too.
+            eff = self.accounting.snapshot()
+            m["mfu"] = eff["mfu"]
+            m["goodput_mfu"] = eff["goodput_mfu"]
+            m["hbm_bandwidth_util"] = eff["hbm_bandwidth_util"]
+            m["wasted_tokens"] = sum(eff["wasted_tokens"].values())
+            m["compute_efficiency"] = eff
         return m
 
     async def metrics(self, req: Request) -> Response:
@@ -406,6 +455,22 @@ class SidecarServer:
             "entries": self.timeline.tail(n if n > 0 else None),
         })
 
+    async def debug_roofline(self, req: Request) -> Response:
+        """GET /debug/roofline — per-step-kind measured-vs-analytic
+        aggregates over the timeline ring (ISSUE 6): p50/p99 step ms,
+        achieved TFLOP/s and GB/s, gap-to-roofline factor, and the
+        compute- vs bandwidth-bound verdict. Off-TPU the report is
+        framed ``measured: false`` — host wall clock is not kernel
+        time and is never presented as a hardware measurement."""
+        if self.accounting is None:
+            return Response.json(
+                {"error": "accounting disabled (TELEMETRY_ACCOUNTING_ENABLE)"},
+                status=404)
+        entries = self.timeline.tail(None) if self.timeline is not None else []
+        report = roofline_report(self.accounting, entries)
+        report["model"] = self.model_name
+        return Response.json(report)
+
     async def debug_status(self, req: Request) -> Response:
         """GET /debug/status — one JSON snapshot of the sidecar's
         introspection state: engine occupancy, timeline summary, the
@@ -418,6 +483,8 @@ class SidecarServer:
         }
         if self.timeline is not None:
             status["timeline"] = self.timeline.stats()
+        if self.accounting is not None:
+            status["compute_efficiency"] = self.accounting.snapshot()
         if self.slow_log is not None:
             status["slow_requests"] = self.slow_log.snapshot()
         if self.profiler is not None:
@@ -677,7 +744,7 @@ class SidecarServer:
 
         if self.access_log is not None:
             to_ms = lambda a, b: round((b - a) / 1e6, 3) if a is not None and b is not None else None  # noqa: E731
-            self.access_log.emit({
+            event = {
                 "route": "/v1/chat/completions",
                 "provider": "tpu",
                 "model": meta["model"],
@@ -690,7 +757,19 @@ class SidecarServer:
                 "queue_wait_ms": to_ms(submit, admit),
                 "prefill_ms": to_ms(admit, first),
                 "decode_ms": to_ms(first, finish),
-            })
+            }
+            if self.accounting is not None:
+                # Per-request compute attribution (ISSUE 6): the useful
+                # work this request bought, in the same FLOP currency the
+                # MFU gauges report — the substrate per-tenant quotas
+                # will bill against (ROADMAP item 4).
+                pf, df = self.accounting.request_flops(
+                    meta["prompt_tokens"], completion_tokens)
+                event["prefill_flops"] = round(pf)
+                event["decode_flops"] = round(df)
+                if gen.disconnected:
+                    event["disconnected"] = True
+            self.access_log.emit(event)
 
         if self.slow_log is not None:
             # Forensics (ISSUE 4): a threshold breach captures the phase
@@ -748,6 +827,7 @@ class SidecarServer:
         detok = DetokenizeState()
         completion_tokens = 0
         reason = "stop"
+        completed = False
         try:
             yield chunk({"role": "assistant", "content": ""}, None)
 
@@ -817,10 +897,17 @@ class SidecarServer:
                     },
                 })
             yield sse.DONE_FRAME
+            completed = True
         finally:
             # Runs for completed AND abandoned streams (the server
             # acloses the generator on dead clients): phase spans, the
             # queue-wait sample, and the access-log line must not leak.
+            if not completed:
+                # Abandoned mid-stream: the scheduler decodes on to the
+                # finish condition, but those tokens are wasted work —
+                # flag the request so the accounting bills them to
+                # engine.wasted_tokens{reason="disconnected"} (ISSUE 6).
+                gen.disconnected = True
             self._finalize_request(gen, meta, traceparent, completion_tokens,
                                    stream=True, finish_reason=reason)
 
@@ -884,7 +971,10 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                            timeline_size=tcfg.profiling_timeline_size,
                            slow_log=slow_log, profiler=profiler, watchdog=watchdog,
                            emit_coalesce=svcfg.emit_coalesce,
-                           stream_coalesce=scfg.stream_coalesce)
+                           stream_coalesce=scfg.stream_coalesce,
+                           accounting_enable=tcfg.accounting_enable,
+                           accounting_window=tcfg.accounting_window,
+                           accounting_chip=tcfg.accounting_chip or None)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:
