@@ -294,15 +294,18 @@ class RouterImpl:
             return out
 
         if body.get("stream"):
-            # Streaming is NOT idempotent once bytes flow: fail over only
-            # before the first byte (stream establishment), never retry.
+            # Streaming is NOT idempotent once bytes flow — but it IS
+            # until the first relayed byte (ISSUE 7): execute_streaming
+            # fails over on establishment errors AND on an upstream that
+            # dies before any byte reaches the client, under the same
+            # trace id. After the first byte, failures propagate.
             async def call(cand: _Candidate, b) -> Any:
                 return await cand.provider_obj.stream_chat_completions(
                     request_for(cand), ctx, timeout=b.timeout())
 
             try:
-                stream, served = await self.resilience.execute(
-                    candidates, call, budget=budget, idempotent=False, alias=alias,
+                stream, served = await self.resilience.execute_streaming(
+                    candidates, call, budget=budget, alias=alias,
                     event=event)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
@@ -473,8 +476,10 @@ class RouterImpl:
                     chat_req_for(cand), ctx, timeout=b.timeout())
 
             try:
-                stream, _served = await self.resilience.execute(
-                    candidates, call, budget=budget, idempotent=False, alias=alias,
+                # Same pre-first-byte recovery contract as the chat
+                # streaming path (ISSUE 7).
+                stream, _served = await self.resilience.execute_streaming(
+                    candidates, call, budget=budget, alias=alias,
                     event=event)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)

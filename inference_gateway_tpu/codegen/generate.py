@@ -112,6 +112,25 @@ _SERVING_DATA_PLANE_DOC = [
 ]
 
 
+# Emitted under the Serving section: the serving-path fault model in one
+# paragraph (ISSUE 7); the full story lives in docs/resilience.md.
+_SERVING_FAULT_TOLERANCE_DOC = [
+    "### Serving-path fault tolerance",
+    "",
+    "KV page exhaustion no longer fails requests: the scheduler *preempts*",
+    "the youngest running request — its slot and pages are released and it",
+    "re-enters the queue with prompt+generated-so-far for a recompute-style",
+    "resume (PrefixCache makes the re-prefill cheap), emitting no duplicate",
+    "and dropping no token. `SERVING_PREEMPT_BUDGET` bounds preemptions per",
+    "request so livelock degrades to a clean failure. A wedged device step",
+    "(`SERVING_WATCHDOG_*`) captures forensics, fails in-flight requests",
+    "with a retryable error, rebuilds the engine in place, and flips health",
+    "degraded→ready so failover pools route around the window. Full fault",
+    "model: [docs/resilience.md](docs/resilience.md).",
+    "",
+]
+
+
 # Emitted under the Resilience section of Configurations.md: what clients
 # observe in each degraded mode (ISSUE 1 satellite).
 _RESILIENCE_FAILURE_MODES = [
@@ -180,6 +199,7 @@ def generate_configurations_md(spec: dict) -> str:
             out.extend(_TELEMETRY_OBSERVABILITY_DOC)
         elif section == "serving":
             out.extend(_SERVING_DATA_PLANE_DOC)
+            out.extend(_SERVING_FAULT_TOLERANCE_DOC)
         elif section == "resilience":
             out.extend(_RESILIENCE_FAILURE_MODES)
         elif section == "overload":
@@ -388,6 +408,13 @@ def check_config_defaults(spec: dict) -> list[str]:
         "SERVER_TLS_KEY_PATH": cfg.server.tls_key_path,
         "SERVER_STREAM_COALESCE": cfg.server.stream_coalesce,
         "SERVING_EMIT_COALESCE_MS": cfg.serving.emit_coalesce,
+        "SERVING_PREEMPT_ENABLE": cfg.serving.preempt_enable,
+        "SERVING_PREEMPT_BUDGET": cfg.serving.preempt_budget,
+        "SERVING_PREEMPT_HIGH_WATER": cfg.serving.preempt_high_water,
+        "SERVING_WATCHDOG_ENABLE": cfg.serving.watchdog_enable,
+        "SERVING_WATCHDOG_INTERVAL": cfg.serving.watchdog_interval,
+        "SERVING_WATCHDOG_MULTIPLIER": cfg.serving.watchdog_multiplier,
+        "SERVING_WATCHDOG_MIN_DEADLINE": cfg.serving.watchdog_min_deadline,
         "CLIENT_TIMEOUT": cfg.client.timeout,
         "CLIENT_MAX_IDLE_CONNS": cfg.client.max_idle_conns,
         "CLIENT_MAX_IDLE_CONNS_PER_HOST": cfg.client.max_idle_conns_per_host,
@@ -407,6 +434,8 @@ def check_config_defaults(spec: dict) -> list[str]:
         "RESILIENCE_RETRY_MAX_BACKOFF": cfg.resilience.retry_max_backoff,
         "RESILIENCE_REQUEST_BUDGET": cfg.resilience.request_budget,
         "RESILIENCE_STREAM_IDLE_TIMEOUT": cfg.resilience.stream_idle_timeout,
+        "RESILIENCE_STREAM_RETRY_ENABLED": cfg.resilience.stream_retry_enabled,
+        "RESILIENCE_STREAM_RETRY_MAX": cfg.resilience.stream_retry_max,
         "OVERLOAD_ENABLED": cfg.overload.enabled,
         "OVERLOAD_MAX_CONCURRENT_STREAMING": cfg.overload.max_concurrent_streaming,
         "OVERLOAD_MAX_CONCURRENT_BUFFERED": cfg.overload.max_concurrent_buffered,

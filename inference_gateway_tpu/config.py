@@ -262,6 +262,12 @@ class ResilienceConfig:
     retry_max_backoff: float = 2.0
     request_budget: float = 30.0
     stream_idle_timeout: float = 60.0
+    # Mid-stream recovery (ISSUE 7): streamed requests are retryable
+    # until the first relayed byte — an upstream that dies pre-first-byte
+    # fails over to the next pool candidate instead of erroring the
+    # client. stream_retry_max bounds the re-establishment hops.
+    stream_retry_enabled: bool = True
+    stream_retry_max: int = 2
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "RESILIENCE_") -> "ResilienceConfig":
@@ -275,6 +281,8 @@ class ResilienceConfig:
             retry_max_backoff=_get_duration(env, prefix + "RETRY_MAX_BACKOFF", "2s"),
             request_budget=_get_duration(env, prefix + "REQUEST_BUDGET", "30s"),
             stream_idle_timeout=_get_duration(env, prefix + "STREAM_IDLE_TIMEOUT", "60s"),
+            stream_retry_enabled=_get_bool(env, prefix + "STREAM_RETRY_ENABLED", True),
+            stream_retry_max=_get_int(env, prefix + "STREAM_RETRY_MAX", 2),
         )
 
 
@@ -327,9 +335,23 @@ class ServingConfig:
     bounded bump in time-to-first-content for far fewer frames under
     fan-out; per-token TPOT metrics are recorded on the scheduler
     thread, before framing, so they are unaffected. 0 keeps the
-    one-frame-per-token wire shape byte-identical."""
+    one-frame-per-token wire shape byte-identical.
+
+    Serving-path fault tolerance (ISSUE 7): ``SERVING_PREEMPT_*`` arms
+    KV-pressure preemption (deschedule-and-resume instead of failing on
+    page exhaustion, bounded per request by the budget);
+    ``SERVING_WATCHDOG_*`` configures the engine hang watchdog whose
+    device-step deadline (multiplier × EWMA step time, floored at the
+    min deadline) trips a supervised in-place engine restart."""
 
     emit_coalesce: float = 0.0
+    preempt_enable: bool = True
+    preempt_budget: int = 3
+    preempt_high_water: float = 0.0
+    watchdog_enable: bool = True
+    watchdog_interval: float = 1.0
+    watchdog_multiplier: float = 20.0
+    watchdog_min_deadline: float = 60.0
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "SERVING_") -> "ServingConfig":
@@ -341,7 +363,16 @@ class ServingConfig:
             coalesce = float(raw) / 1000.0
         except ValueError:
             coalesce = parse_duration(raw)
-        return cls(emit_coalesce=coalesce)
+        return cls(
+            emit_coalesce=coalesce,
+            preempt_enable=_get_bool(env, prefix + "PREEMPT_ENABLE", True),
+            preempt_budget=_get_int(env, prefix + "PREEMPT_BUDGET", 3),
+            preempt_high_water=_get_float(env, prefix + "PREEMPT_HIGH_WATER", 0.0),
+            watchdog_enable=_get_bool(env, prefix + "WATCHDOG_ENABLE", True),
+            watchdog_interval=_get_duration(env, prefix + "WATCHDOG_INTERVAL", "1s"),
+            watchdog_multiplier=_get_float(env, prefix + "WATCHDOG_MULTIPLIER", 20.0),
+            watchdog_min_deadline=_get_duration(env, prefix + "WATCHDOG_MIN_DEADLINE", "60s"),
+        )
 
 
 @dataclass

@@ -239,6 +239,33 @@ class OpenTelemetry:
             "(spec_rejected/chunk_overrun/disconnected/shed_after_prefill)",
             ("gen_ai_request_model", "reason"), unit="{token}",
         )
+        # Serving-path fault tolerance (ISSUE 7): KV-pressure preemption,
+        # engine hang watchdog restarts, pre-first-byte stream recovery,
+        # and the degraded-state gauge the restart window flips so
+        # failover pools (and dashboards) see the sidecar route-around.
+        self.engine_preemption_counter = r.counter(
+            "engine.preemptions",
+            "Requests descheduled under KV pressure (slot+pages released, "
+            "re-enqueued for recompute-style resume), by trigger",
+            ("gen_ai_request_model", "reason"), unit="{preemption}",
+        )
+        self.engine_restart_counter = r.counter(
+            "engine.restarts",
+            "Supervised in-place engine rebuilds after a wedged device step",
+            ("gen_ai_request_model", "reason"), unit="{restart}",
+        )
+        self.streams_recovered_counter = r.counter(
+            "inference_gateway.streams_recovered",
+            "Streamed requests transparently failed over after the upstream "
+            "died before the first relayed byte",
+            ("alias", "from_provider", "to_provider"), unit="{stream}",
+        )
+        self.engine_degraded_gauge = r.gauge(
+            "engine.degraded",
+            "1 while the serving engine is restarting (health reports 503 "
+            "degraded so pools route around the window), else 0",
+            ("gen_ai_request_model",),
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -358,7 +385,8 @@ class OpenTelemetry:
         engine stops being exposed as current state (ISSUE 4 satellite)."""
         labels = {"gen_ai_request_model": model}
         for gauge in (self.engine_slot_occupancy_gauge, self.engine_kv_utilization_gauge,
-                      self.engine_queue_depth_gauge, self.engine_spec_acceptance_gauge):
+                      self.engine_queue_depth_gauge, self.engine_spec_acceptance_gauge,
+                      self.engine_degraded_gauge):
             gauge.remove(labels)
 
     def remove_overload_gauges(self, endpoint_class: str) -> None:
@@ -402,6 +430,24 @@ class OpenTelemetry:
     def record_wasted_tokens(self, model: str, reason: str, tokens: int = 1) -> None:
         self.wasted_tokens_counter.add(
             tokens, {"gen_ai_request_model": model, "reason": reason})
+
+    # -- serving-path fault tolerance (ISSUE 7) --------------------------
+    def record_preemption(self, model: str, reason: str) -> None:
+        self.engine_preemption_counter.add(1, {
+            "gen_ai_request_model": model, "reason": reason})
+
+    def record_engine_restart(self, model: str, reason: str) -> None:
+        self.engine_restart_counter.add(1, {
+            "gen_ai_request_model": model, "reason": reason})
+
+    def record_stream_recovered(self, alias: str, from_provider: str,
+                                to_provider: str) -> None:
+        self.streams_recovered_counter.add(1, {
+            "alias": alias, "from_provider": from_provider,
+            "to_provider": to_provider})
+
+    def set_engine_degraded(self, model: str, value: int) -> None:
+        self.engine_degraded_gauge.set(value, {"gen_ai_request_model": model})
 
     def remove_efficiency_gauges(self, model: str) -> None:
         """Engine teardown: the accounting gauges describe a gone engine
@@ -642,4 +688,16 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def remove_efficiency_gauges(self, *a, **k) -> None:
+        pass
+
+    def record_preemption(self, *a, **k) -> None:
+        pass
+
+    def record_engine_restart(self, *a, **k) -> None:
+        pass
+
+    def record_stream_recovered(self, *a, **k) -> None:
+        pass
+
+    def set_engine_degraded(self, *a, **k) -> None:
         pass

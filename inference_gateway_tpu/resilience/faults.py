@@ -109,15 +109,24 @@ class FaultInjectingClient:
         self.script = script
         self.inner = inner
         self.clock = clock or VirtualClock()
+        self.traceparents: list[tuple[str, str]] = []  # (url, traceparent) per faulted call
 
     async def request(self, method: str, url: str, headers=None, body: bytes = b"",
-                      timeout: float | None = None, stream: bool = False) -> ClientResponse:
+                      timeout: float | None = None, stream: bool = False,
+                      traceparent: str | None = None) -> ClientResponse:
+        # ``traceparent`` mirrors the real HTTPClient's signature (the
+        # provider layer forwards trace context on every call, ISSUE 3);
+        # scripted faults record it so recovery tests can assert one
+        # trace id spans a failover (ISSUE 7).
         fault = self.script.pop(url)
         if fault is None:
             if self.inner is None:
                 raise AssertionError(f"no scripted fault and no inner client for {url}")
             return await self.inner.request(method, url, headers=headers, body=body,
-                                            timeout=timeout, stream=stream)
+                                            timeout=timeout, stream=stream,
+                                            traceparent=traceparent)
+        if traceparent:
+            self.traceparents.append((url, traceparent))
         return await self._play(fault, url, timeout, stream)
 
     async def _play(self, fault: Fault, url: str, timeout: float | None,
@@ -163,10 +172,117 @@ class FaultInjectingClient:
             resp._inproc_chunks = one_shot()
         return resp
 
-    async def get(self, url: str, headers=None, timeout: float | None = None) -> ClientResponse:
-        return await self.request("GET", url, headers=headers, timeout=timeout)
+    async def get(self, url: str, headers=None, timeout: float | None = None,
+                  traceparent: str | None = None) -> ClientResponse:
+        return await self.request("GET", url, headers=headers, timeout=timeout,
+                                  traceparent=traceparent)
 
     async def post(self, url: str, body: bytes, headers=None, timeout: float | None = None,
-                   stream: bool = False) -> ClientResponse:
+                   stream: bool = False, traceparent: str | None = None) -> ClientResponse:
         return await self.request("POST", url, headers=headers, body=body,
-                                  timeout=timeout, stream=stream)
+                                  timeout=timeout, stream=stream, traceparent=traceparent)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level fault injection (ISSUE 7): deterministic serving-path faults
+# ---------------------------------------------------------------------------
+class EngineFaultInjector:
+    """Scripts engine faults at exact dispatch indices (ISSUE 7).
+
+    Installs wrappers onto a live ``Engine``'s dispatch methods IN PLACE
+    (the scheduler keeps using the same Engine object, so chained-carry
+    and allocator bookkeeping are untouched) and plays scripted faults:
+
+    - ``"exhaust"`` — ``OutOfPagesError`` tagged with an active slot
+      (page exhaustion at step N; drives the preemption path),
+    - ``"error"``   — an unattributable ``RuntimeError`` (device error),
+    - ``"hang"``    — the call blocks on an Event until the test (or
+      teardown) calls ``release_hangs()``; ``hanging`` is set while a
+      thread is blocked so tests can wait for the wedge without sleeping
+      (drives the engine-hang watchdog path).
+
+    Ops: ``"prefill"`` (prefill_submit), ``"decode_submit"``,
+    ``"decode_fetch"``. Indices count per-op calls from installation.
+    Unscripted calls pass through; every played fault is logged.
+    """
+
+    def __init__(self, engine) -> None:
+        import threading
+
+        self.engine = engine
+        self._orig = {
+            "prefill": engine.prefill_submit,
+            "decode_submit": engine.decode_chunk_submit,
+            "decode_fetch": engine.decode_chunk_fetch,
+        }
+        engine.prefill_submit = self._wrap("prefill")
+        engine.decode_chunk_submit = self._wrap("decode_submit")
+        engine.decode_chunk_fetch = self._wrap("decode_fetch")
+        self.calls = {op: 0 for op in self._orig}
+        self._scripts: dict[tuple[str, int], tuple[str, int | None]] = {}
+        self.hang_release = threading.Event()
+        self.hanging = threading.Event()
+        self.log: list[tuple[str, int, str]] = []
+
+    def at(self, op: str, call_index: int, kind: str,
+           slot: int | None = None) -> "EngineFaultInjector":
+        assert op in self._orig, f"unknown op {op!r}"
+        assert kind in ("exhaust", "error", "hang"), f"unknown fault {kind!r}"
+        self._scripts[(op, call_index)] = (kind, slot)
+        return self
+
+    def release_hangs(self) -> None:
+        """Wake every thread wedged in a scripted hang. A FRESH event
+        replaces the released one so a later scripted hang wedges again
+        instead of passing through a stale set() (a second hang after a
+        release must not be vacuous)."""
+        import threading
+
+        released = self.hang_release
+        self.hang_release = threading.Event()
+        released.set()
+
+    def uninstall(self) -> None:
+        self.engine.prefill_submit = self._orig["prefill"]
+        self.engine.decode_chunk_submit = self._orig["decode_submit"]
+        self.engine.decode_chunk_fetch = self._orig["decode_fetch"]
+        self.release_hangs()
+
+    # -- internals -------------------------------------------------------
+    def _wrap(self, op: str):
+        def call(*args, **kwargs):
+            i = self.calls[op]
+            self.calls[op] = i + 1
+            fault = self._scripts.pop((op, i), None)
+            if fault is not None:
+                self.log.append((op, i, fault[0]))
+                self._play(op, fault, args)
+            return self._orig[op](*args, **kwargs)
+
+        return call
+
+    def _play(self, op: str, fault: tuple, args: tuple) -> None:
+        kind, slot = fault
+        if kind == "hang":
+            # Wedge exactly like a dead device call: block until
+            # released. Wait on the event captured NOW — release_hangs
+            # swaps in a fresh one for any later scripted hang.
+            release = self.hang_release
+            self.hanging.set()
+            release.wait()
+            self.hanging.clear()
+            return
+        if kind == "error":
+            raise RuntimeError(f"injected device error at {op}")
+        # "exhaust": a recoverable OutOfPagesError attributed to a live
+        # slot, like the allocator raises under real pressure.
+        from inference_gateway_tpu.serving.kv_cache import OutOfPagesError
+
+        e = OutOfPagesError("injected page exhaustion")
+        if slot is None and op == "decode_submit" and len(args) >= 3:
+            import numpy as np
+
+            live = np.flatnonzero(np.asarray(args[2]))  # ``active``
+            slot = int(live[-1]) if live.size else None
+        e.slot = slot
+        raise e

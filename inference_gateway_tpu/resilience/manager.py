@@ -56,6 +56,14 @@ class Resilience:
         # guard — upstream calls fall back to the client's own timeouts.
         self.request_budget = getattr(cfg, "request_budget", 30.0) if self.enabled else 0.0
         self.stream_idle_timeout = getattr(cfg, "stream_idle_timeout", 60.0) if self.enabled else 0.0
+        # Mid-stream recovery (ISSUE 7): a streamed request is safely
+        # retryable until the FIRST byte is relayed downstream — an
+        # upstream that dies pre-first-byte fails over to the next pool
+        # candidate under the same trace id instead of surfacing a
+        # client error. stream_retry_max bounds re-establishments.
+        self.stream_retry_enabled = (getattr(cfg, "stream_retry_enabled", True)
+                                     if self.enabled else False)
+        self.stream_retry_max = getattr(cfg, "stream_retry_max", 2)
         self.retry_policy = RetryPolicy(
             max_attempts=getattr(cfg, "retry_max_attempts", 3) if self.enabled else 1,
             base_backoff=getattr(cfg, "retry_base_backoff", 0.1),
@@ -267,6 +275,109 @@ class Resilience:
         raise UpstreamUnavailableError(
             f"all deployments unavailable (circuit open){' for ' + alias if alias else ''}"
         )
+
+    # -- mid-stream recovery (ISSUE 7) -----------------------------------
+    def _record_stream_recovered(self, alias: str, from_provider: str,
+                                 to_provider: str) -> None:
+        if self.logger is not None:
+            self.logger.info("stream recovered pre-first-byte", "alias", alias,
+                             "from", from_provider, "to", to_provider)
+        if self.otel is not None:
+            self.otel.record_stream_recovered(alias, from_provider, to_provider)
+
+    async def execute_streaming(
+        self,
+        candidates: list[Any],
+        call: Callable[[Any, DeadlineBudget], Awaitable[Any]],
+        *,
+        budget: DeadlineBudget | None = None,
+        alias: str = "",
+        event: dict[str, Any] | None = None,
+    ) -> tuple[AsyncIterator[bytes], Any]:
+        """``execute`` for SSE relays: streamed requests are safely
+        retryable until the first relayed byte.
+
+        Establishment walks the candidate list exactly like
+        ``execute(idempotent=False)``. The returned iterator then keeps
+        that guarantee alive: if the established stream dies BEFORE any
+        byte reaches the client — a connection reset, or an upstream
+        that closes with zero bytes — the failed candidate's breaker is
+        charged and the walk continues with the remaining candidates,
+        re-issuing the same request (same trace context) so the client
+        sees one uninterrupted stream. Once a single byte has been
+        relayed the stream is non-idempotent as before: failures
+        propagate. Returns ``(stream, served)`` where ``served`` is the
+        candidate that established first (recovery hops are recorded via
+        the streams-recovered counter and the wide event).
+        """
+        if budget is None:
+            budget = self.new_budget()
+        stream, served = await self.execute(
+            candidates, call, budget=budget, idempotent=False, alias=alias,
+            event=event)
+        if not self.enabled or not self.stream_retry_enabled:
+            return stream, served
+
+        idx = next((i for i, c in enumerate(candidates) if c is served),
+                   len(candidates) - 1)
+        remaining = list(candidates[idx + 1:])
+
+        async def recovering() -> AsyncIterator[bytes]:
+            current, cand = stream, served
+            relayed = False
+            hops = 0
+            first_provider = served.provider
+            while True:
+                err: Exception | None = None
+                try:
+                    async for chunk in current:
+                        if not relayed:
+                            relayed = True
+                            if hops:
+                                self._record_stream_recovered(
+                                    alias, first_provider, cand.provider)
+                                if event is not None:
+                                    # The wide event is written at
+                                    # request end: correct the serving
+                                    # attribution to the candidate that
+                                    # actually delivered bytes. (The
+                                    # X-Selected-Provider header was
+                                    # already sent and still names the
+                                    # establisher — headers can't be
+                                    # amended mid-stream.)
+                                    event["stream_recovered"] = hops
+                                    event["served_provider"] = cand.provider
+                                    event["served_model"] = cand.model
+                        yield chunk
+                    if relayed:
+                        return
+                except Exception as e:
+                    if relayed:
+                        raise
+                    err = e
+                    if not self._classify(e)[0]:
+                        raise
+                # Dead pre-first-byte: the upstream failed this request
+                # even though establishment "succeeded" — charge its
+                # breaker and move on like any establishment failure.
+                self.breakers.get(cand.provider, cand.model).record_failure()
+                hops += 1
+                if hops > self.stream_retry_max or not remaining:
+                    if err is not None:
+                        raise err
+                    return  # empty stream, nowhere to go: end cleanly
+                if self.logger is not None:
+                    self.logger.warn("stream died pre-first-byte; failing over",
+                                     "alias", alias, "provider", cand.provider,
+                                     "error", repr(err) if err else "closed with no bytes")
+                current, cand = await self.execute(
+                    remaining, call, budget=budget, idempotent=False,
+                    alias=alias, event=event)
+                ridx = next((i for i, c in enumerate(remaining) if c is cand),
+                            len(remaining) - 1)
+                del remaining[:ridx + 1]
+
+        return recovering(), served
 
     # -- stream guarding -------------------------------------------------
     def guard_stream(self, stream: AsyncIterator[bytes],

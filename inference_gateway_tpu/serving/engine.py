@@ -693,14 +693,7 @@ class Engine:
         # sequence-sharded pass, O(T/sp) memory per device — dense AND
         # paged caches), else the serial chunked loop (dense cache).
         # The rest batch normally.
-        biggest = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
-        ring_ok = (
-            self.mesh is not None
-            and self.mesh.shape.get("sp", 1) > 1
-            and not self.is_moe
-            and self.model_cfg.sliding_window is None
-        )
-        long_path = ring_ok or (not self.paged and not self.is_moe)
+        biggest, ring_ok, long_path = self._long_prompt_path()
         # Multimodal rows can't ride the long path: neither the ring nor
         # the chunked prefill carries per-row embedding overrides, and
         # silently prefilling on token IDs alone would return plausible
@@ -935,7 +928,10 @@ class Engine:
                     jnp.asarray([seed if seed is not None else 0], np.int32),
                     jnp.asarray([seed is not None]), self._next_rng(),
                 )
-            self.metrics["prefill_tokens"] += total
+                # Bumped per chunk, not once at the end: the hang
+                # watchdog reads these as a progress signal, and a long
+                # chunked prefill must look alive while it works.
+                self.metrics["prefill_tokens"] += len(piece)
             self.metrics["prefill_batches"] += 1
         return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
 
@@ -1389,6 +1385,40 @@ class Engine:
 
     def context_window(self) -> int:
         return min(self.config.max_seq_len, self.model_cfg.max_position_embeddings)
+
+    def _long_prompt_path(self) -> tuple[int, bool, bool]:
+        """(largest prefill bucket, ring available, any long path
+        available) — the ONE admission gate prefill_submit and the
+        serving edge's fast-fail (max_prompt_len) both consult, so the
+        400 check can never drift from actual admission behavior."""
+        biggest = max(b for b in self.config.prefill_buckets
+                      if b <= self.config.max_seq_len)
+        ring_ok = (
+            self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+            and not self.is_moe
+            and self.model_cfg.sliding_window is None
+        )
+        long_path = ring_ok or (not self.paged and not self.is_moe)
+        return biggest, ring_ok, long_path
+
+    def max_prompt_len(self, multimodal: bool = False) -> int:
+        """Largest admittable prompt in tokens (ISSUE 7 fast-fail).
+
+        Engines with a long-prompt prefill path (ring attention over an
+        sp axis, or the serial chunked loop on a dense non-MoE cache)
+        admit up to the context window; paged/MoE/speculative/multimodal
+        configurations without one are bounded by the largest prefill
+        bucket — the serving edge rejects above it with a structured 400
+        *before* a slot is allocated, instead of letting admission fail
+        the request into a finish_reason "error" stream."""
+        window = self.context_window() - 1
+        biggest, _ring_ok, long_path = self._long_prompt_path()
+        if multimodal:
+            long_path = False  # long paths carry no embedding overrides
+        if self.spec or not long_path:
+            return min(biggest, window)
+        return window
 
     def kv_utilization(self) -> float:
         """KV-cache pressure in [0, 1]: pages in use / total (paged

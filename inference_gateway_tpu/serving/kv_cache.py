@@ -22,7 +22,19 @@ from inference_gateway_tpu.models.llama import LlamaConfig
 
 
 class OutOfPagesError(RuntimeError):
-    pass
+    """KV page pressure. ``recoverable`` distinguishes pool exhaustion
+    (freeing other slots' pages would help — the scheduler may preempt
+    instead of failing, ISSUE 7) from a per-slot structural limit that
+    no amount of preemption can satisfy. ``slot`` is tagged by the
+    engine so failures attribute to one request, not the whole batch."""
+
+    def __init__(self, msg: str = "KV page pool exhausted", *, needed: int = 0,
+                 free: int = 0, recoverable: bool = True) -> None:
+        super().__init__(msg)
+        self.needed = needed
+        self.free = free
+        self.recoverable = recoverable
+        self.slot: int | None = None
 
 
 @dataclass
@@ -84,10 +96,14 @@ class PageAllocator:
         pages = self._slot_pages.setdefault(slot, [])
         needed = (n_tokens + self.cfg.page_size - 1) // self.cfg.page_size
         if needed > self.cfg.max_pages_per_slot:
-            raise OutOfPagesError(f"slot {slot} needs {needed} pages > per-slot max")
+            raise OutOfPagesError(
+                f"slot {slot} needs {needed} pages > per-slot max",
+                needed=needed, free=len(self._free), recoverable=False)
         while len(pages) < needed:
             if not self._free:
-                raise OutOfPagesError("KV page pool exhausted")
+                raise OutOfPagesError(
+                    "KV page pool exhausted",
+                    needed=needed - len(pages), free=0)
             page = self._free.pop()
             self._refs[page] = 1
             self._table[slot, len(pages)] = page

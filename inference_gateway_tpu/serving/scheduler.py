@@ -23,6 +23,7 @@ from typing import Callable
 import numpy as np
 
 from inference_gateway_tpu.serving.engine import Engine
+from inference_gateway_tpu.serving.kv_cache import OutOfPagesError
 
 # Callback payload: (token_id, logprob, finished, finish_reason)
 TokenCallback = Callable[[int, float, bool, str | None], None]
@@ -37,6 +38,13 @@ class SchedulerSaturatedError(RuntimeError):
     def __init__(self, queue_depth: int) -> None:
         super().__init__(f"scheduler queue full ({queue_depth} waiting)")
         self.queue_depth = queue_depth
+
+
+class SchedulerStoppedError(RuntimeError):
+    """Submit against a stopped scheduler (ISSUE 7): during a supervised
+    engine restart the old scheduler's loop is gone — enqueueing there
+    would hang the client forever. The serving edge maps this to a
+    retryable 503 (the replacement scheduler takes over moments later)."""
 
 
 @dataclass
@@ -70,6 +78,14 @@ class GenRequest:
     # queue-wait/TPOT histograms from these — span timestamps are epoch
     # ns, hence time_ns() rather than the monotonic clock.
     phase_ns: dict[str, int] = field(default_factory=dict)
+    # KV-pressure preemption bookkeeping (ISSUE 7): how many times this
+    # request has been descheduled (slot + pages released, re-enqueued
+    # with prompt+generated-so-far for recompute-style resume), bounded
+    # by the scheduler's per-request budget so livelock degrades to a
+    # clean failure; resume_generated carries the emitted-token count
+    # across preemptions so max_tokens spans the whole stream.
+    preempt_count: int = 0
+    resume_generated: int = 0
 
 
 @dataclass
@@ -88,6 +104,14 @@ class _SlotState:
     # token stream (prompt + emitted, incl. the pending token) —
     # proposals are n-gram continuations found in it (ngram_propose).
     history: list | None = None
+    # Preemption support (ISSUE 7): every emitted token, recorded only
+    # while the scheduler's preemption budget is armed — a preempted
+    # request resumes by re-prefilling prompt + out_tokens, so the
+    # serving edge neither drops nor repeats a token.
+    out_tokens: list = field(default_factory=list)
+    # Admission sequence number: larger = younger. Preemption picks the
+    # youngest victim (least sunk prefill/decode cost).
+    seq: int = 0
 
 
 def ngram_propose(history: list, K: int, max_n: int = 3) -> list:
@@ -146,7 +170,8 @@ class _PendingPrefill:
 
 
 class Scheduler:
-    def __init__(self, engine: Engine, logger=None, max_queue_depth: int = 0):
+    def __init__(self, engine: Engine, logger=None, max_queue_depth: int = 0,
+                 preempt_max: int = 0, preempt_high_water: float = 0.0):
         from inference_gateway_tpu.logger import NoopLogger
 
         self.engine = engine
@@ -154,6 +179,23 @@ class Scheduler:
         # Bounded admission (0 = unbounded): submit raises
         # SchedulerSaturatedError past this many waiting requests.
         self.max_queue_depth = max_queue_depth
+        # KV-pressure preemption (ISSUE 7): 0 disables (page exhaustion
+        # fails the request, the pre-preemption behavior). >0 arms it:
+        # on recoverable page exhaustion the youngest running request is
+        # descheduled (slot + pages released, re-enqueued with
+        # prompt+generated-so-far) instead of anyone erroring, at most
+        # preempt_max times per request. preempt_high_water (0 = off,
+        # else a KV-utilization fraction) additionally preempts the
+        # youngest running request at admission time when utilization is
+        # above the mark and requests are waiting — FIFO fairness under
+        # sustained pressure.
+        self.preempt_max = preempt_max
+        self.preempt_high_water = preempt_high_water
+        self.preemptions = 0  # exported metric
+        # Called on the scheduler thread after every preemption with the
+        # trigger reason ("kv_pressure" | "high_water") — the sidecar
+        # wires it to the engine.preemptions otel counter.
+        self.on_preempt: Callable[[str], None] | None = None
         self._waiting: deque[GenRequest] = deque()
         self._slots: dict[int, _SlotState] = {}
         self._free = list(range(engine.config.max_slots))
@@ -183,6 +225,28 @@ class Scheduler:
         # sidecar /health endpoint flags "degraded" when requests are
         # active but no step has completed recently (wedged device).
         self.last_step_time = time.monotonic()
+        # Monotone progress counter for the engine hang watchdog (ISSUE
+        # 7): unlike last_step_time (real monotonic clock) a counter can
+        # be compared on an injected virtual clock, so the watchdog is
+        # zero-sleep testable. step_ewma is a smoothed per-step wall
+        # time (updated in _record_step when an observer is attached)
+        # the watchdog derives its device-step deadline from.
+        self.steps_completed = 0
+        self.step_ewma = 0.0
+        # Admission bookkeeping for preemption: monotone sequence so the
+        # youngest victim is well-defined, and a free-page-count latch
+        # that keeps a pages-starved admission from busy-retrying every
+        # loop pass (it re-arms the moment any release/evict changes the
+        # pool).
+        self._admit_seq = itertools.count()
+        self._page_wait: int | None = None
+        # The batch currently inside engine.prefill_submit: popped from
+        # _waiting but not yet registered in _slots, so a supervised
+        # restart's abort_all would otherwise miss it — exactly where a
+        # wedged prefill leaves its requests (written only on the
+        # scheduler thread; abort_all reads it).
+        self._admitting: list[GenRequest] = []
+        self._aborted = False
         # Optional decode-step timeline (ISSUE 4, otel/profiling.py
         # StepTimeline): every processed prefill/decode/spec step is
         # recorded with its wall time, kind, batch occupancy, tokens
@@ -212,6 +276,8 @@ class Scheduler:
         if len(req.prompt_ids) > limit:
             req.prompt_ids = req.prompt_ids[-limit:]
         with self._wake:
+            if self._stop:
+                raise SchedulerStoppedError("scheduler stopped (engine restarting)")
             if self.max_queue_depth and len(self._waiting) >= self.max_queue_depth:
                 raise SchedulerSaturatedError(len(self._waiting))
             self._waiting.append(req)
@@ -229,6 +295,42 @@ class Scheduler:
             self._wake.notify()
         if self._thread:
             self._thread.join(timeout=10)
+
+    def abort_all(self) -> int:
+        """Fail every queued and in-flight request with finish_reason
+        "error" (retryable at the gateway edge) and stop the loop —
+        the supervised-restart path (ISSUE 7): the scheduler thread may
+        be wedged inside a device call forever, so cleanup cannot be
+        delegated to it. ``_slots`` is only READ here (the wedged thread
+        owns mutation; the replacement scheduler gets a fresh table),
+        and if the old thread ever unwedges it exits on ``_stop`` —
+        late emissions land on callbacks that already saw a terminal
+        event, which every consumer tolerates. Returns the number of
+        requests failed. Idempotent: a second call (the watchdog tripping
+        again after a failed engine rebuild) fails only newly queued
+        requests, never re-firing terminal callbacks for the same
+        slots."""
+        with self._wake:
+            self._stop = True
+            waiting = list(self._waiting)
+            self._waiting.clear()
+            self.queue_depth = 0
+            self._wake.notify_all()
+        failed = 0
+        for req in waiting:
+            self._fail_request(req)
+            failed += 1
+        if not self._aborted:
+            self._aborted = True
+            # A batch wedged INSIDE prefill_submit is in neither _waiting
+            # nor _slots — _admitting is the only record of it.
+            for req in list(self._admitting):
+                self._fail_request(req)
+                failed += 1
+            for st in list(self._slots.values()):
+                self._fail_request(st.req)
+                failed += 1
+        return failed
 
     # -- adaptive speculation (EngineConfig.spec_adaptive) -------------
     def _spec_mode_active(self) -> bool:
@@ -306,7 +408,10 @@ class Scheduler:
                     self._wake.wait(timeout=0.2)
                 if self._stop:
                     break
-                want_admit = bool(self._waiting and self._free)
+            if self.preempt_max and self.preempt_high_water > 0:
+                self._maybe_high_water_preempt()
+            with self._wake:
+                want_admit = bool(self._waiting and self._free) and self._admit_ready()
             if self.engine.spec and self._spec_turn():
                 # Speculative rounds are synchronous (draft + verify per
                 # round, 1..K+1 tokens out); no chunk pipeline.
@@ -421,9 +526,13 @@ class Scheduler:
             # decoded, but the stream ends in "error": all of it was
             # work no client benefits from (ISSUE 6). The generated
             # tokens were emitted — and so counted as delivered — before
-            # the failure; the prompt tokens never were.
+            # the failure; the prompt tokens never were. For a resumed
+            # request (ISSUE 7), prompt_ids already contains the
+            # pre-preemption tokens that generated also counts —
+            # subtract resume_generated so they are not billed twice.
             self._wasted("shed_after_prefill",
-                         len(st.req.prompt_ids) + st.generated,
+                         len(st.req.prompt_ids) + st.generated
+                         - st.req.resume_generated,
                          delivered=st.generated)
         try:
             self._release(slot, reason)
@@ -438,6 +547,12 @@ class Scheduler:
         of a hung stream."""
         slot = getattr(e, "slot", None)
         if slot is not None and slot in self._slots:
+            if (self.preempt_max and isinstance(e, OutOfPagesError)
+                    and getattr(e, "recoverable", True)
+                    and self._preempt_for_pressure(slot)):
+                # Pressure relieved by descheduling the youngest budgeted
+                # request — nobody fails; the next loop pass resubmits.
+                return
             victims = [slot]
             self.logger.warn("decode error attributed to slot", "slot", slot, "err", repr(e))
         else:
@@ -447,6 +562,124 @@ class Scheduler:
         for s in victims:
             self._fail_slot(s)
 
+    # -- KV-pressure preemption (ISSUE 7) ------------------------------
+    def _admit_ready(self) -> bool:
+        """False while a pages-starved admission waits for the pool to
+        change. Re-arms the moment the free-page count moves (any
+        release or eviction), or when no active slot is left to free
+        pages (so a failed release can never park admission forever)."""
+        if self._page_wait is None:
+            return True
+        alloc = self.engine.allocator
+        if alloc is None or not self._slots or alloc.free_page_count() != self._page_wait:
+            self._page_wait = None
+            return True
+        return False
+
+    def _resumable(self, st: _SlotState) -> bool:
+        """Whether the slot's request can re-enter admission after a
+        preemption: prompt + generated-so-far must still fit the
+        engine's admittable-prompt limit (paged mode has no chunked
+        fallback for the re-prefill)."""
+        req = st.req
+        resume_len = len(req.prompt_ids)
+        if st.pending_token != _TOKEN_PENDING:
+            resume_len += len(st.out_tokens)
+        return 0 < resume_len <= self.engine.max_prompt_len(
+            multimodal=req.embeds is not None)
+
+    def _pick_victim(self) -> int | None:
+        """Youngest active slot whose request still has preemption
+        budget and whose resume prompt is admittable; None when nobody
+        qualifies (degrade to today's clean failure)."""
+        best = None
+        for slot, st in self._slots.items():
+            if st.req.preempt_count >= self.preempt_max:
+                continue
+            if not self._resumable(st):
+                continue
+            if best is None or st.seq > self._slots[best].seq:
+                best = slot
+        return best
+
+    def _preempt(self, slot: int, reason: str) -> None:
+        """Deschedule one running request: release its slot and KV pages
+        and re-enqueue it with prompt + generated-so-far as the new
+        prompt (recompute-style resume; PrefixCache makes the re-prefill
+        cheap when enabled). Emitted tokens are never re-emitted — the
+        resumed prefill's first sampled token is the next NEW token, so
+        the serving edge sees one uninterrupted stream. In-flight chunks
+        still carrying this slot are excluded by the state-identity
+        check in _process_chunk/_process_prefill."""
+        st = self._slots.pop(slot)
+        req = st.req
+        req.preempt_count += 1
+        if st.pending_token != _TOKEN_PENDING and st.out_tokens:
+            req.prompt_ids = list(req.prompt_ids) + st.out_tokens
+            req.resume_generated += len(st.out_tokens)
+        self.preemptions += 1
+        self._release_guarded(slot, "preempted")
+        with self._wake:
+            if reason == "high_water":
+                # High-water preemption makes room for the waiting head:
+                # the victim goes to the back, behind it.
+                self._waiting.append(req)
+            else:
+                # Pressure preemption resumes as soon as pages free up —
+                # the client already holds a live, half-served stream.
+                self._waiting.appendleft(req)
+            self.queue_depth = len(self._waiting)
+            self._wake.notify()
+        self.logger.warn("preempted request under KV pressure",
+                         "request", req.request_id, "reason", reason,
+                         "resume_prompt", len(req.prompt_ids),
+                         "preempt_count", req.preempt_count)
+        if self.on_preempt is not None:
+            try:
+                self.on_preempt(reason)
+            except Exception:
+                pass
+
+    def _maybe_high_water_preempt(self) -> None:
+        """Admission high-water mark (ISSUE 7): sustained KV pressure
+        must not starve the waiting head forever — when utilization is
+        above the mark with requests waiting, the youngest running
+        request yields its slot and pages (and rejoins the queue BEHIND
+        the head). Runs every loop pass, independent of free slots: the
+        preemption is what frees one."""
+        if (not self._waiting or not self._slots
+                or self.engine.kv_utilization() < self.preempt_high_water):
+            return
+        victim = self._pick_victim()
+        if victim is not None:
+            self._preempt(victim, "high_water")
+
+    def _preempt_for_pressure(self, starved: int) -> bool:
+        """Decode-time page exhaustion attributed to ``starved``: preempt
+        the youngest budgeted request instead of failing anyone. The
+        starved slot (often the youngest itself) either gets descheduled
+        for a clean resume or keeps running against the freed pages."""
+        victim = self._pick_victim()
+        if victim is None:
+            return False
+        self._preempt(victim, "kv_pressure")
+        return True
+
+    def _requeue_admission(self, batch: list, slots: list) -> None:
+        """Page-starved admission: return the batch's slots and partial
+        page allocations and put the requests back at the head of the
+        queue (order preserved) instead of failing them. Admission then
+        parks until the page pool changes (_admit_ready)."""
+        for _req, slot in zip(batch, slots):
+            self._release_guarded(slot, "requeue")
+        with self._wake:
+            for req in reversed(batch):
+                self._waiting.appendleft(req)
+            self.queue_depth = len(self._waiting)
+        alloc = self.engine.allocator
+        self._page_wait = alloc.free_page_count() if alloc is not None else None
+
+    # ------------------------------------------------------------------
     def _admit(self) -> None:
         """Move waiting requests into free slots and prefill them.
 
@@ -474,6 +707,7 @@ class Scheduler:
             req.phase_ns.setdefault("admit", admit_ns)
         embeds = [r.embeds for r in batch]
         seeds = [r.seed for r in batch]
+        self._admitting = batch  # visible to abort_all if prefill wedges
         try:
             handle = self.engine.prefill_submit(
                 [r.prompt_ids for r in batch], slots,
@@ -481,7 +715,15 @@ class Scheduler:
                 embeds=embeds if any(e is not None for e in embeds) else None,
                 seeds=seeds if any(s is not None for s in seeds) else None,
             )
-        except Exception:
+        except Exception as e:
+            self._admitting = []
+            if (self.preempt_max and isinstance(e, OutOfPagesError)
+                    and getattr(e, "recoverable", True) and self._slots):
+                # Admission-time page exhaustion with running requests
+                # that will free pages: requeue instead of failing
+                # (ISSUE 7) — the batch resumes once the pool changes.
+                self._requeue_admission(batch, slots)
+                return
             # Fail the whole admission batch (finish_reason "error"),
             # return its slots/pages, keep the scheduler alive.
             for req, slot in zip(batch, slots):
@@ -491,7 +733,13 @@ class Scheduler:
         for req, slot in zip(batch, slots):
             self._slots[slot] = _SlotState(
                 req, pos=len(req.prompt_ids), pending_token=_TOKEN_PENDING,
-                pending_logprob=0.0, draft_len=len(req.prompt_ids))
+                pending_logprob=0.0, draft_len=len(req.prompt_ids),
+                generated=req.resume_generated + 1, seq=next(self._admit_seq))
+        # Cleared only AFTER the slots are registered: a concurrent
+        # abort_all in the gap must find the batch in _admitting OR
+        # _slots (a double terminal callback is tolerated; a missed one
+        # hangs the client — code-review round 2).
+        self._admitting = []
         if self.engine.spec and self._spec_mode_active():
             # Spec rounds need first tokens host-side immediately.
             self._process_prefill(_PendingPrefill(handle, list(zip(batch, slots))))
@@ -515,9 +763,14 @@ class Scheduler:
                     self._release_guarded(slot, "error")
             return
         self.last_step_time = time.monotonic()
+        self.steps_completed += 1
         for (req, slot), res in zip(p.items, results):
             st = self._slots.get(slot)
-            if st is None:  # failed/released while in flight
+            if st is None or st.req is not req:
+                # Failed/released/preempted while in flight — and the
+                # slot may already belong to a NEW request (identity
+                # check, same contract as _Inflight snapshots): these
+                # first tokens describe a stream that no longer runs.
                 continue
             st.pending_token = res.first_token
             st.pending_logprob = res.logprob
@@ -554,9 +807,11 @@ class Scheduler:
         # otherwise wait out this whole chunk before prefill; skip the
         # submit so the next loop iteration admits first (the
         # pre-pipelining code bounded admission latency the same way by
-        # shrinking the chunk to one step).
+        # shrinking the chunk to one step). A page-blocked admission
+        # (_admit_ready False) must NOT defer the chunk — decode progress
+        # is what frees the pages it is waiting for.
         with self._wake:
-            if self._waiting and self._free:
+            if self._waiting and self._free and self._admit_ready():
                 return None
         S = self.engine.config.max_slots
         chunk_handles = [h for h in self._handles if isinstance(h, _Inflight)]
@@ -630,6 +885,7 @@ class Scheduler:
             catchup, catchup_len, catchup_pos, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
         self.last_step_time = time.monotonic()
+        self.steps_completed += 1
         self.spec_rounds += 1
         self.spec_slot_rounds += len(self._slots)
         batch = len(self._slots)
@@ -708,6 +964,7 @@ class Scheduler:
             pending, positions, draft, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
         self.last_step_time = time.monotonic()
+        self.steps_completed += 1
         self.spec_rounds += 1
         self.spec_slot_rounds += len(self._slots)
         batch = len(self._slots)
@@ -766,6 +1023,13 @@ class Scheduler:
         observers are detached — serving continues, observability
         reports its own death exactly once."""
         duration = time.perf_counter() - t0
+        if n_steps > 0:
+            # Smoothed per-engine-step wall time: the hang watchdog's
+            # deadline base (ISSUE 7). EWMA over per-step cost so a
+            # fused chunk and a single prefill weigh comparably.
+            per_step = duration / n_steps
+            self.step_ewma = per_step if self.step_ewma <= 0 else (
+                0.8 * self.step_ewma + 0.2 * per_step)
         try:
             cost = None
             if self.accounting is not None:
@@ -826,6 +1090,7 @@ class Scheduler:
             self._fail_after_decode_error(e)
             return
         self.last_step_time = time.monotonic()
+        self.steps_completed += inf.n_steps
 
         ctx = sum(s.pos for s in inf.states.values()) if observing else 0
         emitted = 0
@@ -891,14 +1156,29 @@ class Scheduler:
         reason = None
         if finished:
             reason = "stop" if is_stop else "length"
-            req.phase_ns["finish"] = time.time_ns()  # decode ends
+        if req.disconnected and not finished:
+            # Early termination (ISSUE 7): the client abandoned the
+            # stream — finish at this decode step and free the slot/KV
+            # pages instead of decoding to max_tokens. Tokens already
+            # decoded keep their ISSUE 6 wasted-work attribution below.
+            finished = True
+            reason = "disconnected"
+        if finished:
+            req.phase_ns.setdefault("finish", time.time_ns())  # decode ends
+        if self.preempt_max:
+            # Preemption resume material: a descheduled request re-enters
+            # admission with prompt + out_tokens as its new prompt.
+            st.out_tokens.append(token)
         try:
             req.callback(token, logprob, finished, reason)
         except Exception:
-            pass  # a dead client must not kill the batch
+            # A dead client must not kill the batch — and a callback
+            # that raises IS a dead client: mark the stream disconnected
+            # so the next emission terminates it instead of silently
+            # decoding to max_tokens forever (ISSUE 7 satellite).
+            req.disconnected = True
         if req.disconnected:
-            # The serving edge marked the stream abandoned: the engine
-            # still decodes to the finish condition, but nobody reads
+            # The serving edge marked the stream abandoned; nobody reads
             # these tokens (ISSUE 6 wasted-work attribution). Each one
             # was just counted as delivered — flag it so goodput
             # subtracts it again.

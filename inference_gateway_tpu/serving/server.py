@@ -45,7 +45,12 @@ from inference_gateway_tpu.otel.profiling import (
 from inference_gateway_tpu.otel.tracing import Tracer, parse_traceparent
 from inference_gateway_tpu.resilience.overload import ServiceTimeEstimator
 from inference_gateway_tpu.serving.engine import Engine, EngineConfig
-from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler, SchedulerSaturatedError
+from inference_gateway_tpu.serving.scheduler import (
+    GenRequest,
+    Scheduler,
+    SchedulerSaturatedError,
+    SchedulerStoppedError,
+)
 from inference_gateway_tpu.serving.tokenizer import DetokenizeState
 
 # OTLP push bucket boundaries (delta histograms; the gateway ingest
@@ -76,9 +81,24 @@ class SidecarServer:
                  accounting: PerfAccounting | None = None,
                  accounting_enable: bool = True,
                  accounting_window: float = 10.0,
-                 accounting_chip: str | None = None):
+                 accounting_chip: str | None = None,
+                 preempt_max: int = 3, preempt_high_water: float = 0.0,
+                 engine_watchdog=None, engine_factory=None):
         self.engine = engine
         self.logger = logger or new_logger()
+        # Serving-path fault tolerance (ISSUE 7): "ok" | "degraded" —
+        # degraded flips /health to 503 while a supervised engine
+        # restart is in flight, so PR 1 failover pools route around the
+        # window. engine_factory rebuilds the Engine in place (default:
+        # same config, fresh weights/caches); engine_watchdog (an
+        # EngineWatchdog) trips the restart on a wedged device step.
+        self.state = "ok"
+        self.restarts = 0
+        self.last_restart: dict[str, Any] | None = None
+        self.engine_factory = engine_factory
+        self.engine_watchdog = engine_watchdog
+        self.preempt_max = preempt_max
+        self.preempt_high_water = preempt_high_water
         # Observability wiring (ISSUE 3): a tracer for the sidecar's
         # queue.wait/prefill/decode child spans (disabled by default —
         # spans are built only when enabled), an optional co-hosted
@@ -93,8 +113,14 @@ class SidecarServer:
         # without it a recurring _admit/_release bug would be invisible
         # in the deployed sidecar (round-3 review finding).
         self.scheduler = scheduler or Scheduler(engine, logger=self.logger,
-                                                max_queue_depth=max_queue_depth)
+                                                max_queue_depth=max_queue_depth,
+                                                preempt_max=preempt_max,
+                                                preempt_high_water=preempt_high_water)
         self._own_scheduler = scheduler is None
+        if self.scheduler.on_preempt is None:
+            self.scheduler.on_preempt = self._on_preempt
+        if self.engine_watchdog is not None:
+            self.engine_watchdog.bind(self)
         # Observed per-request service time → Retry-After hints when the
         # scheduler queue saturates (ISSUE 2; same estimator as the
         # gateway's admission ledger so the policy can't drift).
@@ -180,6 +206,13 @@ class SidecarServer:
             self.scheduler.start()
         if self.watchdog is not None:
             self.watchdog.start()
+        if self.engine_watchdog is not None:
+            self.engine_watchdog.start()
+        if self.otel is not None:
+            # The degraded gauge must exist from boot: an absent series
+            # is indistinguishable from a non-reporting replica, and
+            # alerts key on 0 → 1 (code-review finding).
+            self.otel.set_engine_degraded(self.model_name, 0)
         bound = await self.http.start(host, port)
         if self.metrics_push_url or (self.tracer.enabled and self.tracer.otlp_endpoint):
             self._push_task = asyncio.create_task(self._metrics_push_loop())
@@ -190,6 +223,8 @@ class SidecarServer:
             self._push_task.cancel()
         if self.watchdog is not None:
             await self.watchdog.stop()
+        if self.engine_watchdog is not None:
+            await self.engine_watchdog.stop()
         await self.http.shutdown()
         if self._own_scheduler:
             self.scheduler.stop()
@@ -208,6 +243,91 @@ class SidecarServer:
         OverloadController.add_depth_probe (ISSUE 2 priority shedding:
         gateway sheds batch work when the engine queue backs up)."""
         return self.scheduler.queue_depth
+
+    # -- serving-path fault tolerance (ISSUE 7) ------------------------
+    def _on_preempt(self, reason: str) -> None:
+        """Scheduler-thread hook: KV-pressure preemption telemetry."""
+        if self.otel is not None:
+            self.otel.record_preemption(self.model_name, reason)
+
+    def _default_engine_factory(self) -> Engine:
+        """Rebuild the Engine from its own config — checkpointed engines
+        reload from disk, preset engines re-init (same seed → same
+        weights), and the fresh instance owns fresh device buffers and a
+        fresh page allocator, leaving the wedged one behind."""
+        return Engine(self.engine.config)
+
+    async def restart_engine(self, reason: str,
+                             forensics: dict[str, Any] | None = None) -> dict[str, Any]:
+        """Supervised in-place engine restart (ISSUE 7 tentpole b).
+
+        Health flips degraded (503) for the whole window so failover
+        pools route around it. Every queued and in-flight request fails
+        with a retryable error (the wedged scheduler thread cannot be
+        killed — it is abandoned with its stop flag set). The Engine is
+        rebuilt on an executor thread, a fresh Scheduler takes over, and
+        health flips back to ready. The process never restarts."""
+        self.state = "degraded"
+        if self.otel is not None:
+            self.otel.set_engine_degraded(self.model_name, 1)
+        old_sched = self.scheduler
+        info: dict[str, Any] = {"reason": reason, "at": time.time(),
+                                "forensics": forensics or {}}
+        info["failed_requests"] = old_sched.abort_all()
+        self.logger.error("engine wedged; supervised in-place restart", None,
+                          "reason", reason,
+                          "failed_requests", info["failed_requests"])
+        factory = self.engine_factory or self._default_engine_factory
+
+        def _build() -> Engine:
+            eng = factory()
+            # Warm before the swap (same contract as serve() at boot):
+            # the replacement must not meet its first request cold — a
+            # post-restart compile longer than the watchdog deadline
+            # would read as another wedge and crash-loop the restart
+            # (observed live before this warmup).
+            eng.warmup()
+            return eng
+
+        loop = asyncio.get_running_loop()
+        try:
+            new_engine = await loop.run_in_executor(None, _build)
+        except Exception as e:
+            # The rebuild itself failed (dead driver/tunnel): stay
+            # degraded — health keeps reporting 503 so pools keep
+            # routing around — and surface the failed attempt. The
+            # watchdog re-trips after another deadline period (natural
+            # backoff) and abort_all is idempotent, so the retry costs
+            # no duplicate client callbacks.
+            info["failed"] = repr(e)
+            self.last_restart = info
+            self.logger.error("engine rebuild failed; replica stays degraded", e,
+                              "reason", reason)
+            raise
+        sched = Scheduler(new_engine, logger=self.logger,
+                          max_queue_depth=old_sched.max_queue_depth,
+                          preempt_max=old_sched.preempt_max,
+                          preempt_high_water=old_sched.preempt_high_water)
+        sched.timeline = self.timeline
+        sched.accounting = self.accounting
+        sched.on_preempt = self._on_preempt
+        # Counter continuity: /metrics "preemptions" is cumulative for
+        # the PROCESS — a scheduler swap must not make it go backwards
+        # (engine_restarts is the signal that a swap happened).
+        sched.preemptions = old_sched.preemptions
+        sched.start()
+        self.engine = new_engine
+        self.scheduler = sched
+        self._own_scheduler = True
+        self.restarts += 1
+        self.last_restart = info
+        self.state = "ok"
+        if self.otel is not None:
+            self.otel.set_engine_degraded(self.model_name, 0)
+            self.otel.record_engine_restart(self.model_name, reason)
+        self.logger.info("engine restart complete", "reason", reason,
+                         "restarts", self.restarts)
+        return info
 
     # -- OTLP metrics push ---------------------------------------------
     def record_ttft(self, seconds: float) -> None:
@@ -344,7 +464,15 @@ class SidecarServer:
         """Liveness + device-stall detection: active requests with no
         completed engine step for HEALTH_STALL_SECONDS means the
         accelerator (or its tunnel) is wedged — report degraded with 503
-        so orchestrators can recycle the replica."""
+        so orchestrators can recycle the replica. During a supervised
+        engine restart (ISSUE 7) the same 503 "degraded" flows, so
+        failover pools route around the window without external help."""
+        if self.state == "degraded":
+            return Response.json({
+                "status": "degraded",
+                "reason": "supervised engine restart in progress",
+                "restarts": self.restarts,
+            }, status=503)
         stalled = (
             self.scheduler.active_requests() > 0
             and time.monotonic() - self.scheduler.last_step_time > self.HEALTH_STALL_SECONDS
@@ -391,6 +519,8 @@ class SidecarServer:
                 m["spec_tokens_per_slot_round"] = round(
                     self.scheduler.spec_emitted / self.scheduler.spec_slot_rounds, 3)
         m["uptime_seconds"] = round(time.monotonic() - self._started, 3)
+        m["preemptions"] = self.scheduler.preemptions
+        m["engine_restarts"] = self.restarts
         gauges = self.sample_engine_gauges()  # refresh on every scrape
         m["slot_occupancy"] = round(gauges["slot_occupancy"], 4)
         m["kv_page_utilization"] = round(gauges["kv_page_utilization"], 4)
@@ -480,7 +610,14 @@ class SidecarServer:
             "uptime_seconds": round(time.monotonic() - self._started, 3),
             "active_requests": self.scheduler.active_requests(),
             "queue_depth": self.scheduler.queue_depth,
+            "state": self.state,
+            "preemptions": self.scheduler.preemptions,
+            "engine_restarts": self.restarts,
         }
+        if self.last_restart is not None:
+            status["last_restart"] = self.last_restart
+        if self.engine_watchdog is not None:
+            status["engine_watchdog"] = self.engine_watchdog.stats()
         if self.timeline is not None:
             status["timeline"] = self.timeline.stats()
         if self.accounting is not None:
@@ -586,6 +723,24 @@ class SidecarServer:
         gen, meta = self._prepare(body)
         if len(gen.prompt_ids) >= self.engine.context_window():
             return Response.json({"error": "prompt exceeds context window"}, status=400)
+        # Oversized-prompt fast-fail (ISSUE 7 satellite): in modes with
+        # no long-prompt prefill path (paged/MoE/spec/multimodal), a
+        # prompt above the largest prefill bucket can only ever fail at
+        # admission — reject it with a structured 400 BEFORE a slot or
+        # any KV pages are allocated, instead of streaming a
+        # finish_reason "error".
+        limit = self.engine.max_prompt_len(multimodal=gen.embeds is not None)
+        if len(gen.prompt_ids) > limit:
+            return Response.json({"error": {
+                "message": (f"prompt of {len(gen.prompt_ids)} tokens exceeds the "
+                            f"largest admittable prompt ({limit} tokens) for this "
+                            "engine configuration"),
+                "type": "invalid_request_error",
+                "param": "messages",
+                "code": "prompt_too_long",
+                "prompt_tokens": len(gen.prompt_ids),
+                "max_prompt_tokens": limit,
+            }}, status=400)
         stream = bool(body.get("stream"))
         include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
 
@@ -627,9 +782,21 @@ class SidecarServer:
 
         # Bounded admission: a full scheduler queue sheds with 429 +
         # Retry-After derived from observed service time and backlog —
-        # BEFORE any SSE headers go out (ISSUE 2).
+        # BEFORE any SSE headers go out (ISSUE 2). A stopped scheduler
+        # (supervised engine restart in flight, ISSUE 7) is a retryable
+        # 503 — submitting there would hang the client forever.
         try:
+            if self.state == "degraded":
+                raise SchedulerStoppedError("engine restart in progress")
             self.scheduler.submit(gen)
+        except SchedulerStoppedError:
+            resp = Response.json({"error": {
+                "message": "engine restart in progress; retry",
+                "type": "server_error",
+                "code": "engine_restarting",
+            }}, status=503)
+            resp.headers.set("Retry-After", "1")
+            return resp
         except SchedulerSaturatedError:
             resp = Response.json(
                 {"error": "Engine is saturated. Please retry later."}, status=429)
@@ -661,6 +828,19 @@ class SidecarServer:
         self._observe_service(time.monotonic() - arrival)
         self._finalize_request(gen, meta, traceparent, completion_tokens, stream=False,
                                finish_reason=reason)
+        if reason == "error":
+            # Engine-side failure (device error, restart, admission
+            # fault) on a request that streamed nothing to the client:
+            # surface it as a RETRYABLE 503 + Retry-After (ISSUE 7), not
+            # a well-formed completion with finish_reason "error" — the
+            # gateway's resilience layer retries/fails over 503s.
+            resp = Response.json({"error": {
+                "message": "generation failed on the serving engine; retry",
+                "type": "server_error",
+                "code": "engine_failure",
+            }}, status=503)
+            resp.headers.set("Retry-After", str(self._retry_after_hint()))
+            return resp
         text, reason = self._apply_stop_strings(detok.emitted, meta["stop_strings"], reason)
         choice: dict[str, Any] = {
             "index": 0,
@@ -965,6 +1145,20 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
         ttft_s=tcfg.slow_request_ttft, tpot_s=tcfg.slow_request_tpot,
         total_s=tcfg.slow_request_total, size=tcfg.slow_request_log_size,
         source="tpu-sidecar")
+    engine_watchdog = None
+    if svcfg.watchdog_enable:
+        from inference_gateway_tpu.serving.watchdog import EngineWatchdog
+
+        engine_watchdog = EngineWatchdog(
+            interval=svcfg.watchdog_interval,
+            multiplier=svcfg.watchdog_multiplier,
+            min_deadline=svcfg.watchdog_min_deadline, logger=logger)
+    # KV-pressure preemption only means anything with a page pool to
+    # exhaust: a dense (non-paged) engine can never raise
+    # OutOfPagesError in production, so don't pay the per-token resume
+    # bookkeeping there (code-review finding).
+    preempt_budget = (svcfg.preempt_budget
+                      if svcfg.preempt_enable and engine.allocator is not None else 0)
     server = SidecarServer(engine, served_model_name=served_model_name, logger=logger,
                            metrics_push_url=metrics_push_url, tracer=tracer,
                            access_log=access_log,
@@ -974,7 +1168,10 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                            stream_coalesce=scfg.stream_coalesce,
                            accounting_enable=tcfg.accounting_enable,
                            accounting_window=tcfg.accounting_window,
-                           accounting_chip=tcfg.accounting_chip or None)
+                           accounting_chip=tcfg.accounting_chip or None,
+                           preempt_max=preempt_budget,
+                           preempt_high_water=svcfg.preempt_high_water,
+                           engine_watchdog=engine_watchdog)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:

@@ -241,6 +241,105 @@ def hammer_registry(registry, writer_threads: int = 8, reader_threads: int = 2,
     return errors
 
 
+def hammer_scheduler_preempt(scheduler, submit_threads: int = 3,
+                             per_thread: int = 8, timeout: float = 120.0) -> list[str]:
+    """Concurrency hammer for the preemption/cancel seam (ISSUE 7).
+
+    N submitter threads pour paged-mode requests into a pool sized so
+    organic KV-pressure preemption fires, while a canceller thread flips
+    ``disconnected`` on live requests (early-terminate) mid-decode.
+    Invariants: every request reaches EXACTLY ONE terminal callback with
+    a known reason, no request exceeds the preemption budget, and the
+    slot pool is fully restored after the drain. Run under instrument()
+    so every preemption-path mutation is also discipline-checked.
+    """
+    import queue
+    import time
+
+    from inference_gateway_tpu.serving.scheduler import GenRequest
+
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+    terminal: dict[str, list] = {}
+    done: "queue.Queue[str]" = queue.Queue()
+    live: list = []
+    stop_cancel = threading.Event()
+    total = submit_threads * per_thread
+    barrier = threading.Barrier(submit_threads + 1)
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    def submitter(tid: int) -> None:
+        barrier.wait()
+        for i in range(per_thread):
+            rid = f"h{tid}-{i}"
+            terminal[rid] = []
+
+            def cb(tok, lp, fin, reason, rid=rid):
+                if fin:
+                    terminal[rid].append(reason)
+                    done.put(rid)
+
+            req = GenRequest(prompt_ids=[1 + (tid + i) % 7] * (18 + 5 * (i % 4)),
+                             max_tokens=6 + 4 * (i % 3), callback=cb,
+                             request_id=rid)
+            live.append(req)
+            try:
+                scheduler.submit(req)
+            except Exception as e:
+                fail(f"submit: {e!r}")
+                done.put(rid)
+                terminal[rid].append("submit-error")
+
+    def canceller() -> None:
+        barrier.wait()
+        n = 0
+        while not stop_cancel.is_set():
+            snapshot = list(live)
+            if snapshot:
+                snapshot[n % len(snapshot)].disconnected = True
+                n += 1
+            time.sleep(0.003)
+
+    threads = [threading.Thread(target=submitter, args=(t,), name=f"preempt-s{t}",
+                                daemon=True) for t in range(submit_threads)]
+    threads.append(threading.Thread(target=canceller, name="preempt-cancel", daemon=True))
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout
+    seen = 0
+    while seen < total and time.monotonic() < deadline:
+        try:
+            done.get(timeout=max(deadline - time.monotonic(), 0.1))
+            seen += 1
+        except queue.Empty:
+            break
+    stop_cancel.set()
+    if seen < total:
+        fail(f"only {seen}/{total} requests reached a terminal callback")
+    for rid, reasons in terminal.items():
+        if len(reasons) != 1:
+            fail(f"{rid}: {len(reasons)} terminal callbacks ({reasons})")
+        elif reasons[0] not in ("stop", "length", "error", "disconnected"):
+            fail(f"{rid}: unexpected terminal reason {reasons[0]!r}")
+    for req in live:
+        if req.preempt_count > scheduler.preempt_max:
+            fail(f"{req.request_id}: preempt_count {req.preempt_count} "
+                 f"exceeds budget {scheduler.preempt_max}")
+    # Drain: every slot back in the pool, every page free.
+    deadline = time.monotonic() + 15
+    while scheduler.active_requests() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    if sorted(scheduler._free) != list(range(scheduler.engine.config.max_slots)):
+        fail(f"slot pool not restored: {sorted(scheduler._free)}")
+    alloc = scheduler.engine.allocator
+    if alloc is not None and alloc.free_page_count() != alloc.num_pages:
+        fail(f"page pool leaked: {alloc.free_page_count()}/{alloc.num_pages} free")
+    return errors
+
+
 def hammer_profiler(lifecycle_threads: int = 3, reader_threads: int = 3,
                     iters: int = 25) -> list[str]:
     """Concurrency hammer for the sampling profiler (ISSUE 4 satellite).

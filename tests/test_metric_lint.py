@@ -114,6 +114,43 @@ def test_noop_recorders_record_nothing():
     assert noop.wasted_tokens_counter.values() == {}
 
 
+def test_fault_tolerance_instruments_registered_with_expected_shapes():
+    """ISSUE 7: the serving-path fault-tolerance surface must expose
+    exactly the advertised names — the acceptance criteria and
+    dashboards key on them."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    preempt = by_name["engine.preemptions"]
+    assert isinstance(preempt, Counter)
+    assert preempt.label_names == ("gen_ai_request_model", "reason")
+    assert preempt.unit == "{preemption}"
+    restarts = by_name["engine.restarts"]
+    assert isinstance(restarts, Counter)
+    assert restarts.label_names == ("gen_ai_request_model", "reason")
+    assert restarts.unit == "{restart}"
+    recovered = by_name["inference_gateway.streams_recovered"]
+    assert isinstance(recovered, Counter)
+    assert recovered.label_names == ("alias", "from_provider", "to_provider")
+    assert recovered.unit == "{stream}"
+    degraded = by_name["engine.degraded"]
+    assert isinstance(degraded, Gauge)
+    assert degraded.label_names == ("gen_ai_request_model",)
+
+
+def test_noop_fault_tolerance_recorders_record_nothing():
+    """NoopTelemetry drift guard for the ISSUE 7 recorders (the generic
+    override scan catches missing methods; this pins the behavior)."""
+    noop = NoopTelemetry()
+    noop.record_preemption("m", "kv_pressure")
+    noop.record_engine_restart("m", "step_deadline_exceeded")
+    noop.record_stream_recovered("alias", "a", "b")
+    noop.set_engine_degraded("m", 1)
+    assert noop.engine_preemption_counter.values() == {}
+    assert noop.engine_restart_counter.values() == {}
+    assert noop.streams_recovered_counter.values() == {}
+    assert noop.engine_degraded_gauge.values() == {}
+
+
 def test_efficiency_instruments_registered_with_expected_shapes():
     """ISSUE 6: the compute-efficiency surface must expose exactly the
     advertised names — dashboards and the BENCH trajectory key on them."""

@@ -392,6 +392,10 @@ class _FakeEngine:
     def context_window(self):
         return 64
 
+    def max_prompt_len(self, multimodal=False):
+        # Engine interface grew with the ISSUE 7 fast-fail check.
+        return self.context_window() - 1
+
 
 def test_scheduler_bounded_queue_raises_when_full():
     from inference_gateway_tpu.serving.scheduler import (

@@ -23,6 +23,7 @@ from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
 from tests.race_harness import (
     DisciplineViolation,
     hammer_registry,
+    hammer_scheduler_preempt,
     instrument,
     start_instrumented,
 )
@@ -79,6 +80,28 @@ def test_concurrent_serving_upholds_lock_discipline():
     finally:
         stop_readers.set()
         s.stop()
+    assert rec.violations == [], rec.violations
+
+
+def test_concurrent_preempt_cancel_upholds_discipline_and_terminal_contract():
+    """ISSUE 7: concurrent submit / organic KV-pressure preemption /
+    mid-stream cancel under full instrumentation — the preemption paths
+    (slot pop, requeue appendleft, page release, free-list return) must
+    respect the same locks, every request gets exactly one terminal
+    callback, and no slot or page leaks."""
+    eng = Engine(EngineConfig(
+        model="test-tiny", max_slots=3, max_seq_len=96, dtype="float32",
+        max_prefill_batch=2, use_mesh=False, attention="paged",
+        page_size=16, num_pages=9, prefix_cache=False, decode_chunk=2,
+        prefill_buckets=(16, 32, 64)))
+    s = Scheduler(eng, preempt_max=3)
+    rec = instrument(s)
+    start_instrumented(s)
+    try:
+        errors = hammer_scheduler_preempt(s)
+    finally:
+        s.stop()
+    assert errors == [], errors
     assert rec.violations == [], rec.violations
 
 

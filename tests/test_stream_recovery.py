@@ -1,0 +1,149 @@
+"""Gateway mid-stream recovery (ISSUE 7 tentpole c).
+
+``Resilience.execute_streaming``: a streamed request is safely retryable
+until the first relayed byte — an upstream (e.g. the TPU sidecar) that
+dies pre-first-token fails over to another pool candidate under the SAME
+trace id, and the client sees one uninterrupted SSE stream. After the
+first byte the old non-idempotent contract holds. All timing on a
+VirtualClock — zero real sleeps.
+"""
+
+import json
+import random
+
+from inference_gateway_tpu.config import Config
+from inference_gateway_tpu.netio.server import Headers, Request
+from inference_gateway_tpu.otel.otel import OpenTelemetry
+from inference_gateway_tpu.providers.registry import ProviderRegistry
+from inference_gateway_tpu.providers.routing import Deployment, Pool, Selector
+from inference_gateway_tpu.resilience import Resilience, VirtualClock
+from inference_gateway_tpu.resilience.breaker import OPEN
+from inference_gateway_tpu.resilience.faults import Fault, FaultInjectingClient, FaultScript
+
+TRACEPARENT = "00-1234567890abcdef1234567890abcdef-1234567890abcdef-01"
+
+
+def _make_router(script, env=None, otel=None):
+    from inference_gateway_tpu.api.routes import RouterImpl
+
+    clk = VirtualClock()
+    cfg = Config.load(env or {})
+    registry = ProviderRegistry({pid: cfg.providers[pid] for pid in ("ollama", "tpu")})
+    res = Resilience(cfg.resilience, otel=otel, clock=clk, rng=random.Random(0))
+    pools = {"fast-model": Pool("fast-model",
+                                [Deployment("ollama", "model-a"),
+                                 Deployment("tpu", "model-b")])}
+    selector = Selector(pools, health=res.healthy)
+    client = FaultInjectingClient(script, clock=clk)
+    router = RouterImpl(cfg, registry, client, otel=otel, selector=selector,
+                        resilience=res)
+    return router, res, client
+
+
+def _post_chat_stream(model: str) -> Request:
+    body = {"model": model, "stream": True,
+            "messages": [{"role": "user", "content": "x"}]}
+    req = Request(method="POST", path="/v1/chat/completions", query={},
+                  headers=Headers(), body=json.dumps(body).encode())
+    req.ctx["traceparent"] = TRACEPARENT
+    return req
+
+
+async def _drain(resp) -> bytes:
+    out = b""
+    async for chunk in resp.chunks:
+        out += chunk
+    return out
+
+
+async def test_pre_first_byte_death_fails_over_transparently():
+    """Acceptance (criterion 3): the first candidate's stream dies with
+    zero bytes relayed → the request transparently re-establishes on
+    the second candidate; one SSE stream, one trace id,
+    inference_gateway.streams_recovered == 1."""
+    otel = OpenTelemetry()
+    sse_body = b'data: {"id":"x","choices":[{"delta":{"content":"ok"}}]}\n\ndata: [DONE]\n\n'
+    script = (FaultScript()
+              # Dies before the first byte: 200 established, then the
+              # stream goes silent and resets with nothing delivered.
+              .script("/proxy/ollama/", Fault.stall(0.01, chunks=()))
+              .default("/proxy/tpu/", Fault.ok(sse_body)))
+    router, res, client = _make_router(script, otel=otel)
+
+    resp = await router.chat_completions_handler(_post_chat_stream("fast-model"))
+    assert resp.status == 200
+    body = await _drain(resp)
+    # One uninterrupted stream with the second candidate's bytes.
+    assert sse_body in body
+    # Recovery counted exactly once, with the hop attribution.
+    vals = otel.streams_recovered_counter.values()
+    assert sum(vals.values()) == 1
+    assert vals[("fast-model", "ollama", "tpu")] == 1
+    # Both upstream calls carried the SAME trace id.
+    tps = [tp for _url, tp in client.traceparents]
+    assert len(tps) == 2 and set(tps) == {TRACEPARENT}
+    # The failed candidate's breaker was charged for the dead stream.
+    assert res.breakers.get("ollama", "model-a")._consecutive_failures >= 1
+
+
+async def test_post_first_byte_death_is_not_recovered():
+    """Once a byte has been relayed the stream is non-idempotent: the
+    upstream dying mid-stream must NOT re-issue the request."""
+    otel = OpenTelemetry()
+    first = b'data: {"choices":[{"delta":{"content":"par"}}]}\n\n'
+    script = (FaultScript()
+              .script("/proxy/ollama/", Fault.stall(0.01, chunks=(first,)))
+              .default("/proxy/tpu/", Fault.ok(b"SHOULD-NEVER-APPEAR")))
+    router, _res, _client = _make_router(script, otel=otel)
+
+    resp = await router.chat_completions_handler(_post_chat_stream("fast-model"))
+    body = await _drain(resp)
+    assert first in body
+    assert b"SHOULD-NEVER-APPEAR" not in body
+    assert sum(otel.streams_recovered_counter.values().values()) == 0
+
+
+async def test_stream_retry_disabled_keeps_old_behavior():
+    otel = OpenTelemetry()
+    script = (FaultScript()
+              .script("/proxy/ollama/", Fault.stall(0.01, chunks=()))
+              .default("/proxy/tpu/", Fault.ok(b"RECOVERED")))
+    router, _res, _client = _make_router(
+        script, env={"RESILIENCE_STREAM_RETRY_ENABLED": "false"}, otel=otel)
+
+    resp = await router.chat_completions_handler(_post_chat_stream("fast-model"))
+    body = await _drain(resp)
+    # No recovery: the dead stream just ends empty, like before ISSUE 7.
+    assert b"RECOVERED" not in body
+    assert sum(otel.streams_recovered_counter.values().values()) == 0
+
+
+async def test_repeated_pre_byte_deaths_open_breaker_and_exhaust():
+    """Every candidate dying pre-first-byte ends the stream (bounded by
+    stream_retry_max and the candidate list) and charges breakers."""
+    otel = OpenTelemetry()
+    script = (FaultScript()
+              .default("/proxy/ollama/", Fault.stall(0.01, chunks=()))
+              .default("/proxy/tpu/", Fault.stall(0.01, chunks=())))
+    router, res, _client = _make_router(
+        script, env={"RESILIENCE_BREAKER_FAILURE_THRESHOLD": "1"}, otel=otel)
+
+    resp = await router.chat_completions_handler(_post_chat_stream("fast-model"))
+    assert resp.status == 200  # headers were already committed
+    body = await _drain(resp)
+    assert body == b""
+    assert sum(otel.streams_recovered_counter.values().values()) == 0
+    # Threshold 1: each pre-byte death opened its candidate's circuit.
+    assert res.breakers.get("ollama", "model-a").state == OPEN
+
+
+async def test_non_streaming_unaffected():
+    """Buffered requests keep the plain execute path."""
+    script = FaultScript().default("/proxy/ollama/", Fault.ok())
+    router, _res, _client = _make_router(script)
+    body = {"model": "fast-model", "messages": [{"role": "user", "content": "x"}]}
+    req = Request(method="POST", path="/v1/chat/completions", query={},
+                  headers=Headers(), body=json.dumps(body).encode())
+    resp = await router.chat_completions_handler(req)
+    assert resp.status == 200
+    assert json.loads(resp.body)["choices"][0]["message"]["content"] == "ok"
