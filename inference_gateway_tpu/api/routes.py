@@ -82,6 +82,18 @@ class _Candidate:
     model: str
 
 
+class _MessagesPassthrough(Exception):
+    """Carrier for a non-SSE upstream response on the streamed Messages
+    path: not an upstream illness (no breaker charge, no failover) —
+    the Anthropic envelope passes through verbatim."""
+
+    def __init__(self, status: int, content_type: str, body: bytes) -> None:
+        super().__init__(f"upstream returned {status} non-SSE")
+        self.status = status
+        self.content_type = content_type
+        self.body = body
+
+
 class RouterImpl:
     """All gateway endpoints (routes.go:52-67 constructor wiring)."""
 
@@ -298,15 +310,19 @@ class RouterImpl:
             # until the first relayed byte (ISSUE 7): execute_streaming
             # fails over on establishment errors AND on an upstream that
             # dies before any byte reaches the client, under the same
-            # trace id. After the first byte, failures propagate.
+            # trace id. Past the first byte (ISSUE 9), a continuation
+            # re-establishes with the generated-so-far prefix on
+            # continuation-capable candidates and splices the frames; the
+            # returned stream is idle-guarded internally.
             async def call(cand: _Candidate, b) -> Any:
                 return await cand.provider_obj.stream_chat_completions(
                     request_for(cand), ctx, timeout=b.timeout())
 
+            continuation = self._make_continuation(candidates, request_for, ctx)
             try:
                 stream, served = await self.resilience.execute_streaming(
                     candidates, call, budget=budget, alias=alias,
-                    event=event)
+                    event=event, continuation=continuation)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
             except BudgetExceededError:
@@ -318,7 +334,7 @@ class RouterImpl:
             if event is not None:
                 event["served_provider"] = served.provider
                 event["served_model"] = served.model
-            resp = StreamingResponse.sse(self.resilience.guard_stream(stream))
+            resp = StreamingResponse.sse(stream)
             if alias:
                 resp.headers.set("X-Selected-Provider", served.provider)
                 resp.headers.set("X-Selected-Model", served.model)
@@ -419,6 +435,34 @@ class RouterImpl:
                          "provider", provider_id, "model", model)
         return [strip_image_content(m) if isinstance(m, dict) else m for m in messages]
 
+    def _make_continuation(self, candidates: list[_Candidate], request_for, ctx):
+        """Post-first-byte continuation state for a chat-shaped stream
+        (ISSUE 9), or None when no candidate advertises the capability.
+        ``request_for`` is the handler's per-candidate request builder —
+        the continuation re-issues exactly that request plus the
+        ``continuation`` extension."""
+        if not any(c.provider_obj.supports_stream_continuation(c.model)
+                   for c in candidates[1:]):
+            # Continuation resumes on a candidate AFTER the establisher
+            # (``remaining`` is always a suffix), so a capable candidate
+            # at index 0 — or a single-candidate route — can never be a
+            # resume target: arming would only buy per-frame parse +
+            # prefix accumulation on the hot relay path for nothing
+            # (code-review finding: the tpu-primary + foreign-fallback
+            # pool rotation).
+            return None
+        from inference_gateway_tpu.resilience.continuation import ChatStreamContinuation
+
+        def cont_call(cand: _Candidate, b, payload: dict) -> Any:
+            return cand.provider_obj.stream_chat_completions(
+                dict(request_for(cand), continuation=payload), ctx,
+                timeout=b.timeout())
+
+        return ChatStreamContinuation(
+            cont_call,
+            supports=lambda c: c.provider_obj.supports_stream_continuation(c.model),
+            max_buffer=self.resilience.continuation_max_buffer)
+
     async def responses_handler(self, req: Request) -> Response:
         """POST /v1/responses — OpenAI Responses API, IMPLEMENTED.
 
@@ -475,12 +519,15 @@ class RouterImpl:
                 return await cand.provider_obj.stream_chat_completions(
                     chat_req_for(cand), ctx, timeout=b.timeout())
 
+            # Same recovery contract as the chat streaming path: pre- and
+            # post-first-byte (ISSUE 7 + 9) — the continuation rides the
+            # underlying chat-chunk stream, BEFORE the Responses-event
+            # translation consumes it, so the splice logic is shared.
+            continuation = self._make_continuation(candidates, chat_req_for, ctx)
             try:
-                # Same pre-first-byte recovery contract as the chat
-                # streaming path (ISSUE 7).
                 stream, _served = await self.resilience.execute_streaming(
                     candidates, call, budget=budget, alias=alias,
-                    event=event)
+                    event=event, continuation=continuation)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
             except BudgetExceededError:
@@ -489,8 +536,7 @@ class RouterImpl:
                 return error_json(e.message, e.status_code)
             except HTTPClientError as e:
                 return error_json(str(e), 502)
-            return StreamingResponse.sse(
-                stream_response_events(self.resilience.guard_stream(stream), body))
+            return StreamingResponse.sse(stream_response_events(stream, body))
 
         async def call(cand: _Candidate, b) -> Any:
             return await cand.provider_obj.chat_completions(
@@ -577,18 +623,102 @@ class RouterImpl:
         if req.ctx.get("traceparent"):
             headers.set("traceparent", req.ctx["traceparent"])
 
-        # Passthrough is non-idempotent: no retry, but the circuit breaker
-        # sheds load from a dead upstream and the deadline budget bounds
-        # connect + headers (streaming) or the whole exchange.
+        deployment = routing.Deployment(provider=provider_id, model=model)
+
+        if is_streaming:
+            # Streamed /v1/messages rides execute_streaming (ISSUE 9
+            # satellite — it previously had no failover at all): the
+            # breaker/budget walk covers establishment, and a death
+            # before the first relayed byte re-issues the request on any
+            # remaining candidate under the same trace id. No
+            # continuation — Anthropic doesn't advertise the capability,
+            # so post-first-byte keeps the non-idempotent contract. The
+            # returned stream is idle-guarded internally.
+            async def stream_call(cand, b) -> Any:
+                resp = await self.client.post(
+                    upstream_url, body, headers=headers, stream=True,
+                    timeout=b.timeout(),
+                )
+                content_type = resp.headers.get("Content-Type") or ""
+                if resp.status == 200 and content_type.startswith("text/event-stream"):
+                    # Block-level passthrough, no wrapper generator:
+                    # iter_raw already coalesces every buffered upstream
+                    # byte into one block per read (SSE framing preserved
+                    # verbatim; the telemetry usage scan splits lines
+                    # itself), and the server's write path batches blocks
+                    # into one transport write per loop pass.
+                    return resp.iter_raw()
+                # Buffer the non-SSE body (list-accumulate + join once:
+                # `bytes +=` is O(n²) on large bodies).
+                parts = []
+                async for block in resp.iter_raw():
+                    parts.append(block)
+                raw = b"".join(parts) or resp.body
+                if resp.status >= 500 or resp.status == 429:
+                    from inference_gateway_tpu.resilience.retry import retry_after_seconds
+
+                    # Upstream illness: raise so the breaker is charged
+                    # (and a multi-candidate walk would continue). The
+                    # EXACT body bytes + content type ride along so the
+                    # passthrough below stays verbatim — decode/encode
+                    # round-trips mangle non-UTF-8 bodies.
+                    err = HTTPError(resp.status,
+                                    raw.decode("utf-8", errors="replace"),
+                                    retry_after=retry_after_seconds(resp.headers))
+                    err.passthrough = _MessagesPassthrough(resp.status,
+                                                           content_type, raw)
+                    raise err
+                raise _MessagesPassthrough(resp.status, content_type, raw)
+
+            try:
+                stream, _served = await self.resilience.execute_streaming(
+                    [deployment], stream_call,
+                    budget=self.resilience.new_budget(),
+                    event=req.ctx.get("wide_event"),
+                )
+            except _MessagesPassthrough as p:
+                # A sub-500 non-SSE answer means the upstream is alive:
+                # feed the breaker the same success verdict the buffered
+                # path's result_ok records, or a half-open circuit would
+                # never close on an upstream that answers stream:true
+                # with buffered/4xx responses (code-review finding).
+                self.resilience.breakers.get(
+                    deployment.provider, deployment.model).record_success()
+                out = Response(status=p.status, body=p.body)
+                out.headers.set("Content-Type", p.content_type or "application/json")
+                return out
+            except UpstreamUnavailableError:
+                return messages_error(503, "overloaded_error",
+                                      "Upstream temporarily unavailable (circuit open)")
+            except BudgetExceededError:
+                return messages_error(504, "api_error", "Request timed out")
+            except HTTPError as e:
+                # Verbatim upstream error passthrough (routes.go keeps
+                # the Anthropic envelope untouched): the original bytes
+                # and content type ride the exception.
+                p = getattr(e, "passthrough", None)
+                body_out = p.body if p is not None else e.message.encode()
+                ctype = (p.content_type if p is not None else "") or "application/json"
+                out = Response(status=e.status_code, body=body_out)
+                out.headers.set("Content-Type", ctype)
+                return out
+            except HTTPClientError as e:
+                self.logger.error("failed to reach upstream server", e, "url", upstream_url)
+                return messages_error(502, "api_error", "Failed to reach upstream server")
+            return StreamingResponse.sse(stream)
+
+        # Buffered passthrough is non-idempotent: no retry, but the
+        # circuit breaker sheds load from a dead upstream and the
+        # deadline budget bounds the whole exchange.
         async def call(cand, b) -> Any:
             return await self.client.post(
-                upstream_url, body, headers=headers, stream=is_streaming,
+                upstream_url, body, headers=headers, stream=False,
                 timeout=b.timeout(),
             )
 
         try:
             resp, _ = await self.resilience.execute(
-                [routing.Deployment(provider=provider_id, model=model)], call,
+                [deployment], call,
                 budget=self.resilience.new_budget(), idempotent=False,
                 event=req.ctx.get("wide_event"),
                 # Upstream errors pass through verbatim (no exception), so
@@ -604,29 +734,9 @@ class RouterImpl:
             self.logger.error("failed to reach upstream server", e, "url", upstream_url)
             return messages_error(502, "api_error", "Failed to reach upstream server")
 
-        content_type = resp.headers.get("Content-Type") or ""
-        if not is_streaming or not content_type.startswith("text/event-stream"):
-            if is_streaming:
-                # List-accumulate + join once: `bytes += block` re-copies
-                # the whole prefix per block — O(n²) on large streamed
-                # non-SSE bodies.
-                parts = []
-                async for block in resp.iter_raw():
-                    parts.append(block)
-                body_out = b"".join(parts)
-            else:
-                body_out = resp.body
-            out = Response(status=resp.status, body=body_out)
-            out.headers.set("Content-Type", content_type or "application/json")
-            return out
-
-        # Block-level passthrough, no wrapper generator: iter_raw already
-        # coalesces every buffered upstream byte into one block per read
-        # (SSE framing preserved verbatim; the telemetry usage scan
-        # splits lines itself), and the server's write path batches
-        # blocks into one transport write per loop pass — an extra
-        # passthrough coroutine frame per block bought nothing.
-        return StreamingResponse.sse(self.resilience.guard_stream(resp.iter_raw()))
+        out = Response(status=resp.status, body=resp.body)
+        out.headers.set("Content-Type", resp.headers.get("Content-Type") or "application/json")
+        return out
 
     # ------------------------------------------------------------------
     async def list_tools_handler(self, req: Request) -> Response:

@@ -169,6 +169,15 @@ CreateChatCompletionRequest = TypedDict('CreateChatCompletionRequest', {
     'parallel_tool_calls': 'NotRequired[bool]',
     'reasoning_format': 'NotRequired[str]',
     'reasoning_effort': 'NotRequired[str]',
+    'continuation': 'NotRequired[StreamContinuation]',
+}, total=True)
+
+StreamContinuation = TypedDict('StreamContinuation', {
+    'token_ids': 'NotRequired[list[int]]',
+    'text': 'NotRequired[str]',
+    'emitted_tokens': 'NotRequired[int]',
+    'id': 'NotRequired[str]',
+    'created': 'NotRequired[int]',
 }, total=True)
 
 CompletionUsage = TypedDict('CompletionUsage', {
@@ -765,7 +774,40 @@ SCHEMAS: dict[str, Any] = {'Provider': {'type': 'string',
                                                                      'enum': ['minimal',
                                                                               'low',
                                                                               'medium',
-                                                                              'high']}}},
+                                                                              'high']},
+                                                'continuation': {'$ref': '#/components/schemas/StreamContinuation'}}},
+ 'StreamContinuation': {'type': 'object',
+                        'description': 'Mid-stream continuation extension (TPU sidecar): '
+                                       're-enter a killed stream with the generated-so-far '
+                                       'prefix. The sidecar re-prefills prompt+prefix, samples '
+                                       'the next NEW token, echoes id/created in the chunk '
+                                       'envelope, and bills only the new tokens (usage reports '
+                                       'the whole logical stream).',
+                        'properties': {'token_ids': {'type': 'array',
+                                                     'description': 'Generated-so-far token '
+                                                                    'ids (authoritative when '
+                                                                    'present)',
+                                                     'items': {'type': 'integer'}},
+                                       'text': {'type': 'string',
+                                                'description': 'Generated-so-far text '
+                                                               '(re-encoded when token_ids '
+                                                               'absent)'},
+                                       'emitted_tokens': {'type': 'integer',
+                                                          'description': 'Content frames '
+                                                                         'relayed so far — '
+                                                                         'diagnostic only '
+                                                                         '(under emit '
+                                                                         'coalescing one frame '
+                                                                         'carries several '
+                                                                         'tokens); token '
+                                                                         'counts derive from '
+                                                                         'token_ids/text'},
+                                       'id': {'type': 'string',
+                                              'description': 'Original completion id to echo '
+                                                             'in the envelope'},
+                                       'created': {'type': 'integer',
+                                                   'description': 'Original created timestamp '
+                                                                  'to echo'}}},
  'CompletionUsage': {'type': 'object',
                      'required': ['prompt_tokens', 'completion_tokens', 'total_tokens'],
                      'properties': {'prompt_tokens': {'type': 'integer'},

@@ -144,7 +144,23 @@ _RESILIENCE_FAILURE_MODES = [
     "| Deadline budget (`RESILIENCE_REQUEST_BUDGET`) exhausted across retries/failovers | `504` | `{\"error\": \"Request timed out\"}` |",
     "| Upstream kept failing after retries and failover (transport errors) | `502` | `{\"error\": \"<client error detail>\"}` |",
     "| Upstream returned a terminal HTTP error (passes through after retries for 429/5xx) | upstream status | upstream error body |",
-    "| SSE relay idle past `RESILIENCE_STREAM_IDLE_TIMEOUT` | stream aborted mid-flight (headers already sent) | connection closed |",
+    "| SSE relay idle past `RESILIENCE_STREAM_IDLE_TIMEOUT` | stream aborted mid-flight (headers already sent) — or transparently continued when the pool has a continuation-capable candidate | connection closed / spliced stream |",
+    "",
+    "### Stream continuation & active probing",
+    "",
+    "`RESILIENCE_CONTINUATION_*`: a streamed request whose upstream dies",
+    "AFTER the first relayed byte no longer truncates the client stream —",
+    "the gateway re-establishes on the next continuation-capable pool",
+    "candidate with the generated-so-far prefix, the sidecar re-prefills",
+    "prompt+prefix and samples the next NEW token (billing continuation",
+    "tokens exactly once), and the frames are spliced so a greedy stream",
+    "completes byte-identical to an unkilled run under one trace id.",
+    "`RESILIENCE_PROBE_*`: a background health prober per pool deployment",
+    "ejects dead replicas after K consecutive probe failures — ejected",
+    "replicas get ZERO establishment attempts until a probe succeeds —",
+    "with probe state in `/debug/status` and the",
+    "`inference_gateway.pool_healthy` gauge. Full contract:",
+    "[docs/resilience.md](docs/resilience.md).",
     "",
 ]
 
@@ -436,6 +452,12 @@ def check_config_defaults(spec: dict) -> list[str]:
         "RESILIENCE_STREAM_IDLE_TIMEOUT": cfg.resilience.stream_idle_timeout,
         "RESILIENCE_STREAM_RETRY_ENABLED": cfg.resilience.stream_retry_enabled,
         "RESILIENCE_STREAM_RETRY_MAX": cfg.resilience.stream_retry_max,
+        "RESILIENCE_CONTINUATION_ENABLED": cfg.resilience.continuation_enabled,
+        "RESILIENCE_CONTINUATION_MAX_BUFFER": cfg.resilience.continuation_max_buffer,
+        "RESILIENCE_PROBE_ENABLED": cfg.resilience.probe_enabled,
+        "RESILIENCE_PROBE_INTERVAL": cfg.resilience.probe_interval,
+        "RESILIENCE_PROBE_TIMEOUT": cfg.resilience.probe_timeout,
+        "RESILIENCE_PROBE_FAILURES": cfg.resilience.probe_failures,
         "OVERLOAD_ENABLED": cfg.overload.enabled,
         "OVERLOAD_MAX_CONCURRENT_STREAMING": cfg.overload.max_concurrent_streaming,
         "OVERLOAD_MAX_CONCURRENT_BUFFERED": cfg.overload.max_concurrent_buffered,

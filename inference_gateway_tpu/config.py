@@ -268,6 +268,22 @@ class ResilienceConfig:
     # client. stream_retry_max bounds the re-establishment hops.
     stream_retry_enabled: bool = True
     stream_retry_max: int = 2
+    # Post-first-byte continuation (ISSUE 9): a stream that dies AFTER
+    # the first relayed byte re-establishes on the next
+    # continuation-capable pool candidate with the generated-so-far
+    # prefix (the sidecar re-prefills and samples the next NEW token) and
+    # splices frames byte-identically. continuation_max_buffer bounds the
+    # accumulated prefix; past it, continuation disarms for that stream.
+    continuation_enabled: bool = True
+    continuation_max_buffer: int = 1 << 20
+    # Active pool health probing (ISSUE 9): a background prober GETs each
+    # pool deployment's /health every probe_interval; probe_failures
+    # consecutive failures eject the deployment (zero establishment
+    # attempts) until a probe succeeds again.
+    probe_enabled: bool = True
+    probe_interval: float = 5.0
+    probe_timeout: float = 2.0
+    probe_failures: int = 3
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "RESILIENCE_") -> "ResilienceConfig":
@@ -283,6 +299,12 @@ class ResilienceConfig:
             stream_idle_timeout=_get_duration(env, prefix + "STREAM_IDLE_TIMEOUT", "60s"),
             stream_retry_enabled=_get_bool(env, prefix + "STREAM_RETRY_ENABLED", True),
             stream_retry_max=_get_int(env, prefix + "STREAM_RETRY_MAX", 2),
+            continuation_enabled=_get_bool(env, prefix + "CONTINUATION_ENABLED", True),
+            continuation_max_buffer=_get_int(env, prefix + "CONTINUATION_MAX_BUFFER", 1 << 20),
+            probe_enabled=_get_bool(env, prefix + "PROBE_ENABLED", True),
+            probe_interval=_get_duration(env, prefix + "PROBE_INTERVAL", "5s"),
+            probe_timeout=_get_duration(env, prefix + "PROBE_TIMEOUT", "2s"),
+            probe_failures=_get_int(env, prefix + "PROBE_FAILURES", 3),
         )
 
 

@@ -53,6 +53,7 @@ class Gateway:
     mcp_client: Any = None
     overload: OverloadController | None = None
     resilience: Any = None
+    prober: Any = None
     access_log: Any = None
     profiler: SamplingProfiler | None = None
     watchdog: EventLoopWatchdog | None = None
@@ -83,6 +84,11 @@ class Gateway:
             self.profiler.start_continuous()
         if self.watchdog is not None:
             self.watchdog.start()
+        if self.prober is not None:
+            # Active pool health probing (ISSUE 9): per-deployment
+            # /health loop — starts here (the loop exists now), torn
+            # down in shutdown().
+            self.prober.start()
         # Self-addressing: the provider loopback hop targets this listener
         # (main.go:167, client.go:66-75).
         self.client.self_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
@@ -116,6 +122,8 @@ class Gateway:
         if self.watchdog is not None:
             # The heartbeat would read every drain pause as a stall.
             await self.watchdog.stop()
+        if self.prober is not None:
+            await self.prober.stop()
         if self.overload is not None:
             self.overload.begin_drain()
         if self.mcp_client is not None:
@@ -204,12 +212,43 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     overload = OverloadController(cfg.overload, otel=otel, logger=logger)
 
     selector = None
+    prober = None
     if cfg.routing.enabled:
         if not cfg.routing.config_path:
             raise ValueError("ROUTING_CONFIG_PATH is required when ROUTING_ENABLED is true")
         pools = routing.load_pools_config(cfg.routing.config_path)
-        selector = routing.Selector(pools, health=resilience.healthy)
-        logger.info("routing pools loaded", "aliases", selector.aliases())
+        # Active pool health probing (ISSUE 9): a background /health
+        # probe per pool deployment ejects dead replicas after K
+        # consecutive failures — the selector demotes them AND the
+        # executor skips them outright (zero establishment attempts)
+        # until a probe succeeds again. Passive breaker health still
+        # covers direct (non-pool) routes.
+        health = resilience.healthy
+        if cfg.resilience.enabled and cfg.resilience.probe_enabled:
+            from inference_gateway_tpu.resilience.prober import (
+                HealthProber,
+                ProbeTarget,
+                probe_url,
+            )
+
+            targets = [
+                ProbeTarget(d.provider, d.model,
+                            probe_url(cfg.providers[d.provider].url))
+                for pool in pools.values() for d in pool.deployments
+            ]
+            prober = HealthProber(
+                targets, client, interval=cfg.resilience.probe_interval,
+                timeout=cfg.resilience.probe_timeout,
+                eject_after=cfg.resilience.probe_failures,
+                otel=otel, logger=logger)
+            resilience.prober = prober
+
+            def health(d, _breakers=resilience.healthy, _probes=prober.healthy):
+                return _breakers(d) and _probes(d.provider, d.model)
+
+        selector = routing.Selector(pools, health=health)
+        logger.info("routing pools loaded", "aliases", selector.aliases(),
+                    "active_probing", prober is not None)
 
     # MCP subsystem (main.go:181-213).
     if mcp_client is None and cfg.mcp.enable and cfg.mcp.servers:
@@ -288,7 +327,8 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
         router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
         mcp_client=mcp_client, overload=overload, resilience=resilience,
-        access_log=access_log, profiler=profiler, watchdog=watchdog, slow_log=slow_log,
+        prober=prober, access_log=access_log, profiler=profiler, watchdog=watchdog,
+        slow_log=slow_log,
     )
 
     if metrics_router is not None:
@@ -308,6 +348,8 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
                 "admission": overload.snapshot(),
                 "gauges": otel.registry.gauge_snapshot(),
             }
+            if prober is not None:
+                status["probes"] = prober.snapshot()
             if access_log is not None:
                 status["access_log_tail"] = list(access_log.tail)[-8:]
                 status["access_log_dropped"] = access_log.dropped

@@ -257,14 +257,36 @@ class OpenTelemetry:
         self.streams_recovered_counter = r.counter(
             "inference_gateway.streams_recovered",
             "Streamed requests transparently failed over after the upstream "
-            "died before the first relayed byte",
-            ("alias", "from_provider", "to_provider"), unit="{stream}",
+            "died: phase=pre_first_byte re-issues the request, "
+            "phase=post_first_byte continues it with the relayed prefix "
+            "spliced (ISSUE 9)",
+            ("alias", "from_provider", "to_provider", "phase"), unit="{stream}",
         )
         self.engine_degraded_gauge = r.gauge(
             "engine.degraded",
             "1 while the serving engine is restarting (health reports 503 "
             "degraded so pools route around the window), else 0",
             ("gen_ai_request_model",),
+        )
+        # Active pool health probing (ISSUE 9): per-deployment probe
+        # verdict plus ejection/readmission lifecycle counters. The
+        # gauge is set to 1 for every probed target at prober start —
+        # an absent series must never read as healthy.
+        self.pool_healthy_gauge = r.gauge(
+            "inference_gateway.pool_healthy",
+            "Active-probe verdict per pool deployment: 1 healthy, "
+            "0 probe-ejected (zero establishment attempts until readmission)",
+            ("gen_ai_provider_name", "gen_ai_request_model"),
+        )
+        self.probe_ejection_counter = r.counter(
+            "inference_gateway.probe_ejections",
+            "Pool deployments ejected after K consecutive health-probe failures",
+            ("gen_ai_provider_name", "gen_ai_request_model"), unit="{ejection}",
+        )
+        self.probe_readmission_counter = r.counter(
+            "inference_gateway.probe_readmissions",
+            "Probe-ejected pool deployments readmitted on probe recovery",
+            ("gen_ai_provider_name", "gen_ai_request_model"), unit="{readmission}",
         )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
@@ -441,13 +463,27 @@ class OpenTelemetry:
             "gen_ai_request_model": model, "reason": reason})
 
     def record_stream_recovered(self, alias: str, from_provider: str,
-                                to_provider: str) -> None:
+                                to_provider: str,
+                                phase: str = "pre_first_byte") -> None:
         self.streams_recovered_counter.add(1, {
             "alias": alias, "from_provider": from_provider,
-            "to_provider": to_provider})
+            "to_provider": to_provider, "phase": phase})
 
     def set_engine_degraded(self, model: str, value: int) -> None:
         self.engine_degraded_gauge.set(value, {"gen_ai_request_model": model})
+
+    # -- active pool health probing (ISSUE 9) ----------------------------
+    def set_pool_healthy(self, provider: str, model: str, value: int) -> None:
+        self.pool_healthy_gauge.set(value, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model})
+
+    def record_probe_ejection(self, provider: str, model: str) -> None:
+        self.probe_ejection_counter.add(1, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model})
+
+    def record_probe_readmission(self, provider: str, model: str) -> None:
+        self.probe_readmission_counter.add(1, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model})
 
     def remove_efficiency_gauges(self, model: str) -> None:
         """Engine teardown: the accounting gauges describe a gone engine
@@ -700,4 +736,13 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def set_engine_degraded(self, *a, **k) -> None:
+        pass
+
+    def set_pool_healthy(self, *a, **k) -> None:
+        pass
+
+    def record_probe_ejection(self, *a, **k) -> None:
+        pass
+
+    def record_probe_readmission(self, *a, **k) -> None:
         pass

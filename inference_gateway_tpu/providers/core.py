@@ -84,6 +84,15 @@ class Provider:
             return "vision" in m or "vl" in m or "llava" in m or "gemma-3" in m
         return "vision" in m or "multimodal" in m or "-vl" in m or ("qwen" in m and "vl" in m)
 
+    def supports_stream_continuation(self, model: str) -> bool:
+        """Whether the provider honors the chat-request ``continuation``
+        extension (ISSUE 9): re-prefill prompt+generated-so-far, sample
+        the next NEW token, echo the original completion id, and bill
+        continuation tokens exactly once. Only the TPU sidecar speaks it
+        — the gateway's post-first-byte stream splice is gated on this,
+        so foreign providers keep the PR 7 pre-first-byte-only contract."""
+        return self.cfg.id == constants.TPU_ID
+
     # -- helpers -------------------------------------------------------
     def _headers(self, ctx: dict[str, Any] | None) -> Headers:
         h = Headers()

@@ -1,0 +1,272 @@
+"""Post-first-byte stream continuation (ISSUE 9 tentpole a+b, gateway side).
+
+PR 7 made a streamed request retryable until the FIRST relayed byte.
+This module extends the contract past it: ``ChatStreamContinuation``
+rides an OpenAI-chunk SSE relay, accumulating exactly the state needed
+to re-issue the request as a *continuation* — the generated-so-far text,
+an emitted-token hint, and the original completion id/created — when the
+upstream dies mid-stream. The serving sidecar maps the continuation
+extension onto the scheduler's recompute-style resume path (re-prefill
+prompt + prefix, sample the next NEW token, bill continuation tokens
+exactly once via ``resume_generated``), and echoes the original
+completion id/created in its chunk envelope, so the only splice work
+left at the gateway is suppressing the duplicate role-preamble chunk:
+the client stream completes byte-identical to an unkilled run.
+
+What can't splice (see docs/resilience.md "Stream continuation"):
+- a stream whose finish chunk was already relayed (resuming would
+  fabricate extra content — ``complete`` disarms the continuation),
+- prefixes past ``RESILIENCE_CONTINUATION_MAX_BUFFER`` (bounded memory),
+- providers that don't advertise continuation capability
+  (``Provider.supports_stream_continuation``).
+
+Byte-identity scope: the gateway only holds TEXT (frames carry no token
+ids by design — they stay byte-identical to unkilled runs), so the
+sidecar re-encodes the prefix. Byte-exact greedy splices therefore
+require the prefix to re-encode to the original ids — always true for
+byte-level tokenizers, true for BPE only when the kill lands on a merge
+boundary. Otherwise the continuation is a *semantic* resume: the model
+continues greedily from the re-tokenized prefix (a valid sample of the
+same request), the trim verification fails closed (dangling frame
+terminated, new frames passed through verbatim), and billing stays
+once-only against the re-encoded count. Callers that do hold ids (the
+preemption path, tests) use the authoritative ``token_ids`` field.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+# A continued stream's first frame should be the role preamble; anything
+# larger than this before the first frame boundary is not the SSE shape
+# we know how to splice — stop scanning and pass bytes through.
+_SPLICE_SCAN_CAP = 65536
+
+
+class ChatStreamContinuation:
+    """Continuation state for one streamed chat request.
+
+    ``call(cand, budget, payload)`` is supplied by the handler: it must
+    issue the SAME request against ``cand`` with the continuation
+    extension attached (the handler owns request construction — vision
+    gating, model rewrites — so the resilience layer stays
+    provider-shape agnostic). ``supports(cand)`` gates candidates on
+    advertised continuation capability.
+    """
+
+    def __init__(self, call: Callable[[Any, Any, dict], Awaitable[AsyncIterator[bytes]]],
+                 *, supports: Callable[[Any], bool] | None = None,
+                 max_buffer: int = 1 << 20) -> None:
+        self._call = call
+        self._supports = supports
+        self.max_buffer = max_buffer
+        # Partial-FRAME buffer: accumulation is frame-aligned (``\n\n``
+        # boundaries), so ``text`` only ever covers frames the client
+        # holds completely — the dangling tail a mid-frame death leaves
+        # behind is ``pending_raw``, which the splice trims off the
+        # resumed stream (the sidecar re-frames the same token with the
+        # same envelope, so the bytes line up exactly).
+        self._buf = b""
+        self.text = ""
+        # The max_buffer contract is BYTES: track the accumulated text's
+        # UTF-8 size incrementally (len(text) counts characters, which
+        # undercounts multi-byte content ~4× — code-review finding).
+        self._text_bytes = 0
+        # Content frames relayed — a DIAGNOSTIC count, not a token
+        # count (emit coalescing packs several tokens per frame); the
+        # sidecar derives token counts from the resume material.
+        self.frames = 0
+        self.completion_id = ""
+        self.created: int | None = None
+        self.model = ""
+        # True once a finish_reason or [DONE] was relayed: the stream is
+        # complete (or close enough that resuming would fabricate
+        # content past the model's own stop) — never resume.
+        self.complete = False
+        self.overflowed = False
+
+    # -- accumulation ----------------------------------------------------
+    @property
+    def pending_raw(self) -> bytes:
+        """Raw bytes the client holds past the last complete frame."""
+        return self._buf
+
+    def observe(self, chunk: bytes) -> None:
+        """Feed one relayed block (may contain partial frames)."""
+        if self.overflowed:
+            return
+        if len(self._buf) + len(chunk) + self._text_bytes > self.max_buffer:
+            self.overflowed = True
+            self._buf = b""
+            return
+        self._buf += chunk
+        while True:
+            # Both spec-legal event separators: LF-only (what the
+            # sidecar emits) and CRLF (other OpenAI-compatible servers
+            # — without this, frames never complete, the continuation
+            # silently disarms, and _buf grows to max_buffer for
+            # nothing; code-review finding).
+            i_lf = self._buf.find(b"\n\n")
+            i_cr = self._buf.find(b"\r\n\r\n")
+            if i_cr != -1 and (i_lf == -1 or i_cr < i_lf):
+                end = i_cr + 4
+            elif i_lf != -1:
+                end = i_lf + 2
+            else:
+                return
+            frame = self._buf[:end]
+            self._buf = self._buf[end:]
+            self._ingest_frame(frame)
+
+    def _ingest_frame(self, frame: bytes) -> None:
+        for line in frame.split(b"\n"):
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == b"[DONE]":
+                self.complete = True
+                continue
+            try:
+                event = json.loads(payload)
+            except ValueError:
+                continue  # not a chat chunk; never disarm mid-stream
+            if not isinstance(event, dict):
+                continue
+            if not self.completion_id and event.get("id"):
+                self.completion_id = str(event["id"])
+                created = event.get("created")
+                self.created = int(created) if isinstance(created, (int, float)) else None
+                self.model = str(event.get("model") or "")
+            for choice in event.get("choices") or []:
+                if not isinstance(choice, dict):
+                    continue
+                delta = choice.get("delta") or {}
+                content = delta.get("content") if isinstance(delta, dict) else None
+                if content:
+                    self.text += content
+                    self._text_bytes += len(content.encode("utf-8"))
+                    self.frames += 1
+                if choice.get("finish_reason"):
+                    self.complete = True
+
+    # -- resume ----------------------------------------------------------
+    def can_resume(self) -> bool:
+        """Resumable only while the relayed prefix is reconstructable:
+        the stream is incomplete, bounded, and we saw the preamble (so
+        the original completion id is known)."""
+        return not self.complete and not self.overflowed and bool(self.completion_id)
+
+    def supports(self, cand: Any) -> bool:
+        return self._supports is None or bool(self._supports(cand))
+
+    def payload(self) -> dict[str, Any]:
+        """The chat-request ``continuation`` extension (openapi.yaml
+        ``StreamContinuation``): generated-so-far text, a diagnostic
+        relayed-frame count, and the original envelope identity."""
+        out: dict[str, Any] = {"text": self.text, "emitted_tokens": self.frames}
+        if self.completion_id:
+            out["id"] = self.completion_id
+        if self.created is not None:
+            out["created"] = self.created
+        return out
+
+    def call(self, cand: Any, budget: Any) -> Awaitable[AsyncIterator[bytes]]:
+        return self._call(cand, budget, self.payload())
+
+    # -- splice ----------------------------------------------------------
+    def splice(self, stream: AsyncIterator[bytes]) -> AsyncIterator[bytes]:
+        """Splice a continued stream onto the relayed prefix.
+
+        Two corrections, then verbatim passthrough:
+
+        1. drop the duplicate role-preamble frame every fresh sidecar
+           stream opens with;
+        2. trim the bytes the client already holds past the last
+           complete frame (a mid-frame death leaves a dangling partial
+           frame downstream; the sidecar re-frames the same token with
+           the same envelope, so the resumed stream's first frame starts
+           with exactly those bytes — verified before trimming, and left
+           untouched on mismatch, e.g. a resampled temperature>0 stream,
+           which has no byte-identity contract anyway).
+
+        The sidecar echoes the original completion id/created/model, so
+        nothing is rewritten per frame. The trimmed-off prefix is also
+        what keeps ``observe`` consistent: its partial-frame buffer
+        still holds those bytes, and the spliced output completes them.
+        """
+        pending = self._buf
+
+        async def gen() -> AsyncIterator[bytes]:
+            buf = b""
+            stage = 0  # 0: scan role frame, 1: trim pending, 2: passthrough
+            async for chunk in stream:
+                if stage == 2:
+                    yield chunk
+                    continue
+                buf += chunk
+                if stage == 0:
+                    idx = buf.find(b"\n\n")
+                    if idx < 0:
+                        if len(buf) > _SPLICE_SCAN_CAP:
+                            stage = 2  # not spliceable SSE; pass through
+                            if pending:
+                                buf = b"\n\n" + buf
+                            yield buf
+                            buf = b""
+                        continue
+                    frame = buf[:idx + 2]
+                    buf = buf[idx + 2:]
+                    if not self._is_role_preamble(frame):
+                        buf = frame + buf
+                    stage = 1
+                if stage == 1:
+                    if pending and len(buf) < len(pending):
+                        if not pending.startswith(buf):
+                            # Mismatch (resampled stream / different
+                            # coalescing): no trim — but the client still
+                            # holds a dangling partial frame, so close it
+                            # first or it concatenates with the new
+                            # 'data:' line into one garbled event. The
+                            # same bytes flow through observe(), which
+                            # terminates ITS partial-frame buffer too.
+                            stage = 2
+                            yield b"\n\n" + buf
+                            buf = b""
+                        continue
+                    if pending and buf.startswith(pending):
+                        buf = buf[len(pending):]
+                    elif pending:
+                        buf = b"\n\n" + buf  # mismatch: close dangling frame
+                    stage = 2
+                    if buf:
+                        yield buf
+                    buf = b""
+            # Stream ended before reaching passthrough: whatever is left
+            # in ``buf`` is either a verified prefix of ``pending`` —
+            # bytes the client ALREADY holds (re-emitting them corrupts
+            # the stream and, via observe(), the continuation state for
+            # any further hop) — or a partial preamble. Discard; a death
+            # this early is handled by the recovery loop hopping again
+            # from the unchanged pending state.
+
+        return gen()
+
+    @staticmethod
+    def _is_role_preamble(frame: bytes) -> bool:
+        """True for the empty assistant-role chunk every fresh stream
+        opens with (the one frame a splice must suppress)."""
+        line = frame.strip()
+        if not line.startswith(b"data:"):
+            return False
+        try:
+            event = json.loads(line[5:].strip())
+        except ValueError:
+            return False
+        for choice in (event.get("choices") or []) if isinstance(event, dict) else []:
+            delta = (choice.get("delta") or {}) if isinstance(choice, dict) else {}
+            if delta.get("role") and not delta.get("content") \
+                    and not choice.get("finish_reason"):
+                return True
+        return False

@@ -32,7 +32,9 @@ OK_CHAT_BODY = {
 
 @dataclass
 class Fault:
-    kind: str  # "ok" | "reset" | "status" | "stall" | "slow_first_byte"
+    # "ok" | "reset" | "status" | "stall" | "slow_first_byte"
+    # | "mid_body_reset" | "cut" | "passthrough"
+    kind: str
     status: int = 200
     body: bytes = b""
     headers: dict[str, str] = field(default_factory=dict)
@@ -40,6 +42,10 @@ class Fault:
     delay: float = 0.0
     # For "stall": chunks delivered before the stream goes silent.
     chunks: tuple[bytes, ...] = ()
+    # For "mid_body_reset": bytes delivered before the connection resets;
+    # for "cut": SSE data frames relayed from the REAL upstream before
+    # the reset (the sidecar-kill-at-decode-step-N chaos variant).
+    after: int = 0
 
     # -- constructors ----------------------------------------------------
     @classmethod
@@ -69,6 +75,33 @@ class Fault:
         f.kind = "slow_first_byte"
         f.delay = delay
         return f
+
+    @classmethod
+    def mid_body_reset(cls, after_bytes: int, body: bytes | dict | None = None) -> "Fault":
+        """Deliver ``after_bytes`` of the body, then reset the connection
+        — the post-first-byte death the ISSUE 9 continuation splices
+        over (after_bytes=0 degenerates to a pre-first-byte zero-byte
+        death)."""
+        f = cls.ok(body)
+        f.kind = "mid_body_reset"
+        f.after = after_bytes
+        return f
+
+    @classmethod
+    def cut_stream(cls, after_frames: int) -> "Fault":
+        """Pass the request through to the wrapped REAL client and kill
+        the relayed stream after ``after_frames`` complete SSE frames —
+        the scripted sidecar-kill-at-decode-step-N chaos variant: the
+        live engine keeps its own state, only the gateway-visible relay
+        dies."""
+        return cls("cut", after=after_frames)
+
+    @classmethod
+    def passthrough(cls) -> "Fault":
+        """Delegate to the wrapped real client, recording the call (and
+        its traceparent) like any scripted fault — lets recovery tests
+        against a live sidecar assert one trace id spans the kill."""
+        return cls("passthrough")
 
 
 class FaultScript:
@@ -127,6 +160,19 @@ class FaultInjectingClient:
                                             traceparent=traceparent)
         if traceparent:
             self.traceparents.append((url, traceparent))
+        if fault.kind in ("cut", "passthrough"):
+            # Both ride the REAL upstream (chaos over a live sidecar);
+            # "cut" additionally kills the relayed stream mid-body.
+            if self.inner is None:
+                raise AssertionError(f"{fault.kind!r} fault needs an inner client for {url}")
+            resp = await self.inner.request(method, url, headers=headers, body=body,
+                                            timeout=timeout, stream=stream,
+                                            traceparent=traceparent)
+            if fault.kind == "passthrough" or not stream:
+                return resp
+            out = ClientResponse(status=resp.status, headers=resp.headers)
+            out._inproc_chunks = _cut_after_frames(resp.iter_raw(), fault.after, url)
+            return out
         return await self._play(fault, url, timeout, stream)
 
     async def _play(self, fault: Fault, url: str, timeout: float | None,
@@ -147,7 +193,21 @@ class FaultInjectingClient:
             headers.set(k, v)
         if fault.retry_after is not None:
             headers.set("Retry-After", f"{fault.retry_after:g}")
-        headers.set("Content-Type", "application/json")
+        if not headers.get("Content-Type"):
+            headers.set("Content-Type", "application/json")
+
+        if fault.kind == "mid_body_reset":
+            cut = fault.body[: max(fault.after, 0)]
+
+            async def mid_reset(b=cut):
+                if b:
+                    yield b
+                raise HTTPClientError(
+                    f"ConnectionResetError mid-body talking to {url} (injected)")
+
+            resp = ClientResponse(status=200, headers=headers)
+            resp._inproc_chunks = mid_reset()
+            return resp
 
         if fault.kind == "stall":
             clock = self.clock
@@ -181,6 +241,32 @@ class FaultInjectingClient:
                    stream: bool = False, traceparent: str | None = None) -> ClientResponse:
         return await self.request("POST", url, headers=headers, body=body,
                                   timeout=timeout, stream=stream, traceparent=traceparent)
+
+
+async def _cut_after_frames(blocks, after_frames: int, url: str):
+    """Relay complete SSE frames from ``blocks`` until ``after_frames``
+    have passed, then die with a connection reset — frames are cut on
+    ``\\n\\n`` boundaries so the delivered prefix is well-formed SSE
+    (exactly what a sidecar killed between decode steps produces)."""
+    relayed = 0
+    buf = b""
+    async for block in blocks:
+        buf += block
+        out = []
+        while relayed < after_frames:
+            idx = buf.find(b"\n\n")
+            if idx < 0:
+                break
+            out.append(buf[: idx + 2])
+            buf = buf[idx + 2:]
+            relayed += 1
+        if out:
+            yield b"".join(out)
+        if relayed >= after_frames:
+            raise HTTPClientError(
+                f"ConnectionResetError after {relayed} frames talking to {url} (injected)")
+    raise HTTPClientError(
+        f"ConnectionResetError after {relayed} frames talking to {url} (injected)")
 
 
 # ---------------------------------------------------------------------------

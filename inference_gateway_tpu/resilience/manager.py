@@ -64,6 +64,20 @@ class Resilience:
         self.stream_retry_enabled = (getattr(cfg, "stream_retry_enabled", True)
                                      if self.enabled else False)
         self.stream_retry_max = getattr(cfg, "stream_retry_max", 2)
+        # Post-first-byte continuation (ISSUE 9): when the handler
+        # supplies a continuation object, a stream that dies AFTER bytes
+        # were relayed re-establishes on the next continuation-capable
+        # candidate with the generated-so-far prefix and splices frames,
+        # instead of truncating the client stream. Shares the
+        # stream_retry_max hop bound with pre-first-byte recovery.
+        self.continuation_enabled = (getattr(cfg, "continuation_enabled", True)
+                                     if self.enabled else False)
+        self.continuation_max_buffer = getattr(cfg, "continuation_max_buffer", 1 << 20)
+        # Active pool health prober (ISSUE 9): wired by the gateway
+        # assembly when routing pools exist. An ejected deployment gets
+        # ZERO establishment attempts (stronger than breaker demotion,
+        # which only re-orders) until the prober readmits it.
+        self.prober = None
         self.retry_policy = RetryPolicy(
             max_attempts=getattr(cfg, "retry_max_attempts", 3) if self.enabled else 1,
             base_backoff=getattr(cfg, "retry_base_backoff", 0.1),
@@ -167,7 +181,17 @@ class Resilience:
             candidates = candidates[:1]
         last_exc: Exception | None = None
         prev_provider: str | None = None
+        probe_skips = 0
         for cand in candidates:
+            if self.prober is not None and not self.prober.healthy(cand.provider,
+                                                                   cand.model):
+                # Probe-ejected: the replica failed K consecutive active
+                # health probes — don't spend a request finding out again
+                # (zero establishment attempts until readmission).
+                probe_skips += 1
+                if event is not None:
+                    event["probe_skips"] = event.get("probe_skips", 0) + 1
+                continue
             breaker = self.breakers.get(cand.provider, cand.model)
             admitted, took_slot = breaker.admit()
             if not admitted:
@@ -272,18 +296,28 @@ class Resilience:
                     f"deadline budget of {budget.total:g}s exhausted"
                 ) from last_exc
             raise last_exc
+        # Name the actual gate so the operator looks at the right
+        # subsystem: a breaker-open skip reads very differently from a
+        # probe ejection in /debug/status (breakers all CLOSED there).
+        if probe_skips >= len(candidates) and probe_skips:
+            reason = "probe-ejected"
+        elif probe_skips:
+            reason = "circuit open or probe-ejected"
+        else:
+            reason = "circuit open"
         raise UpstreamUnavailableError(
-            f"all deployments unavailable (circuit open){' for ' + alias if alias else ''}"
+            f"all deployments unavailable ({reason}){' for ' + alias if alias else ''}"
         )
 
-    # -- mid-stream recovery (ISSUE 7) -----------------------------------
+    # -- mid-stream recovery (ISSUE 7 + ISSUE 9) -------------------------
     def _record_stream_recovered(self, alias: str, from_provider: str,
-                                 to_provider: str) -> None:
+                                 to_provider: str, phase: str) -> None:
         if self.logger is not None:
-            self.logger.info("stream recovered pre-first-byte", "alias", alias,
+            self.logger.info("stream recovered", "alias", alias, "phase", phase,
                              "from", from_provider, "to", to_provider)
         if self.otel is not None:
-            self.otel.record_stream_recovered(alias, from_provider, to_provider)
+            self.otel.record_stream_recovered(alias, from_provider, to_provider,
+                                              phase)
 
     async def execute_streaming(
         self,
@@ -293,22 +327,36 @@ class Resilience:
         budget: DeadlineBudget | None = None,
         alias: str = "",
         event: dict[str, Any] | None = None,
+        continuation: Any = None,
     ) -> tuple[AsyncIterator[bytes], Any]:
-        """``execute`` for SSE relays: streamed requests are safely
-        retryable until the first relayed byte.
+        """``execute`` for SSE relays: streamed requests are retryable
+        until the first relayed byte — and, with a ``continuation``,
+        past it.
 
         Establishment walks the candidate list exactly like
         ``execute(idempotent=False)``. The returned iterator then keeps
-        that guarantee alive: if the established stream dies BEFORE any
-        byte reaches the client — a connection reset, or an upstream
-        that closes with zero bytes — the failed candidate's breaker is
-        charged and the walk continues with the remaining candidates,
-        re-issuing the same request (same trace context) so the client
-        sees one uninterrupted stream. Once a single byte has been
-        relayed the stream is non-idempotent as before: failures
-        propagate. Returns ``(stream, served)`` where ``served`` is the
-        candidate that established first (recovery hops are recorded via
-        the streams-recovered counter and the wide event).
+        the guarantee alive, applying the stream idle timeout per chunk
+        (so callers must NOT re-wrap it in ``guard_stream``):
+
+        - **Pre-first-byte death** (reset, zero-byte close, idle stall
+          before any byte reaches the client): the failed candidate's
+          breaker is charged and the walk continues with the remaining
+          candidates, re-issuing the same request (same trace context).
+        - **Post-first-byte death** (reset, close without a terminal
+          frame, mid-stream idle stall): with a ``continuation``
+          (resilience/continuation.py) the relayed prefix re-establishes
+          on the next continuation-capable candidate as a continuation
+          request — the sidecar re-prefills prompt+prefix and samples
+          the next NEW token — and the new stream is spliced in
+          (duplicate role preamble suppressed, original completion id
+          kept), so a greedy client stream completes byte-identical to
+          an unkilled run. Without one, failures propagate as before.
+
+        Both directions share the ``stream_retry_max`` hop bound.
+        Returns ``(stream, served)`` where ``served`` is the candidate
+        that established first (recovery hops are recorded via the
+        streams-recovered counter — ``phase`` distinguishes pre from
+        post — and the wide event).
         """
         if budget is None:
             budget = self.new_budget()
@@ -316,63 +364,151 @@ class Resilience:
             candidates, call, budget=budget, idempotent=False, alias=alias,
             event=event)
         if not self.enabled or not self.stream_retry_enabled:
-            return stream, served
+            # Recovery off: keep the plain idle guard so a stalled
+            # upstream still can't hold the connection open forever.
+            return self.guard_stream(stream), served
+        if continuation is not None and not self.continuation_enabled:
+            continuation = None
 
         idx = next((i for i, c in enumerate(candidates) if c is served),
                    len(candidates) - 1)
         remaining = list(candidates[idx + 1:])
+        idle = self.stream_idle_timeout
 
         async def recovering() -> AsyncIterator[bytes]:
             current, cand = stream, served
             relayed = False
             hops = 0
+            pending_phase: str | None = None
+            pending_from = served.provider
             first_provider = served.provider
             while True:
                 err: Exception | None = None
-                try:
-                    async for chunk in current:
-                        if not relayed:
-                            relayed = True
-                            if hops:
-                                self._record_stream_recovered(
-                                    alias, first_provider, cand.provider)
-                                if event is not None:
-                                    # The wide event is written at
-                                    # request end: correct the serving
-                                    # attribution to the candidate that
-                                    # actually delivered bytes. (The
-                                    # X-Selected-Provider header was
-                                    # already sent and still names the
-                                    # establisher — headers can't be
-                                    # amended mid-stream.)
-                                    event["stream_recovered"] = hops
-                                    event["served_provider"] = cand.provider
-                                    event["served_model"] = cand.model
-                        yield chunk
+                outcome = ""
+                it = current.__aiter__()
+                while True:
+                    try:
+                        if idle and idle > 0:
+                            chunk = await self.clock.wait_for(it.__anext__(), idle)
+                        else:
+                            chunk = await it.__anext__()
+                    except StopAsyncIteration:
+                        outcome = "end"
+                        break
+                    except asyncio.TimeoutError:
+                        outcome = "stall"
+                        break
+                    except Exception as e:
+                        outcome = "error"
+                        err = e
+                        break
+                    if continuation is not None:
+                        continuation.observe(chunk)
+                    if not relayed or pending_phase is not None:
+                        relayed = True
+                        if hops:
+                            # Recorded only once the new candidate
+                            # actually delivers a byte — a hop that dies
+                            # silently is not a recovery.
+                            phase = pending_phase or "pre_first_byte"
+                            self._record_stream_recovered(
+                                alias, pending_from, cand.provider, phase)
+                            if event is not None:
+                                # The wide event is written at request
+                                # end: correct the serving attribution
+                                # to the candidate that delivered bytes.
+                                # (The X-Selected-Provider header was
+                                # already sent and still names the
+                                # establisher — headers can't be amended
+                                # mid-stream.)
+                                event["stream_recovered"] = hops
+                                event["stream_recovered_phase"] = phase
+                                event["served_provider"] = cand.provider
+                                event["served_model"] = cand.model
+                        pending_phase = None
+                    yield chunk
+
+                # The attempt's stream is over — decide whether this is a
+                # clean completion, a recoverable death, or terminal.
+                resumable = (continuation is not None and relayed
+                             and continuation.can_resume())
+                if outcome == "end":
                     if relayed:
-                        return
-                except Exception as e:
-                    if relayed:
-                        raise
-                    err = e
-                    if not self._classify(e)[0]:
-                        raise
-                # Dead pre-first-byte: the upstream failed this request
-                # even though establishment "succeeded" — charge its
-                # breaker and move on like any establishment failure.
+                        if not resumable:
+                            return  # complete (or nothing to resume with)
+                        death = "closed mid-stream without a terminal frame"
+                    else:
+                        death = "closed with no bytes"
+                elif outcome == "stall":
+                    stalled = StreamStalledError(
+                        f"no upstream bytes for {idle:g}s — aborting relay")
+                    if relayed and not resumable:
+                        raise stalled
+                    # Carried as the death verdict so exhausting the
+                    # candidate walk surfaces the stall (the guard_stream
+                    # contract) instead of a silent clean close.
+                    err = stalled
+                    death = f"no upstream bytes for {idle:g}s"
+                else:
+                    if not self._classify(err)[0]:
+                        raise err
+                    if relayed and not resumable:
+                        raise err
+                    death = repr(err)
+
+                # Dead: the upstream failed this request even though
+                # establishment "succeeded" — charge its breaker and move
+                # on like any establishment failure.
                 self.breakers.get(cand.provider, cand.model).record_failure()
                 hops += 1
-                if hops > self.stream_retry_max or not remaining:
+                post = relayed
+                avail = (remaining if not post
+                         else [c for c in remaining if continuation.supports(c)])
+                if hops > self.stream_retry_max or not avail:
+                    if post:
+                        # The client already holds part of the stream and
+                        # nobody can continue it: end it (truncated — the
+                        # missing [DONE] tells consumers) instead of
+                        # raising into bytes already framed.
+                        if self.logger is not None:
+                            self.logger.warn(
+                                "stream died post-first-byte; continuation exhausted",
+                                "alias", alias, "provider", cand.provider,
+                                "hops", hops, "error", death)
+                        return
                     if err is not None:
                         raise err
                     return  # empty stream, nowhere to go: end cleanly
                 if self.logger is not None:
-                    self.logger.warn("stream died pre-first-byte; failing over",
-                                     "alias", alias, "provider", cand.provider,
-                                     "error", repr(err) if err else "closed with no bytes")
-                current, cand = await self.execute(
-                    remaining, call, budget=budget, idempotent=False,
-                    alias=alias, event=event)
+                    self.logger.warn("stream died; failing over", "alias", alias,
+                                     "provider", cand.provider,
+                                     "post_first_byte", post, "error", death)
+                pending_from = cand.provider if post else first_provider
+                try:
+                    if post:
+                        # A fresh establishment budget: the original one
+                        # has been ticking for the whole stream so far —
+                        # long streams would make continuation stillborn.
+                        new_stream, cand = await self.execute(
+                            avail, lambda c, b: continuation.call(c, b),
+                            budget=self.new_budget(), idempotent=False,
+                            alias=alias, event=event)
+                        current = continuation.splice(new_stream)
+                    else:
+                        current, cand = await self.execute(
+                            remaining, call, budget=budget, idempotent=False,
+                            alias=alias, event=event)
+                except Exception as e2:
+                    if post:
+                        # Same terminal contract as exhaustion above:
+                        # never raise into a stream that already relayed.
+                        if self.logger is not None:
+                            self.logger.warn(
+                                "continuation re-establishment failed; ending stream",
+                                "alias", alias, "error", repr(e2))
+                        return
+                    raise
+                pending_phase = "post_first_byte" if post else "pre_first_byte"
                 ridx = next((i for i, c in enumerate(remaining) if c is cand),
                             len(remaining) - 1)
                 del remaining[:ridx + 1]

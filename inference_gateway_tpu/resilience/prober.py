@@ -1,0 +1,210 @@
+"""Active pool health probing (ISSUE 9 tentpole c).
+
+Pool health was purely passive: a dead replica kept eating first
+attempts until its circuit breaker collected enough *request* failures
+to open — every one of those failures was a real client paying the
+detection cost. The ``HealthProber`` makes detection free: a background
+task issues a cheap ``GET /health`` per pool deployment on an injectable
+clock, *ejects* a deployment after ``eject_after`` consecutive probe
+failures, and *readmits* it on the first successful probe.
+
+Ejection is stronger than breaker demotion: ``Selector`` ordering
+demotes an ejected replica to the tail AND ``Resilience.execute`` skips
+it outright (zero establishment attempts until readmission — the
+acceptance criterion), whereas a breaker-open tail candidate can still
+be probed by the failover walk.
+
+State transitions are lock-protected and safe to drive from any thread
+(``tests/race_harness.hammer_prober``); all timing goes through the
+clock, so tests drive ``probe_once()`` on a ``VirtualClock`` with zero
+real sleeps — the loop task auto-disables there, same contract as the
+PR 7 ``EngineWatchdog``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+from inference_gateway_tpu.resilience.clock import MonotonicClock, VirtualClock
+
+
+def probe_url(base_url: str) -> str:
+    """Health endpoint for a provider base URL: the API version segment
+    is an API namespace, not a host path — ``/health`` lives at the
+    origin (the TPU sidecar, llama.cpp, and Ollama all serve it there)."""
+    base = (base_url or "").rstrip("/")
+    if base.endswith("/v1"):
+        base = base[: -len("/v1")].rstrip("/")
+    return base + "/health"
+
+
+@dataclass(frozen=True)
+class ProbeTarget:
+    provider: str
+    model: str
+    url: str
+
+
+class HealthProber:
+    """Per-deployment active health state for one pool set."""
+
+    def __init__(self, targets: Iterable[ProbeTarget], client: Any = None, *,
+                 clock=None, interval: float = 5.0, timeout: float = 2.0,
+                 eject_after: int = 3, otel=None, logger=None) -> None:
+        self.client = client
+        self.clock = clock or MonotonicClock()
+        self.interval = interval
+        self.timeout = timeout
+        self.eject_after = max(1, int(eject_after))
+        self.otel = otel
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._state: dict[tuple[str, str], dict[str, Any]] = {}
+        self.targets: list[ProbeTarget] = []
+        for t in targets:
+            key = (t.provider, t.model)
+            if key in self._state:
+                continue  # one probe per (provider, model), first URL wins
+            self.targets.append(t)
+            self._state[key] = {
+                "url": t.url, "failures": 0, "ejected": False,
+                "ejections": 0, "readmissions": 0, "last_ok": None,
+                "last_checked": None,
+            }
+        self._task: asyncio.Task | None = None
+
+    # -- the predicate ---------------------------------------------------
+    def healthy(self, provider: str, model: str) -> bool:
+        """False only while the deployment is probe-ejected. Unknown
+        deployments (direct routes, pools added later) are healthy —
+        the prober only ever *removes* candidates it has evidence
+        against."""
+        with self._lock:
+            st = self._state.get((provider, model))
+            return st is None or not st["ejected"]
+
+    # -- probing ---------------------------------------------------------
+    async def probe_once(self) -> None:
+        """One probe round (concurrently) — one GET per DISTINCT url,
+        fanned out to every (provider, model) sharing it: a provider
+        serving N pool models must not receive N identical probes per
+        round (code-review finding)."""
+        by_url: dict[str, list[ProbeTarget]] = {}
+        for t in self.targets:
+            by_url.setdefault(t.url, []).append(t)
+        await asyncio.gather(*(self._probe(url, ts) for url, ts in by_url.items()))
+
+    async def _probe(self, url: str, targets: list[ProbeTarget]) -> None:
+        ok = False
+        try:
+            resp = await self.clock.wait_for(
+                self.client.get(url, timeout=self.timeout), self.timeout)
+            # Unhealthy = unreachable or 5xx (the sidecar's degraded 503,
+            # a dying LB). ANY sub-500 answer proves the host alive —
+            # cloud providers have no /health endpoint and answer 404,
+            # which must never eject them (default-on probing would
+            # otherwise permanently remove every cloud deployment from
+            # its pool ~K intervals after boot; code-review finding).
+            ok = getattr(resp, "status", 599) < 500
+        except Exception:
+            ok = False
+        for t in targets:
+            self.record(t.provider, t.model, ok)
+
+    def record(self, provider: str, model: str, ok: bool) -> None:
+        """Apply one probe outcome (thread-safe; the transition decision
+        happens under the lock, telemetry outside it)."""
+        key = (provider, model)
+        ejected_now = readmitted_now = False
+        with self._lock:
+            st = self._state.get(key)
+            if st is None:
+                return
+            st["last_ok"] = ok
+            st["last_checked"] = self.clock.now()
+            if ok:
+                st["failures"] = 0
+                if st["ejected"]:
+                    st["ejected"] = False
+                    st["readmissions"] += 1
+                    readmitted_now = True
+            else:
+                st["failures"] += 1
+                if not st["ejected"] and st["failures"] >= self.eject_after:
+                    st["ejected"] = True
+                    st["ejections"] += 1
+                    ejected_now = True
+        if ejected_now:
+            if self.logger is not None:
+                self.logger.warn("pool deployment ejected by health prober",
+                                 "provider", provider, "model", model,
+                                 "consecutive_failures", self.eject_after)
+            if self.otel is not None:
+                self.otel.record_probe_ejection(provider, model)
+                self.otel.set_pool_healthy(provider, model, 0)
+        elif readmitted_now:
+            if self.logger is not None:
+                self.logger.info("pool deployment readmitted by health prober",
+                                 "provider", provider, "model", model)
+            if self.otel is not None:
+                self.otel.record_probe_readmission(provider, model)
+                self.otel.set_pool_healthy(provider, model, 1)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self.otel is not None:
+            # Every target starts healthy ON the exposition: an absent
+            # series is indistinguishable from an ejected replica, and
+            # alerts key on 1 → 0 (same contract as engine.degraded).
+            for t in self.targets:
+                self.otel.set_pool_healthy(t.provider, t.model, 1)
+        if isinstance(self.clock, VirtualClock):
+            # Zero-sleep tests drive probe_once() directly; a
+            # virtual-clock sleep loop would spin the event loop (same
+            # auto-disable contract as EngineWatchdog).
+            return
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await self.clock.sleep(self.interval)
+            try:
+                await self.probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # a probe round must never kill the loop
+                if self.logger is not None:
+                    self.logger.warn("health probe round failed", "error", repr(e))
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """The /debug/status view of probe state."""
+        now = self.clock.now()
+        with self._lock:
+            targets = []
+            for (provider, model), st in sorted(self._state.items()):
+                targets.append({
+                    "provider": provider, "model": model, "url": st["url"],
+                    "ejected": st["ejected"],
+                    "consecutive_failures": st["failures"],
+                    "ejections": st["ejections"],
+                    "readmissions": st["readmissions"],
+                    "last_ok": st["last_ok"],
+                    "seconds_since_probe": (round(now - st["last_checked"], 3)
+                                            if st["last_checked"] is not None else None),
+                })
+        return {"interval": self.interval, "timeout": self.timeout,
+                "eject_after": self.eject_after, "targets": targets}

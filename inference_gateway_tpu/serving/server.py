@@ -691,23 +691,50 @@ class SidecarServer:
             images = self._decode_images(messages)
             if images:
                 prompt_ids, embeds = self.engine.prepare_multimodal(prompt_ids, images)
+        # Continuation extension (ISSUE 9): the request re-enters with
+        # prompt + generated-so-far as the prefill prompt — the SAME
+        # resume path KV-pressure preemption uses (PrefixCache makes the
+        # re-prefill cheap) — so the first sampled token is the next NEW
+        # token and ``resume_generated`` spans max_tokens across the
+        # whole logical stream and bills continuation tokens exactly
+        # once. The original completion id/created are echoed in the
+        # chunk envelope so the gateway splice stays byte-identical.
+        cont = body.get("continuation")
+        resume_ids: list[int] = []
+        cont_id: str = ""
+        cont_created: int | None = None
+        if isinstance(cont, dict):
+            ids = cont.get("token_ids")
+            if ids is not None:
+                resume_ids = [int(t) for t in ids]
+            elif cont.get("text"):
+                resume_ids = self.engine.tokenizer.encode(cont["text"], add_bos=False)
+            cont_id = str(cont.get("id") or "")
+            created = cont.get("created")
+            cont_created = int(created) if isinstance(created, (int, float)) else None
         max_tokens = body.get("max_completion_tokens") or body.get("max_tokens") or 256
         stop = body.get("stop")
         stop_strings: list[str] = [stop] if isinstance(stop, str) else list(stop or [])
         seed = body.get("seed")
         req = GenRequest(
-            prompt_ids=prompt_ids,
+            prompt_ids=prompt_ids + resume_ids,
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature") or 0.0),
             top_p=float(body.get("top_p") or 1.0),
             embeds=embeds,
             seed=int(seed) if seed is not None else None,
+            resume_generated=len(resume_ids),
         )
         meta = {
-            "id": "chatcmpl-" + uuid.uuid4().hex[:24],
-            "created": int(time.time()),
+            "id": cont_id or "chatcmpl-" + uuid.uuid4().hex[:24],
+            "created": cont_created if cont_created is not None else int(time.time()),
             "model": body.get("model") or self.model_name,
+            # The ORIGINAL prompt: resume tokens are completion tokens
+            # (already billed by the replica that generated them), not
+            # input — usage and the wide event keep the unkilled shape.
             "prompt_tokens": len(prompt_ids),
+            "resume_ids": resume_ids,
+            "resume_tokens": len(resume_ids),
             "stop_strings": stop_strings,
         }
         return req, meta
@@ -809,7 +836,8 @@ class SidecarServer:
 
         # Non-streaming: drain the queue (one item per decode step, each
         # a batch of tokens) to completion.
-        detok = DetokenizeState()
+        detok = self._seed_detok(meta)
+        seed_len = len(detok.emitted)
         completion_tokens = 0
         reason = "stop"
         done = False
@@ -842,6 +870,11 @@ class SidecarServer:
             resp.headers.set("Retry-After", str(self._retry_after_hint()))
             return resp
         text, reason = self._apply_stop_strings(detok.emitted, meta["stop_strings"], reason)
+        # A continuation returns only the NEW tail (the caller already
+        # holds the resume prefix); usage reports the whole logical
+        # stream so the client-visible totals match an unkilled run.
+        text = text[seed_len:]
+        visible_completion = meta["resume_tokens"] + completion_tokens
         choice: dict[str, Any] = {
             "index": 0,
             "message": {"role": "assistant", "content": text},
@@ -857,10 +890,33 @@ class SidecarServer:
             "choices": [choice],
             "usage": {
                 "prompt_tokens": meta["prompt_tokens"],
-                "completion_tokens": completion_tokens,
-                "total_tokens": meta["prompt_tokens"] + completion_tokens,
+                "completion_tokens": visible_completion,
+                "total_tokens": meta["prompt_tokens"] + visible_completion,
             },
         })
+
+    def _seed_detok(self, meta: dict[str, Any]) -> DetokenizeState:
+        """Detokenizer pre-fed with the continuation's resume tokens
+        (ISSUE 9): incremental detokenization depends on the preceding
+        ids (partial UTF-8 buffering, history rewrites), so a continued
+        stream's deltas only match the unkilled run's if the state at
+        the splice point is identical. The seed deltas are discarded —
+        the client already holds that text."""
+        detok = DetokenizeState()
+        resume = meta.get("resume_ids") or []
+        if resume:
+            # Seed in ONE decode pass, not a per-token push() replay —
+            # each push() re-decodes the whole id list, which is O(N²)
+            # synchronous work on the event loop for a long resume
+            # prefix (code-review finding). Final state is identical:
+            # ids = the prefix, emitted = its full decode minus the
+            # trailing partial-UTF-8 holdback push() applies.
+            detok.ids = list(resume)
+            text = self.engine.tokenizer.decode(detok.ids)
+            while text.endswith("�"):
+                text = text[:-1]
+            detok.emitted = text
+        return detok
 
     @staticmethod
     def _apply_stop_strings(text: str, stop_strings: list[str], reason: str) -> tuple[str, str]:
@@ -938,6 +994,13 @@ class SidecarServer:
                 "prefill_ms": to_ms(admit, first),
                 "decode_ms": to_ms(first, finish),
             }
+            if meta.get("resume_tokens"):
+                # Continuation requests (ISSUE 9) are flagged so billing
+                # audits can pair a killed stream's line with its
+                # continuation's: output_tokens here covers ONLY the new
+                # tokens; resume_tokens were billed by the replica that
+                # generated them.
+                event["resume_tokens"] = meta["resume_tokens"]
             if self.accounting is not None:
                 # Per-request compute attribution (ISSUE 6): the useful
                 # work this request bought, in the same FLOP currency the
@@ -1004,7 +1067,7 @@ class SidecarServer:
         coalesce_s = self.emit_coalesce
         loop = asyncio.get_running_loop()
 
-        detok = DetokenizeState()
+        detok = self._seed_detok(meta)
         completion_tokens = 0
         reason = "stop"
         completed = False
@@ -1012,7 +1075,10 @@ class SidecarServer:
             yield chunk({"role": "assistant", "content": ""}, None)
 
             stop_strings = meta["stop_strings"]
-            emitted_len = 0
+            # A continuation starts past the resume prefix: stop-string
+            # scans see the full emitted text (so a stop spanning the
+            # kill boundary still cuts), but only new text is framed.
+            emitted_len = len(detok.emitted)
             stopped_early = False
             done = False
             while not done:
@@ -1064,6 +1130,11 @@ class SidecarServer:
             self._observe_service(time.monotonic() - arrival)
             yield chunk({}, reason)
             if include_usage:
+                # Usage spans the whole logical stream: resume tokens
+                # (billed by the replica that generated them) plus this
+                # replica's new tokens — the client-visible frame is
+                # byte-identical to an unkilled run's (ISSUE 9).
+                visible = meta["resume_tokens"] + completion_tokens
                 yield sse.format_event({
                     "id": meta["id"],
                     "object": "chat.completion.chunk",
@@ -1072,8 +1143,8 @@ class SidecarServer:
                     "choices": [],
                     "usage": {
                         "prompt_tokens": meta["prompt_tokens"],
-                        "completion_tokens": completion_tokens,
-                        "total_tokens": meta["prompt_tokens"] + completion_tokens,
+                        "completion_tokens": visible,
+                        "total_tokens": meta["prompt_tokens"] + visible,
                     },
                 })
             yield sse.DONE_FRAME

@@ -409,3 +409,68 @@ def hammer_profiler(lifecycle_threads: int = 3, reader_threads: int = 3,
     if leaked:
         fail(f"sampler thread leaked after stop(): {[t.name for t in leaked]}")
     return errors
+
+
+def hammer_prober(prober, flip_threads: int = 4, reader_threads: int = 3,
+                  iters: int = 500) -> list[str]:
+    """Concurrency hammer for the health prober (ISSUE 9 satellite).
+
+    Probe outcomes can land from concurrent probe rounds while the
+    request path calls ``healthy()`` per candidate and /debug/status
+    snapshots — the eject/readmit transition must be race-free. N
+    flipper threads record random outcomes per target while readers
+    call healthy()/snapshot() concurrently. Invariants at quiesce:
+    no exceptions, and per target ``ejections - readmissions`` equals
+    exactly 1 when ejected else 0 (transitions strictly alternate —
+    a torn transition double-counts one side).
+    """
+    import random as _random
+
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+    barrier = threading.Barrier(flip_threads + reader_threads)
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    def flipper(tid: int) -> None:
+        rng = _random.Random(1000 + tid)
+        barrier.wait()
+        for _ in range(iters):
+            t = rng.choice(prober.targets)
+            try:
+                prober.record(t.provider, t.model, rng.random() < 0.5)
+            except Exception as e:
+                fail(f"flipper: {e!r}")
+                return
+
+    def reader() -> None:
+        barrier.wait()
+        for _ in range(iters):
+            try:
+                for t in prober.targets:
+                    prober.healthy(t.provider, t.model)
+                prober.snapshot()
+            except Exception as e:
+                fail(f"reader: {e!r}")
+                return
+
+    threads = [threading.Thread(target=flipper, args=(t,), name=f"probe-f{t}", daemon=True)
+               for t in range(flip_threads)]
+    threads += [threading.Thread(target=reader, name=f"probe-r{t}", daemon=True)
+                for t in range(reader_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        if t.is_alive():
+            fail(f"{t.name} did not finish")
+    for tgt in prober.snapshot()["targets"]:
+        want = 1 if tgt["ejected"] else 0
+        if tgt["ejections"] - tgt["readmissions"] != want:
+            fail(f"{tgt['provider']}/{tgt['model']}: ejections={tgt['ejections']} "
+                 f"readmissions={tgt['readmissions']} ejected={tgt['ejected']}")
+        if not tgt["ejected"] and not prober.healthy(tgt["provider"], tgt["model"]):
+            fail(f"{tgt['provider']}/{tgt['model']}: snapshot/healthy disagree")
+    return errors

@@ -130,11 +130,44 @@ def test_fault_tolerance_instruments_registered_with_expected_shapes():
     assert restarts.unit == "{restart}"
     recovered = by_name["inference_gateway.streams_recovered"]
     assert isinstance(recovered, Counter)
-    assert recovered.label_names == ("alias", "from_provider", "to_provider")
+    # phase distinguishes a pre-first-byte re-issue from a
+    # post-first-byte continuation splice (ISSUE 9).
+    assert recovered.label_names == ("alias", "from_provider", "to_provider", "phase")
     assert recovered.unit == "{stream}"
     degraded = by_name["engine.degraded"]
     assert isinstance(degraded, Gauge)
     assert degraded.label_names == ("gen_ai_request_model",)
+
+
+def test_probe_instruments_registered_with_expected_shapes():
+    """ISSUE 9: the active-probing surface must expose exactly the
+    advertised names — the e2e acceptance and dashboards key on them."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    healthy = by_name["inference_gateway.pool_healthy"]
+    assert isinstance(healthy, Gauge)
+    assert healthy.label_names == ("gen_ai_provider_name", "gen_ai_request_model")
+    ejections = by_name["inference_gateway.probe_ejections"]
+    assert isinstance(ejections, Counter)
+    assert ejections.label_names == ("gen_ai_provider_name", "gen_ai_request_model")
+    assert ejections.unit == "{ejection}"
+    readmissions = by_name["inference_gateway.probe_readmissions"]
+    assert isinstance(readmissions, Counter)
+    assert readmissions.label_names == ("gen_ai_provider_name", "gen_ai_request_model")
+    assert readmissions.unit == "{readmission}"
+
+
+def test_noop_probe_recorders_record_nothing():
+    """NoopTelemetry drift guard for the ISSUE 9 recorders."""
+    noop = NoopTelemetry()
+    noop.set_pool_healthy("tpu", "m", 1)
+    noop.record_probe_ejection("tpu", "m")
+    noop.record_probe_readmission("tpu", "m")
+    noop.record_stream_recovered("alias", "a", "b", "post_first_byte")
+    assert noop.pool_healthy_gauge.values() == {}
+    assert noop.probe_ejection_counter.values() == {}
+    assert noop.probe_readmission_counter.values() == {}
+    assert noop.streams_recovered_counter.values() == {}
 
 
 def test_noop_fault_tolerance_recorders_record_nothing():

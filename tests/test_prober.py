@@ -1,0 +1,241 @@
+"""Active pool health probing (ISSUE 9 tentpole c).
+
+Eject-after-K / readmit-on-recovery state machine, the Selector/executor
+integration (an ejected deployment receives ZERO establishment attempts
+until readmission — the acceptance criterion), telemetry, and the main.py
+assembly. All timing on VirtualClock — zero real sleeps.
+"""
+
+import json
+import random
+
+from inference_gateway_tpu.config import Config
+from inference_gateway_tpu.netio.server import Headers, Request
+from inference_gateway_tpu.otel.otel import OpenTelemetry
+from inference_gateway_tpu.providers.registry import ProviderRegistry
+from inference_gateway_tpu.providers.routing import Deployment, Pool, Selector
+from inference_gateway_tpu.resilience import Resilience, VirtualClock
+from inference_gateway_tpu.resilience.faults import Fault, FaultInjectingClient, FaultScript
+from inference_gateway_tpu.resilience.prober import HealthProber, ProbeTarget, probe_url
+
+
+def test_probe_url_strips_api_namespace():
+    assert probe_url("http://h:8000/v1") == "http://h:8000/health"
+    assert probe_url("http://h:8000/v1/") == "http://h:8000/health"
+    assert probe_url("http://h:8000") == "http://h:8000/health"
+    assert probe_url("http://h:8000/") == "http://h:8000/health"
+
+
+def _prober(otel=None, eject_after=3, clk=None, client=None):
+    targets = [ProbeTarget("tpu", "model-a", "http://a/health"),
+               ProbeTarget("tpu", "model-b", "http://b/health")]
+    return HealthProber(targets, client, clock=clk or VirtualClock(),
+                        eject_after=eject_after, otel=otel)
+
+
+def test_eject_after_k_consecutive_failures_and_readmit_on_recovery():
+    otel = OpenTelemetry()
+    p = _prober(otel=otel)
+    p.start()  # VirtualClock: no loop task, but gauges initialize to 1
+    assert otel.pool_healthy_gauge.values()[("tpu", "model-a")] == 1
+
+    # Two failures: not yet ejected (K=3); an intervening success resets.
+    p.record("tpu", "model-a", False)
+    p.record("tpu", "model-a", False)
+    assert p.healthy("tpu", "model-a")
+    p.record("tpu", "model-a", True)
+    p.record("tpu", "model-a", False)
+    p.record("tpu", "model-a", False)
+    assert p.healthy("tpu", "model-a")
+    p.record("tpu", "model-a", False)
+    assert not p.healthy("tpu", "model-a")
+    assert p.healthy("tpu", "model-b")  # independent state
+    assert otel.pool_healthy_gauge.values()[("tpu", "model-a")] == 0
+    assert otel.probe_ejection_counter.values()[("tpu", "model-a")] == 1
+
+    # Further failures while ejected don't re-eject (no double count).
+    p.record("tpu", "model-a", False)
+    assert otel.probe_ejection_counter.values()[("tpu", "model-a")] == 1
+
+    # First success readmits.
+    p.record("tpu", "model-a", True)
+    assert p.healthy("tpu", "model-a")
+    assert otel.pool_healthy_gauge.values()[("tpu", "model-a")] == 1
+    assert otel.probe_readmission_counter.values()[("tpu", "model-a")] == 1
+
+    snap = p.snapshot()
+    a = next(t for t in snap["targets"] if t["model"] == "model-a")
+    assert a["ejections"] == 1 and a["readmissions"] == 1 and not a["ejected"]
+
+
+async def test_probe_once_drives_state_from_http_outcomes():
+    """probe_once on scripted /health endpoints: resets and 503s count
+    as failures, 200 as success — zero real sleeps."""
+    clk = VirtualClock()
+    script = (FaultScript()
+              .default("http://a/health", Fault.reset())
+              .default("http://b/health", Fault.ok(b'{"status":"ok"}')))
+    client = FaultInjectingClient(script, clock=clk)
+    p = _prober(eject_after=2, clk=clk, client=client)
+    await p.probe_once()
+    assert p.healthy("tpu", "model-a")
+    await p.probe_once()
+    assert not p.healthy("tpu", "model-a")
+    assert p.healthy("tpu", "model-b")
+    # Recovery: next probe of A succeeds → readmitted.
+    script._defaults["http://a/health"] = Fault.ok(b'{"status":"ok"}')
+    await p.probe_once()
+    assert p.healthy("tpu", "model-a")
+    # A degraded 503 /health counts as a failure too.
+    script._defaults["http://b/health"] = Fault.error(503)
+    await p.probe_once()
+    await p.probe_once()
+    assert not p.healthy("tpu", "model-b")
+
+
+async def test_probe_404_counts_healthy_not_ejected():
+    """Review regression: cloud providers serve no /health endpoint and
+    answer 404 — any sub-500 answer proves the host alive, so
+    default-on probing must never eject them."""
+    clk = VirtualClock()
+    script = (FaultScript()
+              .default("http://a/health", Fault.error(404, body=b"not found"))
+              .default("http://b/health", Fault.error(503)))
+    p = _prober(eject_after=1, clk=clk, client=FaultInjectingClient(script, clock=clk))
+    for _ in range(3):
+        await p.probe_once()
+    assert p.healthy("tpu", "model-a")      # 404: endpoint absent, host alive
+    assert not p.healthy("tpu", "model-b")  # 5xx: genuinely unhealthy
+
+
+async def test_probe_once_dedupes_shared_urls():
+    """Review regression: N pool models of one provider share one
+    /health origin — one GET per distinct URL per round, verdict fanned
+    out to every (provider, model) sharing it."""
+    calls = []
+
+    class CountingClient:
+        async def get(self, url, timeout=None):
+            calls.append(url)
+            raise OSError("down")
+
+    p = HealthProber([ProbeTarget("tpu", "m1", "http://shared/health"),
+                      ProbeTarget("tpu", "m2", "http://shared/health"),
+                      ProbeTarget("ollama", "m3", "http://other/health")],
+                     CountingClient(), clock=VirtualClock(), eject_after=1)
+    await p.probe_once()
+    assert sorted(calls) == ["http://other/health", "http://shared/health"]
+    # The shared verdict reached BOTH models behind the one URL.
+    assert not p.healthy("tpu", "m1") and not p.healthy("tpu", "m2")
+    assert not p.healthy("ollama", "m3")
+
+
+# ---------------------------------------------------------------------------
+# Selector + executor integration: zero establishment attempts
+# ---------------------------------------------------------------------------
+def _router_with_prober(otel=None):
+    from tests.test_stream_continuation import ContinuationUpstream
+
+    from inference_gateway_tpu.api.routes import RouterImpl
+
+    clk = VirtualClock()
+    cfg = Config.load({})
+    registry = ProviderRegistry({"tpu": cfg.providers["tpu"]})
+    res = Resilience(cfg.resilience, otel=otel, clock=clk, rng=random.Random(0))
+    prober = HealthProber([ProbeTarget("tpu", "model-a", "http://a/health"),
+                           ProbeTarget("tpu", "model-b", "http://b/health")],
+                          clock=clk, eject_after=1, otel=otel)
+    res.prober = prober
+    pools = {"pool-model": Pool("pool-model", [Deployment("tpu", "model-a"),
+                                               Deployment("tpu", "model-b")])}
+    selector = Selector(
+        pools,
+        health=lambda d: res.healthy(d) and prober.healthy(d.provider, d.model))
+    upstream = ContinuationUpstream(clk)
+    router = RouterImpl(cfg, registry, upstream, otel=otel, selector=selector,
+                        resilience=res)
+    return router, prober, upstream
+
+
+def _post_chat(stream=False) -> Request:
+    body = {"model": "pool-model", "stream": stream, "temperature": 0,
+            "messages": [{"role": "user", "content": "x"}]}
+    return Request(method="POST", path="/v1/chat/completions", query={},
+                   headers=Headers(), body=json.dumps(body).encode())
+
+
+async def test_ejected_deployment_gets_zero_establishment_attempts():
+    """Acceptance: while ejected, model-a receives no traffic at all —
+    not even a first attempt — and resumes after readmission."""
+    router, prober, upstream = _router_with_prober()
+    prober.record("tpu", "model-a", False)  # eject_after=1
+    assert not prober.healthy("tpu", "model-a")
+
+    for _ in range(4):
+        resp = await router.chat_completions_handler(_post_chat(stream=True))
+        assert resp.status == 200
+        async for _chunk in resp.chunks:
+            pass
+    assert {c["model"] for c in upstream.calls} == {"model-b"}
+
+    # Readmission restores rotation.
+    prober.record("tpu", "model-a", True)
+    upstream.calls.clear()
+    for _ in range(4):
+        resp = await router.chat_completions_handler(_post_chat(stream=True))
+        async for _chunk in resp.chunks:
+            pass
+    assert {c["model"] for c in upstream.calls} == {"model-a", "model-b"}
+
+
+async def test_probe_skip_annotates_wide_event():
+    """With the whole pool ejected the walk skips every candidate —
+    zero establishment attempts, a 503, and the wide event says why."""
+    router, prober, upstream = _router_with_prober()
+    prober.record("tpu", "model-a", False)
+    prober.record("tpu", "model-b", False)
+    req = _post_chat(stream=True)
+    event = {}
+    req.ctx["wide_event"] = event
+    resp = await router.chat_completions_handler(req)
+    assert resp.status == 503
+    assert upstream.calls == []
+    assert event.get("probe_skips") == 2
+    # The error names the ACTUAL gate (all breakers are closed here) so
+    # operators look at the prober, not /debug/status breaker state.
+    assert b"probe-ejected" in resp.body
+
+
+# ---------------------------------------------------------------------------
+# main.py assembly
+# ---------------------------------------------------------------------------
+def test_build_gateway_wires_prober_from_pools(tmp_path):
+    from inference_gateway_tpu.main import build_gateway
+
+    pools_yaml = tmp_path / "pools.yaml"
+    pools_yaml.write_text(
+        "pools:\n"
+        "  - model: pool-x\n"
+        "    deployments:\n"
+        "      - {provider: tpu, model: m1}\n"
+        "      - {provider: ollama, model: m2}\n"
+    )
+    gw = build_gateway(env={
+        "ROUTING_ENABLED": "true", "ROUTING_CONFIG_PATH": str(pools_yaml),
+        "TPU_API_URL": "http://127.0.0.1:9/v1",
+        "OLLAMA_API_URL": "http://127.0.0.1:9/v1",
+    })
+    assert gw.prober is not None
+    assert gw.resilience.prober is gw.prober
+    snap = gw.prober.snapshot()
+    urls = {t["url"] for t in snap["targets"]}
+    assert urls == {"http://127.0.0.1:9/health"}  # /v1 stripped
+    keys = {(t["provider"], t["model"]) for t in snap["targets"]}
+    assert keys == {("tpu", "m1"), ("ollama", "m2")}
+
+    # Kill switch: no prober, selector falls back to breaker health.
+    gw2 = build_gateway(env={
+        "ROUTING_ENABLED": "true", "ROUTING_CONFIG_PATH": str(pools_yaml),
+        "RESILIENCE_PROBE_ENABLED": "false",
+    })
+    assert gw2.prober is None

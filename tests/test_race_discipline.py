@@ -22,6 +22,7 @@ from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
 
 from tests.race_harness import (
     DisciplineViolation,
+    hammer_prober,
     hammer_registry,
     hammer_scheduler_preempt,
     instrument,
@@ -150,4 +151,19 @@ def test_metrics_registry_survives_concurrent_add_and_collect():
     from inference_gateway_tpu.otel.metrics import Registry
 
     errors = hammer_registry(Registry())
+    assert errors == [], errors
+
+
+def test_prober_survives_concurrent_eject_readmit_select():
+    """The health prober's state is written by probe rounds and read by
+    every request's candidate walk (ISSUE 9 satellite): concurrent
+    record/healthy/snapshot must never tear an eject↔readmit transition
+    (counters strictly alternate) or throw."""
+    from inference_gateway_tpu.otel.otel import OpenTelemetry
+    from inference_gateway_tpu.resilience.prober import HealthProber, ProbeTarget
+
+    prober = HealthProber(
+        [ProbeTarget("tpu", f"model-{i}", f"http://m{i}/health") for i in range(4)],
+        eject_after=2, otel=OpenTelemetry())
+    errors = hammer_prober(prober)
     assert errors == [], errors

@@ -78,7 +78,7 @@ async def test_pre_first_byte_death_fails_over_transparently():
     # Recovery counted exactly once, with the hop attribution.
     vals = otel.streams_recovered_counter.values()
     assert sum(vals.values()) == 1
-    assert vals[("fast-model", "ollama", "tpu")] == 1
+    assert vals[("fast-model", "ollama", "tpu", "pre_first_byte")] == 1
     # Both upstream calls carried the SAME trace id.
     tps = [tp for _url, tp in client.traceparents]
     assert len(tps) == 2 and set(tps) == {TRACEPARENT}
@@ -135,6 +135,81 @@ async def test_repeated_pre_byte_deaths_open_breaker_and_exhaust():
     assert sum(otel.streams_recovered_counter.values().values()) == 0
     # Threshold 1: each pre-byte death opened its candidate's circuit.
     assert res.breakers.get("ollama", "model-a").state == OPEN
+
+
+async def test_mid_body_reset_pre_first_byte_recovers():
+    """Fault.mid_body_reset(after_bytes=0): connection reset after
+    headers with zero body bytes — the canonical pre-first-byte death,
+    recovered by re-issuing on the next candidate."""
+    otel = OpenTelemetry()
+    sse_body = b'data: {"id":"x","choices":[{"delta":{"content":"ok"}}]}\n\ndata: [DONE]\n\n'
+    script = (FaultScript()
+              .script("/proxy/ollama/", Fault.mid_body_reset(0))
+              .default("/proxy/tpu/", Fault.ok(sse_body)))
+    router, _res, _client = _make_router(script, otel=otel)
+    resp = await router.chat_completions_handler(_post_chat_stream("fast-model"))
+    body = await _drain(resp)
+    assert sse_body in body
+    vals = otel.streams_recovered_counter.values()
+    assert vals[("fast-model", "ollama", "tpu", "pre_first_byte")] == 1
+
+
+async def test_mid_body_reset_with_unresumable_prefix_truncates():
+    """Fault.mid_body_reset mid-FRAME, before any complete frame reached
+    the client: the continuation has no completion id to resume under
+    (can_resume() is false), so the stream truncates at the reset —
+    never re-issued, never spliced (the ISSUE 7 contract degrades
+    cleanly when the relayed prefix is unreconstructable)."""
+    otel = OpenTelemetry()
+    sse_body = b'data: {"id":"x","choices":[{"delta":{"content":"partial"}}]}\n\ndata: [DONE]\n\n'
+    script = (FaultScript()
+              .script("/proxy/ollama/", Fault.mid_body_reset(20, sse_body))
+              .default("/proxy/tpu/", Fault.ok(b"SHOULD-NEVER-APPEAR")))
+    router, _res, _client = _make_router(script, otel=otel)
+    resp = await router.chat_completions_handler(_post_chat_stream("fast-model"))
+    body = await _drain(resp)
+    assert body == sse_body[:20]
+    assert b"SHOULD-NEVER-APPEAR" not in body
+    assert sum(otel.streams_recovered_counter.values().values()) == 0
+
+
+async def test_streamed_messages_5xx_passes_through_verbatim():
+    """Review regression: a streamed /v1/messages upstream 5xx keeps the
+    EXACT body bytes and Content-Type (non-UTF-8 HTML must not be
+    mangled to U+FFFD or relabeled application/json) while still
+    charging the breaker."""
+    from inference_gateway_tpu.api.routes import RouterImpl
+
+    html = b"<html>bad gateway \xff</html>"  # invalid UTF-8 on purpose
+    script = FaultScript().script(
+        "api.anthropic.com",
+        Fault("status", status=502, body=html,
+              headers={"Content-Type": "text/html"}))
+    clk = VirtualClock()
+    cfg = Config.load({"ANTHROPIC_API_KEY": "k"})
+    registry = ProviderRegistry({"anthropic": cfg.providers["anthropic"]})
+    res = Resilience(cfg.resilience, clock=clk, rng=random.Random(0))
+    router = RouterImpl(cfg, registry, FaultInjectingClient(script, clock=clk),
+                        resilience=res)
+    body = {"model": "anthropic/claude-3", "stream": True, "max_tokens": 4,
+            "messages": [{"role": "user", "content": "x"}]}
+    req = Request(method="POST", path="/v1/messages", query={},
+                  headers=Headers(), body=json.dumps(body).encode())
+    resp = await router.messages_handler(req)
+    assert resp.status == 502
+    assert resp.body == html
+    assert resp.headers.get("Content-Type") == "text/html"
+    assert res.breakers.get("anthropic", "claude-3")._consecutive_failures >= 1
+
+    # Review regression: a sub-500 non-SSE passthrough must record
+    # breaker SUCCESS (the upstream is alive), like the buffered path's
+    # result_ok — or a half-open circuit never closes on an upstream
+    # answering stream:true with buffered/4xx responses.
+    script.script("api.anthropic.com",
+                  Fault("status", status=404, body=b'{"type":"error"}'))
+    resp2 = await router.messages_handler(req)
+    assert resp2.status == 404
+    assert res.breakers.get("anthropic", "claude-3")._consecutive_failures == 0
 
 
 async def test_non_streaming_unaffected():
