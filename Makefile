@@ -1,12 +1,24 @@
 # Developer entry points (reference parity: Taskfile.yml).
 
-.PHONY: generate check test test-fast bench bench-gateway serve gateway lint
+.PHONY: generate check test test-fast bench bench-gateway serve gateway lint graftlint typecheck
 
 generate:  ## regenerate docs/env examples from openapi.yaml + drift check
 	python -m inference_gateway_tpu.codegen
 
 check:     ## spec<->code drift guards only
 	python -m inference_gateway_tpu.codegen -type Check
+
+graftlint: ## project-invariant static analysis (docs/static-analysis.md)
+	python -m graftlint inference_gateway_tpu
+
+lint: graftlint check  ## graftlint + spec<->code drift guards, one command
+
+typecheck: ## mypy --strict over the typed core (module list: pyproject [tool.mypy])
+	@if python -c "import mypy" 2>/dev/null; then \
+		python -m mypy; \
+	else \
+		echo "mypy not installed in this environment; skipping (the typed-core module list lives in pyproject.toml [tool.mypy])"; \
+	fi
 
 test:      ## full suite on a virtual 8-device CPU mesh
 	python -m pytest tests/ -q

@@ -1,0 +1,45 @@
+"""graftlint — project-invariant static analysis for inference-gateway-tpu.
+
+Nine PRs of resilience, overload, observability, and serving
+fault-tolerance work accreted a set of codebase invariants that were
+enforced only at runtime (fuzzers, race hammers, review rounds). Each
+checker here encodes one of those invariants as an AST pass, so the bug
+classes the PR 2 probe-slot leak, the PR 4 stall watchdog, and the PR 7
+identity guards were late catches of fail at lint time instead:
+
+- ``async-blocking``     — blocking calls reachable inside ``async def``
+  bodies (the static counterpart of the event-loop stall watchdog).
+- ``clock-discipline``   — direct ``time.time()`` / ``time.monotonic()``
+  / ``time.sleep()`` outside the designated clock implementation and the
+  profiling/logger daemon-thread allowlist; everything else must take
+  the PR 1 injectable clock.
+- ``resource-release``   — a declarative registry of acquire→release API
+  pairs (admission ticket, breaker half-open probe slot, KV pages,
+  tracer spans) checked for exception-path coverage.
+- ``cross-thread-state`` — attributes mutated both on a class's worker
+  thread and from event-loop/public methods must be lock-protected.
+- ``jax-hot-path``       — host syncs (``.item()``, ``np.asarray``,
+  ``jax.device_get``, ``block_until_ready``) inside jitted step
+  functions and the engine/scheduler submit path.
+- ``telemetry-noop-drift`` — every ``record_*``/``set_*``/``remove_*``
+  recorder on ``OpenTelemetry`` must be overridden by ``NoopTelemetry``.
+
+Run ``python -m graftlint <paths>``; suppress an intentional violation
+with a trailing ``# graftlint: disable=<id>`` pragma (give a reason),
+or grandfather pre-existing findings in ``graftlint-baseline.json``.
+See docs/static-analysis.md for the catalog and workflow.
+
+stdlib-only by design: ``ast`` + ``json``, no third-party deps.
+"""
+
+from graftlint.core import (  # noqa: F401
+    Finding,
+    ParsedModule,
+    parse_module,
+    parse_source,
+    run_checkers,
+    run_paths,
+    run_source,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,96 @@
+"""CLI: ``python -m graftlint [paths...]``.
+
+Exit codes: 0 clean (all findings baselined or none), 1 new findings,
+2 usage / parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from graftlint import baseline as baseline_mod
+from graftlint.checkers import CHECKERS
+from graftlint.core import run_paths
+
+DEFAULT_BASELINE = "graftlint-baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m graftlint",
+        description="Project-invariant static analysis for inference-gateway-tpu.")
+    parser.add_argument("paths", nargs="*", default=["inference_gateway_tpu"],
+                        help="files or directories to lint (default: inference_gateway_tpu)")
+    parser.add_argument("--root", default=".", help="repo root (paths and the "
+                        "baseline are resolved against it)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON (default: {DEFAULT_BASELINE} at --root if present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write all current findings to the baseline file and exit 0")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated checker ids to run (default: all)")
+    parser.add_argument("--list-checkers", action="store_true")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    if args.list_checkers:
+        for checker_id, doc, _check in CHECKERS:
+            print(f"{checker_id:22s} {doc}")
+        return 0
+
+    root = Path(args.root)
+    if not args.paths:
+        args.paths = ["inference_gateway_tpu"]
+    select = set(args.select.split(",")) if args.select else None
+    if select is not None:
+        known = {cid for cid, _d, _c in CHECKERS}
+        unknown = select - known
+        if unknown:
+            print(f"unknown checker ids: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    findings, errors = run_paths(args.paths, root, select=select)
+    for err in errors:
+        print(f"parse error: {err}", file=sys.stderr)
+
+    baseline_path = root / (args.baseline or DEFAULT_BASELINE)
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    base = baseline_mod.load(baseline_path) if not args.no_baseline else None
+    result = baseline_mod.apply(findings, base or baseline_mod.Counter())
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in result.new],
+            "baselined": [f.__dict__ for f in result.baselined],
+            "stale_baseline_keys": result.stale,
+        }, indent=2))
+    else:
+        for f in result.new:
+            print(f.render())
+        if result.baselined:
+            print(f"-- {len(result.baselined)} baselined finding(s) suppressed "
+                  f"({baseline_path.name}); burn them down", file=sys.stderr)
+        for key in result.stale:
+            print(f"-- stale baseline entry (fixed? delete it): {key}", file=sys.stderr)
+        if result.new:
+            print(f"{len(result.new)} new finding(s). Fix them, add a reasoned "
+                  "'# graftlint: disable=<id>' pragma, or (pre-existing debt "
+                  "only) regenerate the baseline.", file=sys.stderr)
+
+    if errors:
+        return 2
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

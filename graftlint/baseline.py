@@ -1,0 +1,59 @@
+"""Baseline workflow: grandfather pre-existing findings, burn them down.
+
+The baseline is a committed JSON file mapping a finding's line-free key
+(``path::checker::symbol::message``) to a count. A run subtracts matched
+findings from the baseline; whatever remains is new and fails the gate.
+Entries the run no longer produces are *stale* — fixed violations whose
+baseline lines should be deleted (reported so burn-down is visible, but
+not a failure: a checker refinement must not break the gate for every
+branch at once).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+
+from graftlint.core import Finding
+
+
+@dataclass
+class BaselineResult:
+    new: list[Finding]          # findings not covered by the baseline
+    baselined: list[Finding]    # findings the baseline absorbed
+    stale: list[str]            # baseline keys no current finding matches
+
+
+def load(path: Path) -> Counter:
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return Counter({str(k): int(v) for k, v in data.get("findings", {}).items()})
+
+
+def save(path: Path, findings: list[Finding]) -> None:
+    counts = Counter(f.key() for f in findings)
+    payload = {
+        "comment": (
+            "graftlint grandfathered findings — burn down, never grow. "
+            "Keys are path::checker::symbol::message (line-free). "
+            "Regenerate with: python -m graftlint --write-baseline"),
+        "findings": dict(sorted(counts.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def apply(findings: list[Finding], baseline: Counter) -> BaselineResult:
+    remaining = Counter(baseline)
+    new: list[Finding] = []
+    absorbed: list[Finding] = []
+    for f in findings:
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            absorbed.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, v in remaining.items() if v > 0)
+    return BaselineResult(new=new, baselined=absorbed, stale=stale)

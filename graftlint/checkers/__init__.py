@@ -1,0 +1,37 @@
+"""Checker registry. Each entry: (id, one-line doc, check callable)."""
+
+from __future__ import annotations
+
+from graftlint.checkers.async_blocking import check as _async_blocking
+from graftlint.checkers.clock_discipline import check as _clock_discipline
+from graftlint.checkers.cross_thread_state import check as _cross_thread_state
+from graftlint.checkers.jax_hot_path import check as _jax_hot_path
+from graftlint.checkers.resource_release import check as _resource_release
+from graftlint.checkers.telemetry_noop_drift import check as _telemetry_noop_drift
+
+CHECKERS = [
+    ("async-blocking",
+     "blocking calls (time.sleep, sync I/O, Future.result, unbounded "
+     "queue.get) reachable inside async def bodies",
+     _async_blocking),
+    ("clock-discipline",
+     "direct time.time/time.monotonic/time.sleep outside the injectable-"
+     "clock implementation and the profiling/logger allowlist",
+     _clock_discipline),
+    ("resource-release",
+     "acquire/release API pairs (tickets, probe slots, KV pages, spans) "
+     "must cover every exception path (try/finally or handoff)",
+     _resource_release),
+    ("cross-thread-state",
+     "attributes mutated both on a worker thread and from other threads "
+     "must be lock-protected on every write",
+     _cross_thread_state),
+    ("jax-hot-path",
+     "host syncs (.item, np.asarray, jax.device_get, block_until_ready) "
+     "in jitted step functions and the engine/scheduler submit path",
+     _jax_hot_path),
+    ("telemetry-noop-drift",
+     "every record_*/set_*/remove_* on OpenTelemetry must be overridden "
+     "by NoopTelemetry",
+     _telemetry_noop_drift),
+]

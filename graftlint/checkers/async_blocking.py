@@ -1,0 +1,186 @@
+"""async-blocking: blocking calls reachable inside ``async def`` bodies.
+
+The static counterpart of the PR 4 ``EventLoopWatchdog``: that watchdog
+catches an event-loop stall at runtime with a mid-stall stack; this
+checker catches the call that would cause one before it ships.
+
+Flagged inside async functions (and sync module-local helpers they call
+— one module-local transitive hop set, computed to a fixpoint):
+
+- ``time.sleep`` (use ``await clock.sleep(...)``)
+- synchronous subprocess / socket / urllib / os.system calls
+- ``open(...)`` — synchronous file I/O on the loop
+- ``fut.result(...)`` — blocking unless the future is known done; a
+  ``fut.done()`` guard in the same function exempts it
+- ``q.get()`` / ``q.get(True)`` / ``q.get(block=True)`` not awaited —
+  an unbounded blocking ``queue.Queue.get``; ``.get(timeout=...)`` is
+  bounded and allowed (``dict.get(key)`` never matches: it always takes
+  a positional key)
+
+Awaited calls are never flagged (``await q.get()`` on an asyncio.Queue
+is the correct form).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from graftlint.core import (
+    Finding,
+    ParsedModule,
+    dotted_name,
+    enclosing_function,
+    flag,
+    parent,
+)
+
+CHECKER = "async-blocking"
+
+# Dotted module-level calls that block the calling thread.
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep() blocks the event loop; await clock.sleep()",
+    "subprocess.run": "subprocess.run() blocks; use asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess.call() blocks; use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "subprocess.check_call() blocks",
+    "subprocess.check_output": "subprocess.check_output() blocks",
+    "subprocess.getoutput": "subprocess.getoutput() blocks",
+    "os.system": "os.system() blocks",
+    "os.waitpid": "os.waitpid() blocks",
+    "socket.create_connection": "synchronous socket connect blocks; use asyncio.open_connection",
+    "socket.getaddrinfo": "synchronous DNS resolution blocks; use loop.getaddrinfo",
+    "urllib.request.urlopen": "urllib.request.urlopen() blocks; use the netio client",
+}
+
+
+def _is_awaited(call: ast.Call) -> bool:
+    """True when the call is under an ``await`` in the same statement —
+    directly (``await q.get()``) or through a wrapper
+    (``await asyncio.wait_for(q.get(), t)``, ``await clock.wait_for(...)``):
+    either way the event loop, not the thread, does the waiting."""
+    cur = parent(call)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if isinstance(cur, ast.Await):
+            return True
+        cur = parent(cur)
+    return False
+
+
+def _receivers_with_done_guard(fn: ast.AST) -> set[str]:
+    """Receiver dotted names with an ``X.done()`` call in ``fn`` — their
+    ``X.result()`` is a non-blocking read of a completed future."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "done"):
+            recv = dotted_name(node.func.value)
+            if recv:
+                out.add(recv)
+    return out
+
+
+def _direct_blocking(call: ast.Call, done_guarded: set[str]) -> str | None:
+    """Reason string when ``call`` is a blocking primitive, else None."""
+    func = call.func
+    dotted = dotted_name(func)
+    if dotted in BLOCKING_DOTTED:
+        return BLOCKING_DOTTED[dotted]
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "synchronous file I/O on the event loop; use a thread or pre-read"
+    if isinstance(func, ast.Attribute):
+        recv = dotted_name(func.value)
+        if func.attr == "result":
+            if recv is not None and recv in done_guarded:
+                return None
+            return ("Future.result() blocks the loop until the future "
+                    "resolves; await it, or guard with .done()")
+        if func.attr == "get" and not call.args and not call.keywords:
+            return ("unbounded queue.get() blocks the loop; await an "
+                    "asyncio.Queue or pass timeout=")
+        if func.attr == "get" and (
+            any(isinstance(a, ast.Constant) and a.value is True for a in call.args[:1])
+            or any(k.arg == "block" and isinstance(k.value, ast.Constant)
+                   and k.value.value is True for k in call.keywords)
+        ) and not any(k.arg == "timeout" for k in call.keywords) and len(call.args) < 2:
+            return "blocking queue.get without timeout blocks the loop"
+        if func.attr == "join" and not call.args and not call.keywords:
+            return ("unbounded .join() blocks the loop (thread/process "
+                    "join takes no required args; str.join takes one)")
+    return None
+
+
+def _local_callables(tree: ast.Module):
+    """Maps for one-module call resolution: module-level functions by
+    name, and methods by (class, name)."""
+    functions: dict[str, ast.FunctionDef] = {}
+    methods: dict[tuple[str, str], ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    methods[(node.name, item.name)] = item
+    return functions, methods
+
+
+def _resolve_local(call: ast.Call, cls_name: str | None, functions, methods):
+    func = call.func
+    if isinstance(func, ast.Name):
+        return functions.get(func.id)
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        if func.value.id == "self" and cls_name is not None:
+            return methods.get((cls_name, func.attr))
+        return methods.get((func.value.id, func.attr))
+    return None
+
+
+def check(mod: ParsedModule) -> list[Finding]:
+    out: list[Finding] = []
+    functions, methods = _local_callables(mod.tree)
+
+    # Which sync local callables (transitively) contain a blocking
+    # primitive — fixpoint over the one-module call graph.
+    def cls_of(fn: ast.AST) -> str | None:
+        p = parent(fn)
+        return p.name if isinstance(p, ast.ClassDef) else None
+
+    all_sync = list(functions.values()) + list(methods.values())
+    blocking: set[ast.FunctionDef] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fn in all_sync:
+            if fn in blocking:
+                continue
+            guarded = _receivers_with_done_guard(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or _is_awaited(node):
+                    continue
+                if enclosing_function(node) is not fn:
+                    continue  # belongs to a nested def — judged separately
+                callee = _resolve_local(node, cls_of(fn), functions, methods)
+                if _direct_blocking(node, guarded) or (
+                        isinstance(callee, ast.FunctionDef) and callee in blocking):
+                    blocking.add(fn)
+                    changed = True
+                    break
+
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        guarded = _receivers_with_done_guard(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or _is_awaited(node):
+                continue
+            if enclosing_function(node) is not fn:
+                continue
+            reason = _direct_blocking(node, guarded)
+            if reason is not None:
+                flag(out, mod, CHECKER, node, f"blocking call in async def: {reason}")
+                continue
+            callee = _resolve_local(node, cls_of(fn), functions, methods)
+            if isinstance(callee, ast.FunctionDef) and callee in blocking:
+                flag(out, mod, CHECKER, node,
+                     f"call into '{callee.name}' which blocks (contains a "
+                     "blocking primitive); run it in a thread/executor")
+    return out

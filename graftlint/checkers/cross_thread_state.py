@@ -1,0 +1,169 @@
+"""cross-thread-state: dual-thread attribute mutation must hold a lock.
+
+Encodes the PR 7/9 race-hammer discipline statically. For every class
+that starts its own worker thread (``threading.Thread(target=self.X)``),
+the checker computes the set of methods reachable from the thread entry
+(the *thread side*) and the set of instance attributes each side
+mutates. An attribute written both from the thread side and from other
+methods (event-loop code, public API called by the server) is shared
+mutable state: every write to it must happen inside a ``with
+self.<lock>`` block (a ``threading.Lock``/``RLock``/``Condition``
+assigned in ``__init__``, or any attribute whose name says lock/cond/
+wake/mutex), or be handed off via ``call_soon_threadsafe``.
+
+Reads are not flagged (the project's GIL-atomic snapshot reads — gauge
+sampling, ``/debug/status`` — are a documented idiom); *unlocked
+writes* to dual-side attributes are the bug class this catches.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from graftlint.core import Finding, ParsedModule, dotted_name, flag, parent
+
+CHECKER = "cross-thread-state"
+
+_LOCKISH_NAME = ("lock", "cond", "wake", "mutex")
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+
+
+def _methods(cls: ast.ClassDef) -> dict[str, ast.AST]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _thread_entries(cls: ast.ClassDef, methods: dict[str, ast.AST]) -> set[str]:
+    """Method names passed as ``target=`` to ``threading.Thread`` within
+    this class (``self.X``, ``ClassName.X``, or a bare local name)."""
+    entries: set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Call)
+                and (dotted_name(node.func) or "").endswith("Thread")):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            d = dotted_name(kw.value) or ""
+            tail = d.rsplit(".", 1)[-1]
+            if tail in methods:
+                entries.add(tail)
+    return entries
+
+
+def _self_calls(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            out.add(node.func.attr)
+    return out
+
+
+def _closure(entries: set[str], methods: dict[str, ast.AST]) -> set[str]:
+    seen = set(entries)
+    work = list(entries)
+    while work:
+        m = work.pop()
+        fn = methods.get(m)
+        if fn is None:
+            continue
+        for callee in _self_calls(fn):
+            if callee in methods and callee not in seen:
+                seen.add(callee)
+                work.append(callee)
+    return seen
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted_name(node.value.func) or ""
+            if ctor in _LOCK_CTORS or ctor.split(".")[-1] in (
+                    "Lock", "RLock", "Condition"):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        locks.add(t.attr)
+    return locks
+
+
+def _under_lock(node: ast.AST, locks: set[str]) -> bool:
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                d = dotted_name(item.context_expr) or ""
+                # ``with self._lock:`` / ``with self._wake:`` /
+                # ``with x._lock.acquire_timeout():``-style receivers.
+                parts = d.split(".")
+                if len(parts) >= 2 and (
+                        parts[1] in locks
+                        or any(tok in parts[-1].lower() for tok in _LOCKISH_NAME)
+                        or any(tok in parts[1].lower() for tok in _LOCKISH_NAME)):
+                    return True
+        cur = parent(cur)
+    return False
+
+
+def _self_writes(fn: ast.AST) -> list[tuple[str, ast.AST]]:
+    """(attr, node) for every ``self.<attr> = ...`` / ``self.<attr> +=``
+    in ``fn`` (nested defs included: they run on the same side)."""
+    writes: list[tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            # Direct attr writes AND container-slot writes on an attr
+            # (``self.metrics[k] += 1`` mutates shared state too).
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                writes.append((t.attr, node))
+    return writes
+
+
+def check(mod: ParsedModule) -> list[Finding]:
+    out: list[Finding] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = _methods(cls)
+        entries = _thread_entries(cls, methods)
+        if not entries:
+            continue
+        thread_side = _closure(entries, methods)
+        locks = _lock_attrs(cls)
+
+        per_side: dict[str, dict[bool, list[ast.AST]]] = {}
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue  # construction precedes the thread: no race
+            is_thread = name in thread_side
+            for attr, node in _self_writes(fn):
+                per_side.setdefault(attr, {True: [], False: []})[is_thread].append(node)
+
+        for attr, sides in sorted(per_side.items()):
+            if not sides[True] or not sides[False]:
+                continue  # single-side mutation: ownership is clear
+            for node in sides[True] + sides[False]:
+                if not _under_lock(node, locks):
+                    flag(out, mod, CHECKER, node,
+                         f"unlocked write to '{cls.name}.{attr}', which is "
+                         f"mutated both on the worker thread "
+                         f"({', '.join(sorted(n for n in thread_side if n in methods))}) "
+                         f"and from other threads — hold the lock or hand "
+                         f"off via call_soon_threadsafe")
+    return out

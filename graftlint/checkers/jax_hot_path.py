@@ -1,0 +1,115 @@
+"""jax-hot-path: no host synchronization in the engine step path.
+
+ROADMAP item 2 (desynchronized decode) is about removing host↔device
+round-trips from the steady-state serving loop; this checker keeps new
+ones from creeping in — "compile-time elimination of synchronization
+mistakes" (Kernel Looping, arxiv 2410.23668) applied to the host side.
+
+Two scopes:
+
+1. **Jitted step functions** — any function decorated with ``jax.jit``
+   / ``partial(jax.jit, ...)``: host syncs (``.item()``,
+   ``.block_until_ready()``, ``jax.device_get``, ``np.asarray``,
+   ``float()``/``int()`` on expressions) are trace-time errors or
+   silent constant-folding hazards; all are flagged.
+
+2. **Submit-path functions** (named, host-side): the functions whose
+   contract is "dispatch without waiting" — ``Engine.decode_chunk_submit``
+   / ``Engine._scatter_admission`` and ``Scheduler._submit_chunk`` /
+   ``Scheduler.run`` / ``Scheduler._process_handles``. There, only the
+   genuine sync primitives are banned: ``.item()``,
+   ``.block_until_ready()``, ``jax.device_get``, and ``np.asarray`` /
+   ``np.array`` **on anything** — a submit function that materializes a
+   device value serializes the pipeline it exists to overlap. (Fetch
+   functions — ``decode_chunk_fetch``, ``prefill_fetch`` — are the
+   designated sync points and are not in scope.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from graftlint.core import Finding, ParsedModule, dotted_name, flag
+
+CHECKER = "jax-hot-path"
+
+# relpath suffix -> function names forming the submit path.
+SUBMIT_SCOPES = {
+    "serving/engine.py": {
+        "decode_chunk_submit", "_scatter_admission",
+    },
+    "serving/scheduler.py": {
+        "_submit_chunk", "run", "_process_handles",
+    },
+}
+
+_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_DOTTED = {"jax.device_get"}
+# jnp.asarray is NOT here: it dispatches asynchronously (device upload);
+# only host-side numpy materialization forces a blocking readback.
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _is_jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        d = dotted_name(dec)
+        if d in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func)
+            if d in ("jax.jit", "jit"):
+                return True
+            if d in ("partial", "functools.partial") and dec.args:
+                first = dotted_name(dec.args[0])
+                if first in ("jax.jit", "jit"):
+                    return True
+    return False
+
+
+def _submit_scope_names(mod: ParsedModule) -> set[str]:
+    for suffix, names in SUBMIT_SCOPES.items():
+        if mod.path.endswith(suffix):
+            return names
+    return set()
+
+
+def _scan(fn: ast.AST, mod: ParsedModule, out: list[Finding], *,
+          jitted: bool) -> None:
+    where = ("inside a jitted step function" if jitted
+             else "in a submit-path function (dispatch must not wait)")
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS \
+                and not node.args:
+            flag(out, mod, CHECKER, node,
+                 f"host sync '.{func.attr}()' {where}")
+            continue
+        d = dotted_name(func)
+        if d in _SYNC_DOTTED:
+            flag(out, mod, CHECKER, node, f"host sync '{d}(...)' {where}")
+            continue
+        if d in _NP_SYNC:
+            flag(out, mod, CHECKER, node,
+                 f"'{d}(...)' {where} — materializing a device value "
+                 f"here blocks until the computation finishes")
+            continue
+        if jitted and isinstance(func, ast.Name) and func.id in ("float", "int") \
+                and node.args and not isinstance(node.args[0], ast.Constant):
+            flag(out, mod, CHECKER, node,
+                 f"'{func.id}(...)' on a traced value {where} — a "
+                 f"concretization error at trace time")
+
+
+def check(mod: ParsedModule) -> list[Finding]:
+    out: list[Finding] = []
+    submit_names = _submit_scope_names(mod)
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _is_jit_decorated(fn):
+            _scan(fn, mod, out, jitted=True)
+        elif fn.name in submit_names:
+            _scan(fn, mod, out, jitted=False)
+    return out

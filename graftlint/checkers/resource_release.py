@@ -1,0 +1,286 @@
+"""resource-release: acquire→release pairs cover every exception path.
+
+A declarative registry of the project's acquire/release API pairs — the
+bug class behind the PR 2 probe-slot leak (a half-open breaker admission
+whose release was skipped on an early exit wedged the breaker half-open
+with zero probe capacity, found only by the seeded fault fuzz).
+
+Pair shapes:
+
+- ``result``:   the acquire returns an owner object released via a
+  method on the *result* (``ticket = await overload.admit(...)`` →
+  ``ticket.release()``).
+- ``receiver``: the release is owed to the *receiver* that granted the
+  acquire (``ok, slot = breaker.admit()`` → ``breaker.release()`` or a
+  recorded outcome). Only checked when the receiver is a plain local
+  name other than ``self``: long-lived ``self.X`` receivers (e.g. the
+  engine's page allocator) hand ownership across functions by design,
+  and a class delegating to its own acquire is the implementation.
+- ``arg``:      the acquired object is passed back to a release call
+  (``span = tracer.start_span(...)`` → ``tracer.end_span(span)``).
+
+Verdicts per acquire site, in order:
+
+1. ownership transfer (result returned / yielded / stored on an object
+   or container / passed to another call) — not this function's leak;
+2. no release reference at all — flagged "never released";
+3. releases exist but none inside a ``finally`` / ``except`` handler /
+   ``with`` — flagged "happy path only" *if* the region between acquire
+   and the last release can actually raise (contains calls / awaits /
+   raises); straight-line post-hoc pairs (backdated span
+   materialization) pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from graftlint.core import (
+    Finding,
+    ParsedModule,
+    dotted_name,
+    enclosing_function,
+    flag,
+    parent,
+)
+
+CHECKER = "resource-release"
+
+
+@dataclass(frozen=True)
+class Pair:
+    acquire: str           # method name at the acquire call site
+    release: str           # method name that gives the resource back
+    mode: str              # "result" | "receiver" | "arg"
+    awaited: bool | None   # acquire must (not) be awaited; None = either
+    what: str              # human name for messages
+
+
+PAIRS = (
+    # Admission ticket (resilience/overload.py): ``await admit()`` returns
+    # a Ticket that MUST be released when the response/stream finishes.
+    Pair("admit", "release", mode="result", awaited=True, what="admission ticket"),
+    # Breaker half-open probe slot (resilience/breaker.py): a sync
+    # ``admit()`` may consume a probe slot owed back via ``release()``
+    # on the same breaker when no outcome is recorded.
+    Pair("admit", "release", mode="receiver", awaited=False,
+         what="breaker half-open probe slot"),
+    # Tracer spans (otel/tracing.py): an unfinished span is never
+    # exported — end it on every path.
+    Pair("start_span", "end_span", mode="arg", awaited=None, what="tracer span"),
+    # KV pages (serving/kv_cache.py): pages adopted from the prefix
+    # cache must be released if the adopting request fails.
+    Pair("adopt_pages", "release", mode="receiver", awaited=False,
+         what="adopted KV pages"),
+)
+
+# An outcome-recording call also settles a receiver-mode acquire (the
+# breaker pair: record_success/record_failure consume the probe slot).
+RECEIVER_SETTLERS = frozenset({"record_success", "record_failure"})
+
+
+def _in_handler_or_finally(node: ast.AST, owner: str | None = None) -> bool:
+    """Is ``node`` lexically inside a finally block, an except handler,
+    or a ``with`` block whose context manager IS the owned resource?
+
+    An unrelated ``with`` (``with self._lock:`` around the release) is
+    NOT exception-path coverage — the exception that matters happens
+    *outside* that block, between acquire and release (code-review
+    finding: lock-wrapped releases must not blind the check)."""
+    child: ast.AST = node
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.Try) and (
+                child in cur.handlers or child in cur.finalbody):
+            return True
+        if isinstance(cur, (ast.With, ast.AsyncWith)) and owner is not None:
+            for item in cur.items:
+                d = dotted_name(item.context_expr)
+                if d == owner or (d or "").startswith(owner + "."):
+                    return True  # ``with ticket:`` — CM releases it
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        child = cur
+        cur = parent(cur)
+    return False
+
+
+def _assigned_name(call: ast.Call) -> str | None:
+    """Local name the call's result is bound to, else None."""
+    p = parent(call)
+    if isinstance(p, ast.Await):
+        p = parent(p)
+    if isinstance(p, ast.Assign) and len(p.targets) == 1:
+        t = p.targets[0]
+        if isinstance(t, ast.Name):
+            return t.id
+    return None
+
+
+def _unbound_escapes(call: ast.Call) -> bool:
+    """An acquire whose result is not name-bound still transfers
+    ownership when consumed by an enclosing expression (returned,
+    yielded, passed to a call, collected, compared)."""
+    p = parent(call)
+    if isinstance(p, ast.Await):
+        p = parent(p)
+    if isinstance(p, ast.Expr):
+        return False  # bare statement: result dropped on the floor
+    if isinstance(p, ast.Assign):
+        return any(not isinstance(t, ast.Name) for t in p.targets)
+    return True  # Return/Yield/Call/Tuple/keyword/comparison/...
+
+
+def _value_escapes(fn: ast.AST, name: str, skip: set[int]) -> bool:
+    """Does ``name`` leave this function's ownership (returned, yielded,
+    stored into an attribute/container, passed to a call)? ``skip``
+    excludes the release references already accounted for."""
+    for n in ast.walk(fn):
+        if not (isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Load)):
+            continue
+        if id(n) in skip:
+            continue
+        p = parent(n)
+        if isinstance(p, (ast.Return, ast.Yield, ast.YieldFrom, ast.Tuple,
+                          ast.List, ast.Dict, ast.Set, ast.keyword,
+                          ast.Starred, ast.Call, ast.Subscript)):
+            return True
+        if isinstance(p, ast.Assign) and any(
+                not isinstance(t, ast.Name) for t in p.targets):
+            return True
+    return False
+
+
+def _scope_can_raise(fn: ast.AST, start_line: int, end_line: int) -> bool:
+    """Any call/await/raise strictly between the acquire and the last
+    release — straight-line attribute plumbing can't meaningfully
+    fail, so backdated span materialization and the like pass."""
+    for node in ast.walk(fn):
+        ln = getattr(node, "lineno", None)
+        if ln is None or not (start_line < ln < end_line):
+            continue
+        if isinstance(node, (ast.Await, ast.Raise)):
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            if not d.endswith((".append", ".items", ".setdefault")):
+                return True
+    return False
+
+
+def check(mod: ParsedModule) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+            continue
+        for pair in PAIRS:
+            if node.func.attr != pair.acquire:
+                continue
+            awaited = isinstance(parent(node), ast.Await)
+            if pair.awaited is not None and awaited != pair.awaited:
+                continue
+            fn = enclosing_function(node)
+            if fn is None:
+                continue  # module-level acquire: out of scope
+            if pair.mode == "result":
+                _check_result(out, mod, fn, node, pair)
+            elif pair.mode == "receiver":
+                _check_receiver(out, mod, fn, node, pair)
+            else:
+                _check_arg(out, mod, fn, node, pair)
+    return out
+
+
+def _release_attr_refs(fn: ast.AST, name: str, attr: str) -> list[ast.Attribute]:
+    """All ``<name>.<attr>`` attribute nodes in ``fn`` (calls or bare
+    method references handed off as callbacks)."""
+    return [
+        n for n in ast.walk(fn)
+        if isinstance(n, ast.Attribute) and n.attr == attr
+        and isinstance(n.value, ast.Name) and n.value.id == name
+    ]
+
+
+def _check_result(out, mod, fn, call: ast.Call, pair: Pair) -> None:
+    name = _assigned_name(call)
+    if name is None:
+        if not _unbound_escapes(call):
+            flag(out, mod, CHECKER, call,
+                 f"{pair.what} acquired via .{pair.acquire}() but the result "
+                 f"is dropped — nothing can ever call .{pair.release}()")
+        return
+    refs = _release_attr_refs(fn, name, pair.release)
+    if not refs:
+        skip = {id(r.value) for r in refs}
+        if not _value_escapes(fn, name, skip):
+            flag(out, mod, CHECKER, call,
+                 f"{pair.what} '{name}' acquired but never released in this "
+                 f"function and never handed off — leaks on every path")
+        return
+    for ref in refs:
+        p = parent(ref)
+        if not (isinstance(p, ast.Call) and p.func is ref):
+            return  # bare ``x.release`` handed off as a callback
+        if _in_handler_or_finally(ref, name):
+            return
+    last = max(getattr(r, "end_lineno", r.lineno) for r in refs)
+    if _scope_can_raise(fn, call.lineno, last):
+        flag(out, mod, CHECKER, call,
+             f"{pair.what} '{name}' released only on the happy path — an "
+             f"exception between acquire and release leaks it; wrap the "
+             f"release in try/finally (or release in the except path)")
+
+
+def _check_receiver(out, mod, fn, call: ast.Call, pair: Pair) -> None:
+    recv = call.func.value
+    if not isinstance(recv, ast.Name) or recv.id in ("self", "cls"):
+        return  # long-lived/self receivers own the resource elsewhere
+    name = recv.id
+    settlers: list[ast.Attribute] = []
+    for attr in RECEIVER_SETTLERS | {pair.release}:
+        settlers.extend(_release_attr_refs(fn, name, attr))
+    if not settlers:
+        flag(out, mod, CHECKER, call,
+             f"{pair.what}: '{name}.{pair.acquire}()' may consume a slot "
+             f"but this function never calls '{name}.{pair.release}()' or "
+             f"records an outcome — the slot leaks if no outcome follows")
+        return
+    if any(_in_handler_or_finally(n, name) for n in settlers):
+        return
+    last = max(getattr(n, "end_lineno", n.lineno) for n in settlers)
+    if _scope_can_raise(fn, call.lineno, last):
+        flag(out, mod, CHECKER, call,
+             f"{pair.what}: '{name}.{pair.acquire}()' settled only on the "
+             f"happy path — release or record an outcome in try/finally")
+
+
+def _check_arg(out, mod, fn, call: ast.Call, pair: Pair) -> None:
+    name = _assigned_name(call)
+    if name is None:
+        if not _unbound_escapes(call):
+            flag(out, mod, CHECKER, call,
+                 f"{pair.what} from .{pair.acquire}() dropped — it can "
+                 f"never be passed to .{pair.release}()")
+        return
+    releases = [
+        n for n in ast.walk(fn)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == pair.release
+        and any(isinstance(a, ast.Name) and a.id == name for a in n.args)
+    ]
+    if not releases:
+        skip: set[int] = set()
+        if not _value_escapes(fn, name, skip):
+            flag(out, mod, CHECKER, call,
+                 f"{pair.what} '{name}' is never passed to .{pair.release}() "
+                 f"and never handed off — it will never be finalized")
+        return
+    if any(_in_handler_or_finally(r, name) for r in releases):
+        return
+    last = max(getattr(r, "end_lineno", r.lineno) for r in releases)
+    if _scope_can_raise(fn, call.lineno, last):
+        flag(out, mod, CHECKER, call,
+             f"{pair.what} '{name}' finalized only on the happy path — an "
+             f"exception before .{pair.release}() loses it; use try/finally")

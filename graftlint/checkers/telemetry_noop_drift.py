@@ -1,0 +1,54 @@
+"""telemetry-noop-drift: NoopTelemetry must override every recorder.
+
+The lint-time form of ``tests/test_metric_lint.py``'s runtime drift
+guard (which stays as a self-check): every public ``record_*`` /
+``set_*`` / ``remove_*`` method on ``OpenTelemetry`` must be explicitly
+overridden by ``NoopTelemetry``, or a telemetry-off deployment silently
+runs the real recorder — allocating label sets and exposing series —
+for exactly the metrics someone just added. PR 3 added five recorders
+by hand; this is the regression the invariant exists for.
+
+Triggers on any module that defines both class names (so the fixture
+self-test exercises it without importing the real module).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from graftlint.core import Finding, ParsedModule, flag
+
+CHECKER = "telemetry-noop-drift"
+
+RECORDER_PREFIXES = ("record_", "set_", "remove_")
+REAL_CLASS = "OpenTelemetry"
+NOOP_CLASS = "NoopTelemetry"
+
+
+def _method_defs(cls: ast.ClassDef) -> dict[str, ast.AST]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def check(mod: ParsedModule) -> list[Finding]:
+    real = noop = None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            if node.name == REAL_CLASS:
+                real = node
+            elif node.name == NOOP_CLASS:
+                noop = node
+    if real is None or noop is None:
+        return []
+    out: list[Finding] = []
+    real_methods = _method_defs(real)
+    noop_methods = _method_defs(noop)
+    for name, fn in sorted(real_methods.items()):
+        if not name.startswith(RECORDER_PREFIXES):
+            continue
+        if name not in noop_methods:
+            flag(out, mod, CHECKER, fn,
+                 f"{REAL_CLASS}.{name} has no {NOOP_CLASS} override — a "
+                 f"telemetry-off gateway would run the real recorder "
+                 f"(allocating label sets) for it")
+    return out

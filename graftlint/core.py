@@ -1,0 +1,221 @@
+"""Driver core: module parsing, pragmas, checker dispatch.
+
+A checker is a callable ``check(mod: ParsedModule) -> list[Finding]``
+registered in ``graftlint.checkers.CHECKERS``. The driver parses each
+file once, hands the same ``ParsedModule`` to every checker, then drops
+findings suppressed by ``# graftlint: disable=<id>`` pragmas.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable
+
+# Trailing or standalone pragma: ``# graftlint: disable=id1,id2`` or
+# ``# graftlint: disable=all``. A standalone pragma line applies to the
+# next source line (so multi-line statements can carry one cleanly).
+_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=([a-z0-9_,\-]+|all)")
+# File-level: ``# graftlint: disable-file=id1,id2`` anywhere in the file.
+_FILE_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable-file=([a-z0-9_,\-]+|all)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    symbol: str = ""  # enclosing qualname, for stable baseline keys
+
+    def key(self) -> str:
+        """Line-free identity used by the baseline, so unrelated edits
+        moving a grandfathered finding a few lines don't break the
+        gate."""
+        return f"{self.path}::{self.checker}::{self.symbol}::{self.message}"
+
+    def render(self) -> str:
+        sym = f" ({self.symbol})" if self.symbol else ""
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}{sym}"
+
+
+@dataclass
+class ParsedModule:
+    path: str  # repo-relative posix path
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    # line number -> set of disabled checker ids ("all" disables all)
+    line_pragmas: dict[int, set[str]] = field(default_factory=dict)
+    file_pragmas: set[str] = field(default_factory=set)
+
+    def suppressed(self, finding: Finding, node_lines: Iterable[int]) -> bool:
+        if "all" in self.file_pragmas or finding.checker in self.file_pragmas:
+            return True
+        for ln in node_lines:
+            ids = self.line_pragmas.get(ln)
+            if ids and ("all" in ids or finding.checker in ids):
+                return True
+        return False
+
+
+def _collect_pragmas(source: str) -> tuple[dict[int, set[str]], set[str]]:
+    line_pragmas: dict[int, set[str]] = {}
+    file_pragmas: set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _FILE_PRAGMA_RE.search(line)
+        if m:
+            file_pragmas |= set(m.group(1).split(","))
+            continue
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        ids = set(m.group(1).split(","))
+        line_pragmas.setdefault(i, set()).update(ids)
+        if line.lstrip().startswith("#"):
+            # Standalone pragma line: applies to the next line too.
+            line_pragmas.setdefault(i + 1, set()).update(ids)
+    return line_pragmas, file_pragmas
+
+
+def parse_source(source: str, path: str) -> ParsedModule:
+    tree = ast.parse(source, filename=path)
+    _annotate_parents(tree)
+    line_pragmas, file_pragmas = _collect_pragmas(source)
+    return ParsedModule(
+        path=path, source=source, tree=tree, lines=source.splitlines(),
+        line_pragmas=line_pragmas, file_pragmas=file_pragmas)
+
+
+def parse_module(file_path: Path, root: Path) -> ParsedModule:
+    source = file_path.read_text(encoding="utf-8")
+    try:
+        rel = file_path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = file_path.as_posix()
+    return parse_source(source, rel)
+
+
+def _annotate_parents(tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._gl_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> ast.AST | None:
+    return getattr(node, "_gl_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(node: ast.AST) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted path of enclosing class/function defs, for baseline keys."""
+    parts: list[str] = []
+    cur: ast.AST | None = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(cur.name)
+        cur = parent(cur)
+    return ".".join(reversed(parts))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def node_lines(node: ast.AST) -> list[int]:
+    """Candidate pragma lines for a node: its own line, its end line,
+    and the first line of the statement that contains it."""
+    lines = {getattr(node, "lineno", 0), getattr(node, "end_lineno", 0) or 0}
+    for anc in ancestors(node):
+        if isinstance(anc, ast.stmt):
+            lines.add(anc.lineno)
+            break
+    lines.discard(0)
+    return sorted(lines)
+
+
+# ----------------------------------------------------------------------
+Checker = Callable[[ParsedModule], "list[Finding]"]
+
+
+def flag(out: list[Finding], mod: ParsedModule, checker: str, node: ast.AST,
+         message: str) -> None:
+    """Append a finding for ``node`` unless a pragma suppresses it."""
+    f = Finding(checker=checker, path=mod.path,
+                line=getattr(node, "lineno", 1), message=message,
+                symbol=qualname(node))
+    if not mod.suppressed(f, node_lines(node)):
+        out.append(f)
+
+
+def run_checkers(mod: ParsedModule, select: set[str] | None = None) -> list[Finding]:
+    from graftlint.checkers import CHECKERS
+
+    out: list[Finding] = []
+    for checker_id, _doc, check in CHECKERS:
+        if select is not None and checker_id not in select:
+            continue
+        out.extend(check(mod))
+    return out
+
+
+def run_source(source: str, path: str = "<string>",
+               select: set[str] | None = None) -> list[Finding]:
+    """Run checkers over a source string — the fixture-test entry point."""
+    return run_checkers(parse_source(source, path), select=select)
+
+
+# Generated modules: types/constants codegen output is exempt wholesale
+# (same carve-out the ruff config makes).
+_GENERATED = re.compile(r"(_gen\.py|/types_gen\.py)$")
+
+
+def iter_py_files(paths: list[str], root: Path) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    return [f for f in files if not _GENERATED.search(f.as_posix())]
+
+
+def run_paths(paths: list[str], root: Path,
+              select: set[str] | None = None) -> tuple[list[Finding], list[str]]:
+    """(findings, parse_errors) over every non-generated .py under paths."""
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for file_path in iter_py_files(paths, root):
+        try:
+            mod = parse_module(file_path, root)
+        except SyntaxError as e:
+            errors.append(f"{file_path}: {e}")
+            continue
+        findings.extend(run_checkers(mod, select=select))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings, errors

@@ -72,7 +72,9 @@ def verify_jwt(token: str, jwks: dict[str, Any], issuer: str, audience: str) -> 
     if not verified:
         raise JWTError("signature verification failed")
 
-    now = time.time()
+    # JWT exp/nbf claims are epoch seconds — this comparison is
+    # wall-clock by specification (RFC 7519 §4.1.4).
+    now = time.time()  # graftlint: disable=clock-discipline
     if claims.get("exp") is not None and now > float(claims["exp"]):
         raise JWTError("token expired")
     if claims.get("nbf") is not None and now < float(claims["nbf"]):
@@ -92,7 +94,7 @@ class OIDCAuthenticator:
 
     def __init__(self, issuer: str, client_id: str, client,
                  jwks_fetcher: JWKSFetcher | None = None, logger=None,
-                 cache_ttl: float = 300.0) -> None:
+                 cache_ttl: float = 300.0, now_fn=None) -> None:
         self.issuer = issuer.rstrip("/")
         self.client_id = client_id
         self.client = client
@@ -101,9 +103,12 @@ class OIDCAuthenticator:
         self._jwks: dict[str, Any] | None = None
         self._jwks_at = 0.0
         self._cache_ttl = cache_ttl
+        # Injectable time source for the JWKS cache TTL (graftlint
+        # clock-discipline): tests age the cache without waiting.
+        self._now = now_fn or time.monotonic
 
     async def _fetch_jwks(self) -> dict[str, Any]:
-        now = time.monotonic()
+        now = self._now()
         if self._jwks is not None and now - self._jwks_at < self._cache_ttl:
             return self._jwks
         if self._jwks_fetcher is not None:

@@ -129,7 +129,7 @@ def chat_to_response(chat: dict[str, Any], req_body: dict[str, Any]) -> dict[str
     resp: dict[str, Any] = {
         "id": _rid("resp"),
         "object": "response",
-        "created_at": int(chat.get("created") or time.time()),
+        "created_at": int(chat.get("created") or time.time()),  # graftlint: disable=clock-discipline -- epoch wire format
         "model": chat.get("model") or req_body.get("model", ""),
         "status": status,
         "error": None,
@@ -161,7 +161,7 @@ async def stream_response_events(
     resp_id = _rid("resp")
     item_id = _rid("msg")
     base = {
-        "id": resp_id, "object": "response", "created_at": int(time.time()),
+        "id": resp_id, "object": "response", "created_at": int(time.time()),  # graftlint: disable=clock-discipline -- epoch wire format
         "model": req_body.get("model", ""), "status": "in_progress",
         "error": None, "incomplete_details": None, "output": [],
         "metadata": req_body.get("metadata") or {},

@@ -330,6 +330,9 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         prober=prober, access_log=access_log, profiler=profiler, watchdog=watchdog,
         slow_log=slow_log,
     )
+    # Uptime reads through the resilience clock (graftlint
+    # clock-discipline): stamp the start on the same timebase.
+    gw._started = resilience.clock.now()
 
     if metrics_router is not None:
         # /debug/status (ISSUE 3): one JSON snapshot for humans and
@@ -343,7 +346,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
                 "app": APPLICATION_NAME,
                 "version": VERSION,
                 "environment": cfg.environment,
-                "uptime_seconds": round(time.monotonic() - gw._started, 3),
+                "uptime_seconds": round(resilience.clock.now() - gw._started, 3),
                 "breakers": resilience.breaker_snapshot(),
                 "admission": overload.snapshot(),
                 "gauges": otel.registry.gauge_snapshot(),

@@ -13,6 +13,7 @@ import math
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Callable
 
 LabelValues = tuple[str, ...]
 
@@ -75,12 +76,15 @@ class Gauge:
     _values: dict[LabelValues, float] = field(default_factory=dict)
     _updated: dict[LabelValues, float] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    # Injectable time source for TTL aging (graftlint clock-discipline):
+    # a function reference, so tests can age label sets without waiting.
+    _now: Callable[[], float] = field(default=time.monotonic)
 
     def set(self, value: float, labels: dict[str, str] | None = None) -> None:
         key = tuple((labels or {}).get(n, "") for n in self.label_names)
         with self._lock:
             self._values[key] = value
-            self._updated[key] = time.monotonic()
+            self._updated[key] = self._now()
 
     def remove(self, labels: dict[str, str] | None = None) -> bool:
         """Drop one label set (e.g. on drain or engine teardown). True
@@ -94,7 +98,7 @@ class Gauge:
         """Drop label sets older than ``ttl``; returns how many."""
         if self.ttl <= 0:
             return 0
-        now = time.monotonic() if now is None else now
+        now = self._now() if now is None else now
         with self._lock:
             stale = [k for k, t in self._updated.items() if now - t > self.ttl]
             for k in stale:
@@ -205,10 +209,9 @@ class Registry:
         series for departed entities age out of the exposition."""
         with self._lock:
             instruments = list(self._instruments)
-        now = time.monotonic()
         for i in instruments:
             if isinstance(i, Gauge):
-                i.sweep(now)
+                i.sweep()  # each gauge ages on its own injectable clock
         return "\n".join(i.collect() for i in instruments) + "\n"
 
     def gauge_snapshot(self) -> dict[str, dict[str, float]]:

@@ -42,7 +42,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Callable
 
 # ---------------------------------------------------------------------------
 # Chip datasheet table (bf16 peak, HBM bandwidth). v5e anchors the
@@ -110,9 +110,9 @@ class StepCostModel:
     (tests/test_perf_accounting.py).
     """
 
-    def __init__(self, model_cfg, *, n_chips: int = 1, chip: ChipSpec | None = None,
+    def __init__(self, model_cfg: Any, *, n_chips: int = 1, chip: ChipSpec | None = None,
                  quantize: str | None = None, spec_k: int = 0,
-                 draft_cfg=None) -> None:
+                 draft_cfg: Any = None) -> None:
         from inference_gateway_tpu.models import mixtral
         from inference_gateway_tpu.serving.profiles import (
             kv_bytes_per_token,
@@ -258,7 +258,7 @@ class StepCostModel:
 
     # -- constructors --------------------------------------------------
     @classmethod
-    def from_engine(cls, engine, chip: str | None = None) -> "StepCostModel":
+    def from_engine(cls, engine: Any, chip: str | None = None) -> "StepCostModel":
         """Build from a live Engine: model config, quantization, mesh
         size, and (for model-draft spec) the draft config all come from
         what the engine actually runs."""
@@ -278,7 +278,7 @@ class StepCostModel:
         )
 
     @classmethod
-    def from_profile(cls, profile) -> "StepCostModel":
+    def from_profile(cls, profile: Any) -> "StepCostModel":
         """Build from a committed ServingProfile (no engine, no arrays)
         — the CPU-everywhere path bench.py's ``mfu_analytic`` rides."""
         from inference_gateway_tpu.serving.profiles import resolve_model_cfg
@@ -308,11 +308,16 @@ class PerfAccounting:
     # engine chunk (the accounting-overhead bench gates at <5% p99).
     GAUGE_INTERVAL_S = 0.5
 
-    def __init__(self, cost_model: StepCostModel, *, otel=None, model: str = "",
-                 window_s: float = 10.0, measured: bool | None = None) -> None:
+    def __init__(self, cost_model: StepCostModel, *, otel: Any = None, model: str = "",
+                 window_s: float = 10.0, measured: bool | None = None,
+                 now_fn: Callable[[], float] | None = None) -> None:
         self.cost = cost_model
         self.otel = otel
         self.model = model
+        # Injectable time source (graftlint clock-discipline): window
+        # pruning and gauge pacing read through it, so tests can age the
+        # window without real waiting.
+        self._now = now_fn or time.monotonic
         self.window_s = max(float(window_s), 0.5)
         self.measured = detect_tpu() if measured is None else bool(measured)
         self._lock = threading.Lock()
@@ -353,7 +358,7 @@ class PerfAccounting:
         cost = self.cost.step_cost(kind, batch=batch, n_steps=n_steps,
                                    tokens=work_tokens or tokens,
                                    context_tokens=context_tokens, sq_tokens=sq_tokens)
-        now = time.monotonic()
+        now = self._now()
         win = None
         with self._lock:
             self._events.append((now, kind, duration_s, cost.flops, cost.hbm_bytes,
@@ -400,7 +405,7 @@ class PerfAccounting:
         if tokens <= 0:
             return
         delivered = min(max(delivered, 0), tokens)
-        now = time.monotonic()
+        now = self._now()
         with self._lock:
             self.wasted[reason] = self.wasted.get(reason, 0) + tokens
             if delivered:
@@ -457,7 +462,7 @@ class PerfAccounting:
         """The mfu snapshot /debug/status, /metrics, and the OTLP push
         carry. Keys are framing-safe: window numbers derive from wall
         clock and are labeled ``measured`` only on a TPU backend."""
-        now = time.monotonic()
+        now = self._now()
         with self._lock:
             self._prune(now)
             win = self._window_locked(now)

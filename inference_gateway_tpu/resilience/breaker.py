@@ -13,7 +13,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable
 
-from inference_gateway_tpu.resilience.clock import MonotonicClock
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
 
 CLOSED = "closed"
 OPEN = "open"
@@ -31,7 +31,7 @@ class BreakerConfig:
 
 
 class CircuitBreaker:
-    def __init__(self, config: BreakerConfig | None = None, clock=None,
+    def __init__(self, config: BreakerConfig | None = None, clock: Clock | None = None,
                  on_transition: Callable[[str, str], None] | None = None) -> None:
         self.config = config or BreakerConfig()
         self._clock = clock or MonotonicClock()
@@ -145,7 +145,7 @@ class CircuitBreaker:
 class BreakerRegistry:
     """Lazily-created breakers keyed by (provider, model)."""
 
-    def __init__(self, config: BreakerConfig | None = None, clock=None,
+    def __init__(self, config: BreakerConfig | None = None, clock: Clock | None = None,
                  on_transition: Callable[[tuple[str, str], str, str], None] | None = None) -> None:
         self._config = config or BreakerConfig()
         self._clock = clock or MonotonicClock()

@@ -8,7 +8,7 @@ re-divide the original deadline instead of extending total latency.
 
 from __future__ import annotations
 
-from inference_gateway_tpu.resilience.clock import MonotonicClock
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
 
 
 class BudgetExceededError(Exception):
@@ -20,7 +20,7 @@ class DeadlineBudget:
     no-timeout): never expires, and ``timeout()`` defers to the caller's
     own default by returning the cap (or None)."""
 
-    def __init__(self, total: float, clock=None) -> None:
+    def __init__(self, total: float, clock: Clock | None = None) -> None:
         self.total = float(total)
         self.unlimited = self.total <= 0.0
         self._clock = clock or MonotonicClock()

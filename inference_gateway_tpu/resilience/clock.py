@@ -11,7 +11,19 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Any, Awaitable
+from typing import Any, Awaitable, Protocol
+
+
+class Clock(Protocol):
+    """Structural type every clock consumer annotates against (mypy
+    strict, ISSUE 10): anything with ``now``/``sleep``/``wait_for`` —
+    ``MonotonicClock`` in production, ``VirtualClock`` in tests."""
+
+    def now(self) -> float: ...
+
+    async def sleep(self, seconds: float) -> None: ...
+
+    async def wait_for(self, awaitable: Awaitable[Any], timeout: float | None) -> Any: ...
 
 
 class MonotonicClock:

@@ -20,7 +20,7 @@ from typing import Any
 
 from inference_gateway_tpu.netio.client import ClientResponse, HTTPClientError
 from inference_gateway_tpu.netio.server import Headers
-from inference_gateway_tpu.resilience.clock import VirtualClock
+from inference_gateway_tpu.resilience.clock import Clock, VirtualClock
 
 OK_CHAT_BODY = {
     "id": "fault-ok", "object": "chat.completion", "created": 1, "model": "scripted",
@@ -138,13 +138,14 @@ class FaultScript:
 class FaultInjectingClient:
     """HTTPClient-compatible wrapper that injects scripted faults."""
 
-    def __init__(self, script: FaultScript, inner: Any = None, clock=None) -> None:
+    def __init__(self, script: FaultScript, inner: Any = None,
+                 clock: Clock | None = None) -> None:
         self.script = script
         self.inner = inner
         self.clock = clock or VirtualClock()
         self.traceparents: list[tuple[str, str]] = []  # (url, traceparent) per faulted call
 
-    async def request(self, method: str, url: str, headers=None, body: bytes = b"",
+    async def request(self, method: str, url: str, headers: Any = None, body: bytes = b"",
                       timeout: float | None = None, stream: bool = False,
                       traceparent: str | None = None) -> ClientResponse:
         # ``traceparent`` mirrors the real HTTPClient's signature (the
@@ -199,7 +200,7 @@ class FaultInjectingClient:
         if fault.kind == "mid_body_reset":
             cut = fault.body[: max(fault.after, 0)]
 
-            async def mid_reset(b=cut):
+            async def mid_reset(b: bytes = cut) -> Any:
                 if b:
                     yield b
                 raise HTTPClientError(
@@ -212,7 +213,7 @@ class FaultInjectingClient:
         if fault.kind == "stall":
             clock = self.clock
 
-            async def stalled():
+            async def stalled() -> Any:
                 for chunk in fault.chunks:
                     yield chunk
                 # Go silent: virtually sleep past any idle timeout, then
@@ -226,24 +227,24 @@ class FaultInjectingClient:
 
         resp = ClientResponse(status=fault.status, headers=headers, body=fault.body)
         if stream:
-            async def one_shot(b=fault.body):
+            async def one_shot(b: bytes = fault.body) -> Any:
                 yield b
 
             resp._inproc_chunks = one_shot()
         return resp
 
-    async def get(self, url: str, headers=None, timeout: float | None = None,
+    async def get(self, url: str, headers: Any = None, timeout: float | None = None,
                   traceparent: str | None = None) -> ClientResponse:
         return await self.request("GET", url, headers=headers, timeout=timeout,
                                   traceparent=traceparent)
 
-    async def post(self, url: str, body: bytes, headers=None, timeout: float | None = None,
+    async def post(self, url: str, body: bytes, headers: Any = None, timeout: float | None = None,
                    stream: bool = False, traceparent: str | None = None) -> ClientResponse:
         return await self.request("POST", url, headers=headers, body=body,
                                   timeout=timeout, stream=stream, traceparent=traceparent)
 
 
-async def _cut_after_frames(blocks, after_frames: int, url: str):
+async def _cut_after_frames(blocks: Any, after_frames: int, url: str) -> Any:
     """Relay complete SSE frames from ``blocks`` until ``after_frames``
     have passed, then die with a connection reset — frames are cut on
     ``\\n\\n`` boundaries so the delivered prefix is well-formed SSE
@@ -292,7 +293,7 @@ class EngineFaultInjector:
     Unscripted calls pass through; every played fault is logged.
     """
 
-    def __init__(self, engine) -> None:
+    def __init__(self, engine: Any) -> None:
         import threading
 
         self.engine = engine
@@ -335,8 +336,8 @@ class EngineFaultInjector:
         self.release_hangs()
 
     # -- internals -------------------------------------------------------
-    def _wrap(self, op: str):
-        def call(*args, **kwargs):
+    def _wrap(self, op: str) -> Any:
+        def call(*args: Any, **kwargs: Any) -> Any:
             i = self.calls[op]
             self.calls[op] = i + 1
             fault = self._scripts.pop((op, i), None)

@@ -22,6 +22,7 @@ from inference_gateway_tpu.resilience.breaker import (
     BreakerRegistry,
 )
 from inference_gateway_tpu.resilience.budget import BudgetExceededError, DeadlineBudget
+from inference_gateway_tpu.resilience.clock import Clock
 from inference_gateway_tpu.resilience.clock import MonotonicClock
 from inference_gateway_tpu.resilience.retry import RETRYABLE_STATUSES, RetryPolicy
 
@@ -43,7 +44,8 @@ class StreamStalledError(Exception):
 
 
 class Resilience:
-    def __init__(self, cfg: Any = None, otel=None, logger=None, clock=None,
+    def __init__(self, cfg: Any = None, otel: Any = None, logger: Any = None,
+                 clock: Clock | None = None,
                  rng: random.Random | None = None) -> None:
         self.enabled = getattr(cfg, "enabled", True)
         self.otel = otel
@@ -77,7 +79,7 @@ class Resilience:
         # assembly when routing pools exist. An ejected deployment gets
         # ZERO establishment attempts (stronger than breaker demotion,
         # which only re-orders) until the prober readmits it.
-        self.prober = None
+        self.prober: Any = None
         self.retry_policy = RetryPolicy(
             max_attempts=getattr(cfg, "retry_max_attempts", 3) if self.enabled else 1,
             base_backoff=getattr(cfg, "retry_base_backoff", 0.1),

@@ -32,7 +32,7 @@ import math
 from collections import deque
 from typing import Any, Callable
 
-from inference_gateway_tpu.resilience.clock import MonotonicClock
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
 
 # Shed order: higher value is shed first. Critical is never shed — a
 # drain or overload that silenced /health would blind the LB exactly
@@ -79,7 +79,7 @@ class AdmissionRejectedError(Exception):
         self.endpoint_class = endpoint_class
         self.priority = priority
 
-    def to_response(self):
+    def to_response(self) -> Any:
         """Sanitized client response: category + Retry-After, no
         internals (queue lengths, caps, class names stay server-side)."""
         from inference_gateway_tpu.netio.server import Response
@@ -167,7 +167,8 @@ class OverloadController:
     through. Single-event-loop discipline (like the rest of the gateway):
     no locks, every mutation happens on the serving loop."""
 
-    def __init__(self, cfg: Any = None, otel=None, logger=None, clock=None) -> None:
+    def __init__(self, cfg: Any = None, otel: Any = None, logger: Any = None,
+                 clock: Clock | None = None) -> None:
         self.enabled = getattr(cfg, "enabled", True)
         self.otel = otel
         self.logger = logger
@@ -417,7 +418,7 @@ class OverloadController:
                 self.otel.remove_overload_gauges(st.name)
 
 
-def admission_middleware(overload: OverloadController, logger=None):
+def admission_middleware(overload: OverloadController, logger: Any = None) -> Any:
     """Outermost middleware: admission is decided before any other work
     (tracing, logging, auth) is spent on a request that will be shed.
 
@@ -427,7 +428,7 @@ def admission_middleware(overload: OverloadController, logger=None):
     the very request the slot was granted to."""
     from inference_gateway_tpu.netio.server import StreamingResponse
 
-    async def middleware(req, nxt):
+    async def middleware(req: Any, nxt: Any) -> Any:
         if req.client is not None and req.client[0] == "inprocess":
             return await nxt(req)
         endpoint_class, priority = classify_request(req.method, req.path)
@@ -452,7 +453,7 @@ def admission_middleware(overload: OverloadController, logger=None):
             # lets graceful drain wait for in-flight SSE streams.
             inner = resp.chunks
 
-            async def guarded():
+            async def guarded() -> Any:
                 try:
                     async for chunk in inner:
                         yield chunk

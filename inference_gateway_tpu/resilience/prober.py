@@ -28,7 +28,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Iterable
 
-from inference_gateway_tpu.resilience.clock import MonotonicClock, VirtualClock
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock, VirtualClock
 
 
 def probe_url(base_url: str) -> str:
@@ -52,8 +52,9 @@ class HealthProber:
     """Per-deployment active health state for one pool set."""
 
     def __init__(self, targets: Iterable[ProbeTarget], client: Any = None, *,
-                 clock=None, interval: float = 5.0, timeout: float = 2.0,
-                 eject_after: int = 3, otel=None, logger=None) -> None:
+                 clock: Clock | None = None, interval: float = 5.0,
+                 timeout: float = 2.0, eject_after: int = 3,
+                 otel: Any = None, logger: Any = None) -> None:
         self.client = client
         self.clock = clock or MonotonicClock()
         self.interval = interval

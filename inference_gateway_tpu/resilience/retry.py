@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass
+from typing import Any
 
 # Statuses worth retrying/failing over: throttles and transient server
 # errors. Other 4xx are request problems — identical on every replica.
@@ -32,7 +33,7 @@ class RetryPolicy:
         return delay
 
 
-def retry_after_seconds(headers) -> float | None:
+def retry_after_seconds(headers: Any) -> float | None:
     """Parse a Retry-After header value (delta-seconds form only; the
     HTTP-date form is ignored). ``headers`` is any object with ``get``."""
     if headers is None:

@@ -171,11 +171,19 @@ class _PendingPrefill:
 
 class Scheduler:
     def __init__(self, engine: Engine, logger=None, max_queue_depth: int = 0,
-                 preempt_max: int = 0, preempt_high_water: float = 0.0):
+                 preempt_max: int = 0, preempt_high_water: float = 0.0,
+                 clock=None):
         from inference_gateway_tpu.logger import NoopLogger
+        from inference_gateway_tpu.resilience.clock import MonotonicClock
 
         self.engine = engine
         self.logger = logger or NoopLogger()
+        # Injectable monotonic clock (PR 1 discipline, enforced by
+        # graftlint clock-discipline): liveness stamps read through it
+        # so tests can drive staleness without real waiting. Epoch
+        # phase stamps (phase_ns) stay on time.time_ns — span
+        # timestamps are wall-clock by definition.
+        self.clock = clock or MonotonicClock()
         # Bounded admission (0 = unbounded): submit raises
         # SchedulerSaturatedError past this many waiting requests.
         self.max_queue_depth = max_queue_depth
@@ -224,7 +232,7 @@ class Scheduler:
         # Liveness: wall-clock of the last completed engine step. The
         # sidecar /health endpoint flags "degraded" when requests are
         # active but no step has completed recently (wedged device).
-        self.last_step_time = time.monotonic()
+        self.last_step_time = self.clock.now()
         # Monotone progress counter for the engine hang watchdog (ISSUE
         # 7): unlike last_step_time (real monotonic clock) a counter can
         # be compared on an injected virtual clock, so the watchdog is
@@ -762,7 +770,7 @@ class Scheduler:
                     self._fail_request(req)
                     self._release_guarded(slot, "error")
             return
-        self.last_step_time = time.monotonic()
+        self.last_step_time = self.clock.now()
         self.steps_completed += 1
         for (req, slot), res in zip(p.items, results):
             st = self._slots.get(slot)
@@ -884,7 +892,7 @@ class Scheduler:
         out, logprobs, counts = self.engine.spec_round(
             catchup, catchup_len, catchup_pos, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
-        self.last_step_time = time.monotonic()
+        self.last_step_time = self.clock.now()
         self.steps_completed += 1
         self.spec_rounds += 1
         self.spec_slot_rounds += len(self._slots)
@@ -963,7 +971,7 @@ class Scheduler:
         out, logprobs, counts = self.engine.spec_round_ngram(
             pending, positions, draft, active, temps, top_ps,
             seeds=seeds, use_seed=use_seed)
-        self.last_step_time = time.monotonic()
+        self.last_step_time = self.clock.now()
         self.steps_completed += 1
         self.spec_rounds += 1
         self.spec_slot_rounds += len(self._slots)
@@ -1089,7 +1097,7 @@ class Scheduler:
             self._handles.clear()
             self._fail_after_decode_error(e)
             return
-        self.last_step_time = time.monotonic()
+        self.last_step_time = self.clock.now()
         self.steps_completed += inf.n_steps
 
         ctx = sum(s.pos for s in inf.states.values()) if observing else 0
@@ -1214,9 +1222,12 @@ def generate_sync(
         top_p=top_p, stop_token_ids=stop_token_ids, callback=cb, seed=seed,
     ))
     out: list[int] = []
-    deadline = time.monotonic() + timeout
+    # Blocking helper for tests/CLI: runs on its own thread against a
+    # real queue, so real wall-clock is the point here.
+    deadline = time.monotonic() + timeout  # graftlint: disable=clock-discipline
     while True:
-        token, finished, reason = q.get(timeout=max(deadline - time.monotonic(), 0.1))
+        token, finished, reason = q.get(  # graftlint: disable=clock-discipline
+            timeout=max(deadline - time.monotonic(), 0.1))
         is_stop_tok = reason == "stop"
         if not (finished and is_stop_tok):
             out.append(token)

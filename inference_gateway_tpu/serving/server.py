@@ -43,6 +43,7 @@ from inference_gateway_tpu.otel.profiling import (
     jax_trace_capture,
 )
 from inference_gateway_tpu.otel.tracing import Tracer, parse_traceparent
+from inference_gateway_tpu.resilience.clock import MonotonicClock
 from inference_gateway_tpu.resilience.overload import ServiceTimeEstimator
 from inference_gateway_tpu.serving.engine import Engine, EngineConfig
 from inference_gateway_tpu.serving.scheduler import (
@@ -83,9 +84,18 @@ class SidecarServer:
                  accounting_window: float = 10.0,
                  accounting_chip: str | None = None,
                  preempt_max: int = 3, preempt_high_water: float = 0.0,
-                 engine_watchdog=None, engine_factory=None):
+                 engine_watchdog=None, engine_factory=None, clock=None):
         self.engine = engine
         self.logger = logger or new_logger()
+        # Injectable monotonic clock (graftlint clock-discipline): all
+        # duration math (uptime, service time, health staleness) reads
+        # through it; shared with the scheduler — adopted FROM an
+        # externally-passed scheduler, passed INTO one built here — so
+        # the two sides of the last_step_time staleness comparison can
+        # never use different timebases. Epoch wire-format stamps
+        # (``created``) stay on real wall-clock.
+        self._clock = clock or (getattr(scheduler, "clock", None)
+                                if scheduler is not None else None) or MonotonicClock()
         # Serving-path fault tolerance (ISSUE 7): "ok" | "degraded" —
         # degraded flips /health to 503 while a supervised engine
         # restart is in flight, so PR 1 failover pools route around the
@@ -115,7 +125,8 @@ class SidecarServer:
         self.scheduler = scheduler or Scheduler(engine, logger=self.logger,
                                                 max_queue_depth=max_queue_depth,
                                                 preempt_max=preempt_max,
-                                                preempt_high_water=preempt_high_water)
+                                                preempt_high_water=preempt_high_water,
+                                                clock=self._clock)
         self._own_scheduler = scheduler is None
         if self.scheduler.on_preempt is None:
             self.scheduler.on_preempt = self._on_preempt
@@ -126,8 +137,8 @@ class SidecarServer:
         # gateway's admission ledger so the policy can't drift).
         self._service = ServiceTimeEstimator()
         self.model_name = served_model_name or engine.config.model
-        self.created = int(time.time())
-        self._started = time.monotonic()
+        self.created = int(time.time())  # graftlint: disable=clock-discipline -- epoch stamp for the /v1/models wire format
+        self._started = self._clock.now()
         # Performance introspection (ISSUE 4): a decode-step timeline on
         # the scheduler thread (GET /debug/timeline; timeline_size=0
         # disables), slow-request forensics fed by the phase clock in
@@ -271,7 +282,8 @@ class SidecarServer:
         if self.otel is not None:
             self.otel.set_engine_degraded(self.model_name, 1)
         old_sched = self.scheduler
-        info: dict[str, Any] = {"reason": reason, "at": time.time(),
+        info: dict[str, Any] = {"reason": reason,
+                                "at": time.time(),  # graftlint: disable=clock-discipline -- epoch forensics stamp
                                 "forensics": forensics or {}}
         info["failed_requests"] = old_sched.abort_all()
         self.logger.error("engine wedged; supervised in-place restart", None,
@@ -307,7 +319,8 @@ class SidecarServer:
         sched = Scheduler(new_engine, logger=self.logger,
                           max_queue_depth=old_sched.max_queue_depth,
                           preempt_max=old_sched.preempt_max,
-                          preempt_high_water=old_sched.preempt_high_water)
+                          preempt_high_water=old_sched.preempt_high_water,
+                          clock=self._clock)
         sched.timeline = self.timeline
         sched.accounting = self.accounting
         sched.on_preempt = self._on_preempt
@@ -475,13 +488,13 @@ class SidecarServer:
             }, status=503)
         stalled = (
             self.scheduler.active_requests() > 0
-            and time.monotonic() - self.scheduler.last_step_time > self.HEALTH_STALL_SECONDS
+            and self._clock.now() - self.scheduler.last_step_time > self.HEALTH_STALL_SECONDS
         )
         if stalled:
             return Response.json({
                 "status": "degraded",
                 "reason": "no engine step completed recently with active requests",
-                "seconds_since_last_step": round(time.monotonic() - self.scheduler.last_step_time, 1),
+                "seconds_since_last_step": round(self._clock.now() - self.scheduler.last_step_time, 1),
             }, status=503)
         return Response.json({"status": "ok"})
 
@@ -518,7 +531,7 @@ class SidecarServer:
             if self.scheduler.spec_slot_rounds:
                 m["spec_tokens_per_slot_round"] = round(
                     self.scheduler.spec_emitted / self.scheduler.spec_slot_rounds, 3)
-        m["uptime_seconds"] = round(time.monotonic() - self._started, 3)
+        m["uptime_seconds"] = round(self._clock.now() - self._started, 3)
         m["preemptions"] = self.scheduler.preemptions
         m["engine_restarts"] = self.restarts
         gauges = self.sample_engine_gauges()  # refresh on every scrape
@@ -607,7 +620,7 @@ class SidecarServer:
         slow-request log, and profiler/watchdog health."""
         status: dict[str, Any] = {
             "model": self.model_name,
-            "uptime_seconds": round(time.monotonic() - self._started, 3),
+            "uptime_seconds": round(self._clock.now() - self._started, 3),
             "active_requests": self.scheduler.active_requests(),
             "queue_depth": self.scheduler.queue_depth,
             "state": self.state,
@@ -727,7 +740,7 @@ class SidecarServer:
         )
         meta = {
             "id": cont_id or "chatcmpl-" + uuid.uuid4().hex[:24],
-            "created": cont_created if cont_created is not None else int(time.time()),
+            "created": cont_created if cont_created is not None else int(time.time()),  # graftlint: disable=clock-discipline -- epoch wire format
             "model": body.get("model") or self.model_name,
             # The ORIGINAL prompt: resume tokens are completion tokens
             # (already billed by the replica that generated them), not
@@ -773,7 +786,7 @@ class SidecarServer:
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
-        arrival = time.monotonic()
+        arrival = self._clock.now()
         first_token_seen = False
         last_token_t: list[float | None] = [None]
         traceparent = req.headers.get("traceparent")
@@ -787,7 +800,7 @@ class SidecarServer:
             # event loop in ONE call_soon_threadsafe (one loop-wakeup
             # syscall per decode step, not per token).
             nonlocal first_token_seen
-            now = time.monotonic()
+            now = self._clock.now()
             if not first_token_seen:
                 first_token_seen = True
                 self.record_ttft(now - arrival)
@@ -853,7 +866,7 @@ class SidecarServer:
                     reason = fin_reason or "stop"
                     done = True
                     break
-        self._observe_service(time.monotonic() - arrival)
+        self._observe_service(self._clock.now() - arrival)
         self._finalize_request(gen, meta, traceparent, completion_tokens, stream=False,
                                finish_reason=reason)
         if reason == "error":
@@ -960,19 +973,24 @@ class SidecarServer:
             root = self.tracer.start_span("tpu_sidecar.chat_completions",
                                           traceparent=traceparent, start_ns=submit)
             trace_id = root.trace_id
-            root.set_attribute("gen_ai.request.model", meta["model"])
-            root.set_attribute("gen_ai.provider.name", "tpu")
-            root.set_attribute("request.id", gen.request_id or meta["id"])
-            root.set_attribute("gen_ai.usage.input_tokens", meta["prompt_tokens"])
-            root.set_attribute("gen_ai.usage.output_tokens", completion_tokens)
-            phases = (("queue.wait", submit, admit), ("prefill", admit, first),
-                      ("decode", first, finish))
-            for name, t0, t1 in phases:
-                if t0 is None or t1 is None:
-                    continue
-                child = self.tracer.start_span(name, parent=root, start_ns=t0)
-                self.tracer.end_span(child, end_ns=max(t1, t0))
-            self.tracer.end_span(root, end_ns=end_ns)
+            try:
+                root.set_attribute("gen_ai.request.model", meta["model"])
+                root.set_attribute("gen_ai.provider.name", "tpu")
+                root.set_attribute("request.id", gen.request_id or meta["id"])
+                root.set_attribute("gen_ai.usage.input_tokens", meta["prompt_tokens"])
+                root.set_attribute("gen_ai.usage.output_tokens", completion_tokens)
+                phases = (("queue.wait", submit, admit), ("prefill", admit, first),
+                          ("decode", first, finish))
+                for name, t0, t1 in phases:
+                    if t0 is None or t1 is None:
+                        continue
+                    child = self.tracer.start_span(name, parent=root, start_ns=t0)
+                    self.tracer.end_span(child, end_ns=max(t1, t0))
+            finally:
+                # The root span must reach the exporter even if a child
+                # materialization fails mid-loop (graftlint
+                # resource-release: spans end on every exception path).
+                self.tracer.end_span(root, end_ns=end_ns)
 
         if not trace_id:
             ctx = parse_traceparent(traceparent)
@@ -1127,7 +1145,7 @@ class SidecarServer:
                 if parts:
                     yield content_frame("".join(parts))
 
-            self._observe_service(time.monotonic() - arrival)
+            self._observe_service(self._clock.now() - arrival)
             yield chunk({}, reason)
             if include_usage:
                 # Usage spans the whole logical stream: resume tokens

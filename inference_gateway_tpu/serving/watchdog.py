@@ -183,7 +183,7 @@ class EngineWatchdog:
             "active_requests": sched.active_requests(),
             "queue_depth": sched.queue_depth,
             "steps_completed": sched.steps_completed,
-            "captured_at": time.time(),
+            "captured_at": time.time(),  # graftlint: disable=clock-discipline -- epoch forensics stamp
         }
         try:
             th = sched._thread

@@ -1,0 +1,614 @@
+"""graftlint: fixture self-tests per checker + the tier-1 gate (ISSUE 10).
+
+Two layers:
+
+1. **Fixture self-tests** — every checker gets a known-bad snippet that
+   MUST flag and a known-good twin that MUST NOT, so a checker that
+   silently stops firing (or starts over-firing) fails here before it
+   lies about the codebase.
+2. **The gate** — the full suite runs over ``inference_gateway_tpu``
+   with the committed baseline and asserts zero non-baselined
+   violations; a companion test pins the acceptance criterion that the
+   baseline holds NO entries for ``resilience/`` or ``serving/`` (those
+   were fixed, not grandfathered).
+
+Plus the regression test for the real bug the suite found: the sidecar's
+post-hoc span materialization lost the root span (and its trace) when a
+child-span build raised mid-loop.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from graftlint import baseline as baseline_mod  # noqa: E402
+from graftlint import run_paths, run_source  # noqa: E402
+
+BASELINE_PATH = REPO_ROOT / "graftlint-baseline.json"
+
+
+def lint(src: str, path: str = "fixture.py", select: str | None = None):
+    ids = {select} if select else None
+    return run_source(textwrap.dedent(src), path=path, select=ids)
+
+
+def checker_ids(findings):
+    return [f.checker for f in findings]
+
+
+# ----------------------------------------------------------------------
+# async-blocking
+# ----------------------------------------------------------------------
+
+def test_async_blocking_flags_sleep_in_async_def():
+    bad = """
+    import time
+
+    async def handler(req):
+        time.sleep(0.5)
+        return req
+    """
+    assert "async-blocking" in checker_ids(lint(bad, select="async-blocking"))
+
+
+def test_async_blocking_good_twin_awaits_the_clock():
+    good = """
+    async def handler(req, clock):
+        await clock.sleep(0.5)
+        return req
+    """
+    assert lint(good, select="async-blocking") == []
+
+
+def test_async_blocking_flags_transitive_module_local_call():
+    bad = """
+    import time
+
+    def warm_cache():
+        time.sleep(1.0)
+
+    async def handler(req):
+        warm_cache()
+        return req
+    """
+    findings = lint(bad, select="async-blocking")
+    assert len(findings) == 1 and "warm_cache" in findings[0].message
+
+
+def test_async_blocking_sync_only_helper_not_flagged():
+    good = """
+    import time
+
+    def warm_cache():
+        time.sleep(1.0)
+
+    def main():
+        warm_cache()
+    """
+    assert lint(good, select="async-blocking") == []
+
+
+def test_async_blocking_flags_unbounded_queue_get_and_future_result():
+    bad = """
+    async def pump(q, fut):
+        item = q.get()
+        value = fut.result()
+        return item, value
+    """
+    assert len(lint(bad, select="async-blocking")) == 2
+
+
+def test_async_blocking_allows_awaited_get_and_done_guarded_result():
+    good = """
+    import asyncio
+
+    async def pump(q, task):
+        item = await q.get()
+        batch = await asyncio.wait_for(q.get(), 0.1)
+        if task.done():
+            value = task.result()
+        return item, batch
+    """
+    assert lint(good, select="async-blocking") == []
+
+
+# ----------------------------------------------------------------------
+# clock-discipline
+# ----------------------------------------------------------------------
+
+def test_clock_discipline_flags_direct_time_calls():
+    bad = """
+    import time
+
+    def cooldown_over(opened_at, cooldown):
+        return time.monotonic() - opened_at >= cooldown
+    """
+    assert "clock-discipline" in checker_ids(lint(bad, select="clock-discipline"))
+
+
+def test_clock_discipline_good_twin_uses_injected_clock():
+    good = """
+    import time
+
+    def cooldown_over(clock, opened_at, cooldown):
+        return clock.now() - opened_at >= cooldown
+
+    def epoch_stamp():
+        return time.time_ns()  # epoch stamps via time_ns are fine
+
+    def profile_stamp():
+        return time.perf_counter()
+    """
+    assert lint(good, select="clock-discipline") == []
+
+
+def test_clock_discipline_catches_from_import_aliases():
+    bad = """
+    from time import monotonic as mono
+
+    def now():
+        return mono()
+    """
+    assert len(lint(bad, select="clock-discipline")) == 1
+
+
+def test_clock_discipline_respects_allowlist_and_pragma():
+    src = """
+    import time
+
+    def now():
+        return time.monotonic()
+    """
+    allowed = lint(src, path="inference_gateway_tpu/resilience/clock.py",
+                   select="clock-discipline")
+    assert allowed == []
+    pragma = """
+    import time
+
+    def epoch():
+        return time.time()  # graftlint: disable=clock-discipline
+    """
+    assert lint(pragma, select="clock-discipline") == []
+
+
+# ----------------------------------------------------------------------
+# resource-release
+# ----------------------------------------------------------------------
+
+def test_resource_release_flags_happy_path_only_ticket():
+    bad = """
+    async def middleware(overload, nxt, req):
+        ticket = await overload.admit("streaming", 1)
+        resp = await nxt(req)
+        ticket.release()
+        return resp
+    """
+    findings = lint(bad, select="resource-release")
+    assert len(findings) == 1 and "happy path" in findings[0].message
+
+
+def test_resource_release_good_twin_releases_in_finally():
+    good = """
+    async def middleware(overload, nxt, req):
+        ticket = await overload.admit("streaming", 1)
+        try:
+            return await nxt(req)
+        finally:
+            ticket.release()
+    """
+    assert lint(good, select="resource-release") == []
+
+
+def test_resource_release_flags_never_released_breaker_slot():
+    bad = """
+    def attempt(breaker, call):
+        ok, took_slot = breaker.admit()
+        if not ok:
+            return None
+        return call()
+    """
+    findings = lint(bad, select="resource-release")
+    assert len(findings) == 1 and "probe slot" in findings[0].message
+
+
+def test_resource_release_good_twin_settles_breaker_outcome():
+    good = """
+    def attempt(breaker, call):
+        ok, took_slot = breaker.admit()
+        if not ok:
+            return None
+        try:
+            result = call()
+            breaker.record_success()
+            return result
+        except Exception:
+            breaker.record_failure()
+            raise
+        finally:
+            if took_slot:
+                breaker.release()
+    """
+    assert lint(good, select="resource-release") == []
+
+
+def test_resource_release_flags_span_without_exception_coverage():
+    bad = """
+    def traced(tracer, compute):
+        span = tracer.start_span("op")
+        result = compute()
+        tracer.end_span(span)
+        return result
+    """
+    findings = lint(bad, select="resource-release")
+    assert len(findings) == 1 and "span" in findings[0].message
+
+
+def test_resource_release_good_twin_ends_span_in_finally():
+    good = """
+    def traced(tracer, compute):
+        span = tracer.start_span("op")
+        try:
+            return compute()
+        finally:
+            tracer.end_span(span)
+    """
+    assert lint(good, select="resource-release") == []
+
+
+def test_resource_release_unrelated_with_is_not_coverage():
+    """A release wrapped in `with self._lock:` is NOT exception-path
+    coverage — the raise that matters happens outside that block
+    (code-review finding); only `with <resource>:` itself counts."""
+    bad = """
+    def traced(self, tracer, compute):
+        span = tracer.start_span("op")
+        result = compute()
+        with self._lock:
+            tracer.end_span(span)
+        return result
+    """
+    findings = lint(bad, select="resource-release")
+    assert len(findings) == 1 and "happy path" in findings[0].message
+
+
+def test_resource_release_ownership_transfer_is_not_a_leak():
+    good = """
+    def open_span(tracer):
+        return tracer.start_span("op")  # caller owns it now
+
+    def stash_span(self, tracer):
+        self.span = tracer.start_span("op")  # stored: finalized elsewhere
+    """
+    assert lint(good, select="resource-release") == []
+
+
+# ----------------------------------------------------------------------
+# cross-thread-state
+# ----------------------------------------------------------------------
+
+_XTS_TEMPLATE = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self.count = 0
+
+    def run(self):
+        {thread_write}
+
+    def reset(self):
+        {other_write}
+"""
+
+
+def test_cross_thread_state_flags_unlocked_dual_writes():
+    bad = _XTS_TEMPLATE.format(thread_write="self.count += 1",
+                               other_write="self.count = 0")
+    findings = lint(bad, select="cross-thread-state")
+    assert len(findings) == 2  # both unlocked write sites
+    assert all("Worker.count" in f.message for f in findings)
+
+
+def test_cross_thread_state_good_twin_holds_the_lock():
+    good = _XTS_TEMPLATE.format(
+        thread_write="with self._lock:\n            self.count += 1",
+        other_write="with self._lock:\n            self.count = 0")
+    assert lint(good, select="cross-thread-state") == []
+
+
+def test_cross_thread_state_single_side_mutation_is_fine():
+    good = _XTS_TEMPLATE.format(thread_write="self.count += 1",
+                                other_write="pass")
+    assert lint(good, select="cross-thread-state") == []
+
+
+# ----------------------------------------------------------------------
+# jax-hot-path
+# ----------------------------------------------------------------------
+
+def test_jax_hot_path_flags_item_inside_jitted_step():
+    bad = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _decode_fn(self, params, tokens):
+        scale = tokens.max().item()
+        return params * scale
+    """
+    findings = lint(bad, select="jax-hot-path")
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+
+def test_jax_hot_path_good_twin_stays_on_device():
+    good = """
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _decode_fn(self, params, tokens):
+        return params * jnp.max(tokens)
+    """
+    assert lint(good, select="jax-hot-path") == []
+
+
+def test_jax_hot_path_flags_sync_in_submit_path_scope():
+    bad = """
+    import numpy as np
+
+    class Scheduler:
+        def _submit_chunk(self, chain):
+            handle = self.engine.decode_chunk_submit(chain=chain)
+            toks = np.asarray(handle.toks_lp)  # materializes = waits
+            return toks
+    """
+    findings = lint(bad, path="inference_gateway_tpu/serving/scheduler.py",
+                    select="jax-hot-path")
+    assert len(findings) == 1 and "np.asarray" in findings[0].message
+
+
+def test_jax_hot_path_fetch_functions_are_designated_sync_points():
+    good = """
+    import numpy as np
+
+    class Scheduler:
+        def _submit_chunk(self, chain):
+            return self.engine.decode_chunk_submit(chain=chain)
+
+        def _process_chunk(self, handle):
+            return np.asarray(handle.toks_lp)  # fetch side: sync is the point
+    """
+    assert lint(good, path="inference_gateway_tpu/serving/scheduler.py",
+                select="jax-hot-path") == []
+
+
+# ----------------------------------------------------------------------
+# telemetry-noop-drift
+# ----------------------------------------------------------------------
+
+def test_telemetry_noop_drift_flags_missing_override():
+    bad = """
+    class OpenTelemetry:
+        def record_token_usage(self, *a):
+            self.hist.record(a)
+
+        def set_engine_gauges(self, *a):
+            self.gauge.set(a)
+
+    class NoopTelemetry(OpenTelemetry):
+        def record_token_usage(self, *a):
+            pass
+    """
+    findings = lint(bad, select="telemetry-noop-drift")
+    assert len(findings) == 1 and "set_engine_gauges" in findings[0].message
+
+
+def test_telemetry_noop_drift_good_twin_overrides_everything():
+    good = """
+    class OpenTelemetry:
+        def record_token_usage(self, *a):
+            self.hist.record(a)
+
+        def set_engine_gauges(self, *a):
+            self.gauge.set(a)
+
+        def expose_prometheus(self):
+            return ""  # not a recorder: no override required
+
+    class NoopTelemetry(OpenTelemetry):
+        def record_token_usage(self, *a):
+            pass
+
+        def set_engine_gauges(self, *a):
+            pass
+    """
+    assert lint(good, select="telemetry-noop-drift") == []
+
+
+def test_telemetry_noop_drift_holds_on_the_real_module():
+    """The lint-time guard agrees with the runtime drift test in
+    tests/test_metric_lint.py (which stays as a self-check)."""
+    findings, errors = run_paths(
+        ["inference_gateway_tpu/otel/otel.py"], REPO_ROOT,
+        select={"telemetry-noop-drift"})
+    assert errors == []
+    assert findings == [], [f.render() for f in findings]
+
+
+# ----------------------------------------------------------------------
+# pragmas + baseline mechanics
+# ----------------------------------------------------------------------
+
+def test_standalone_pragma_line_covers_next_line():
+    src = """
+    import time
+
+    def f():
+        # graftlint: disable=clock-discipline
+        return time.monotonic()
+    """
+    assert lint(src, select="clock-discipline") == []
+
+
+def test_file_pragma_disables_checker_for_whole_module():
+    src = """
+    # graftlint: disable-file=clock-discipline
+    import time
+
+    def f():
+        return time.monotonic()
+
+    def g():
+        return time.sleep(1)
+    """
+    assert lint(src, select="clock-discipline") == []
+
+
+def test_baseline_absorbs_known_findings_and_reports_stale(tmp_path):
+    bad = """
+    import time
+
+    def f():
+        return time.monotonic()
+    """
+    findings = lint(bad, select="clock-discipline")
+    assert findings
+    path = tmp_path / "baseline.json"
+    baseline_mod.save(path, findings)
+    result = baseline_mod.apply(findings, baseline_mod.load(path))
+    assert result.new == [] and len(result.baselined) == 1 and result.stale == []
+    # The same baseline does NOT absorb a different finding…
+    other = lint(bad.replace("monotonic", "time"), select="clock-discipline")
+    result2 = baseline_mod.apply(other, baseline_mod.load(path))
+    assert len(result2.new) == 1
+    # …and the unmatched entry is reported stale (burn-down visibility).
+    assert len(result2.stale) == 1
+
+
+# ----------------------------------------------------------------------
+# THE GATE: the real package is clean (tier-1)
+# ----------------------------------------------------------------------
+
+def test_package_has_zero_nonbaselined_violations():
+    """`python -m graftlint inference_gateway_tpu` must exit 0: every
+    finding is fixed, pragma'd with a reason, or grandfathered in the
+    committed baseline."""
+    findings, errors = run_paths(["inference_gateway_tpu"], REPO_ROOT)
+    assert errors == []
+    base = baseline_mod.load(BASELINE_PATH)
+    result = baseline_mod.apply(findings, base)
+    assert result.new == [], "new graftlint violations:\n" + "\n".join(
+        f.render() for f in result.new)
+
+
+def test_baseline_is_empty_for_resilience_and_serving():
+    """Acceptance criterion: violations in resilience/ and serving/ were
+    FIXED, not baselined (and as shipped the whole baseline is empty)."""
+    data = json.loads(BASELINE_PATH.read_text())
+    for key in data.get("findings", {}):
+        assert "inference_gateway_tpu/resilience/" not in key, key
+        assert "inference_gateway_tpu/serving/" not in key, key
+
+
+def test_cli_entrypoint_runs_clean():
+    from graftlint.__main__ import main
+
+    assert main(["--list-checkers"]) == 0
+    assert main(["inference_gateway_tpu", "--root", str(REPO_ROOT)]) == 0
+
+
+# ----------------------------------------------------------------------
+# Regression: the real bug the suite found (serving/server.py span
+# finalization lost the root span when a child-span build raised).
+# ----------------------------------------------------------------------
+
+class _FakeTokenizer:
+    eos_token_id = 0
+
+
+class _FakeEngineConfig:
+    model = "fake"
+    max_slots = 2
+    max_seq_len = 64
+    max_prefill_batch = 2
+    pipeline_depth = 1
+    decode_chunk = 1
+
+
+class _FakeEngine:
+    config = _FakeEngineConfig()
+    tokenizer = _FakeTokenizer()
+    vision_cfg = None
+    spec = False
+    spec_ngram = False
+    metrics: dict = {}
+    allocator = None
+    prefix_cache = None
+
+    def context_window(self):
+        return 64
+
+    def max_prompt_len(self, multimodal=False):
+        return self.context_window() - 1
+
+    def kv_utilization(self):
+        return 0.0
+
+
+def test_sidecar_adopts_external_scheduler_clock():
+    """The health staleness comparison must read the SAME timebase the
+    scheduler stamps last_step_time on — a sidecar given an external
+    scheduler adopts its clock (code-review finding: a virtual-clock
+    scheduler against a real-clock server would report permanently
+    degraded)."""
+    from inference_gateway_tpu.resilience.clock import VirtualClock
+    from inference_gateway_tpu.serving.scheduler import Scheduler
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    engine = _FakeEngine()
+    vclock = VirtualClock()
+    sidecar = SidecarServer(engine, scheduler=Scheduler(engine, clock=vclock),
+                            served_model_name="fake")
+    assert sidecar._clock is vclock
+
+
+def test_root_span_survives_child_span_failure():
+    from inference_gateway_tpu.otel.tracing import Tracer
+    from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    class ExplodingTracer(Tracer):
+        def start_span(self, name, **kw):
+            if name != "tpu_sidecar.chat_completions":
+                raise RuntimeError("child span materialization failed")
+            return super().start_span(name, **kw)
+
+    tracer = ExplodingTracer("tpu-sidecar", enabled=True)
+    engine = _FakeEngine()
+    sidecar = SidecarServer(engine, scheduler=Scheduler(engine),
+                            served_model_name="fake", tracer=tracer)
+    gen = GenRequest(prompt_ids=[1, 2, 3])
+    gen.request_id = "req-test"
+    gen.phase_ns.update(submit=1_000, admit=2_000, first_token=3_000,
+                        finish=4_000)
+    meta = {"id": "chatcmpl-x", "model": "fake", "prompt_tokens": 3}
+    with pytest.raises(RuntimeError):
+        sidecar._finalize_request(gen, meta, None, 2, stream=False,
+                                  finish_reason="stop")
+    spans = tracer.drain()
+    roots = [s for s in spans if s.name == "tpu_sidecar.chat_completions"]
+    assert roots and roots[0].end_ns, (
+        "root span must be finalized (and exported) even when a child "
+        "span build raises — pre-fix it leaked unexported")
