@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import resource
 import sys
 import time
@@ -757,6 +758,172 @@ async def bench_preemption_overhead(n: int = 60, max_tokens: int = 24) -> dict:
     }
 
 
+async def bench_affinity_routing(requests: int = 12, max_tokens: int = 8,
+                                 chaos_tokens: int = 48) -> dict:
+    """Fleet prefix-affinity routing (ISSUE 11): TTFT and prefix-cache
+    hit rate over a shared-system-prompt workload through a two-replica
+    fleet, affinity on vs off — affinity pins the shared head to ONE
+    replica whose PrefixCache then serves every prefill, where
+    round-robin splits the workload and halves the hit rate — plus a
+    drain-migration chaos case (planned drain mid-stream, spliced onto
+    the other replica) and an unplanned kill riding ``Fault.cut_stream``
+    for comparison."""
+    from inference_gateway_tpu.main import build_gateway
+    from inference_gateway_tpu.resilience.faults import (
+        Fault,
+        FaultInjectingClient,
+        FaultScript,
+    )
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    # ~285 bytes: longer than the 256-byte affinity budget (tails never
+    # change the key) yet small enough to fit the tiny engine's window
+    # with decode room to spare.
+    shared_system = "You are a precise assistant with a long standing brief. " * 5
+
+    engine_cfg = EngineConfig(model="test-tiny", max_slots=4, max_seq_len=512,
+                              dtype="float32", max_prefill_batch=2, use_mesh=False,
+                              attention="paged", page_size=8, prefix_cache=True,
+                              decode_chunk=2)
+
+    def chat_body(tail: str, tokens: int) -> bytes:
+        return json.dumps({
+            "model": "pool-bench", "stream": True, "temperature": 0,
+            "max_tokens": tokens,
+            "messages": [{"role": "system", "content": shared_system},
+                         {"role": "user", "content": tail}]}).encode()
+
+    async def build_fleet(tmp: str, affinity: bool):
+        sidecars = [SidecarServer(Engine(engine_cfg), served_model_name="test-tiny",
+                                  accounting_enable=False)
+                    for _ in range(2)]
+        ports = [await sc.start("127.0.0.1", 0) for sc in sidecars]
+        pools = os.path.join(tmp, f"pools-{affinity}.yaml")
+        with open(pools, "w") as f:
+            f.write("pools:\n  - model: pool-bench\n    deployments:\n")
+            for name, port in zip("ab", ports):
+                f.write(f"      - {{provider: tpu, model: bench@{name}, "
+                        f"serve_model: test-tiny, "
+                        f"url: \"http://127.0.0.1:{port}/v1\"}}\n")
+        gw = build_gateway(env={
+            "TPU_API_URL": f"http://127.0.0.1:{ports[0]}/v1",
+            "ROUTING_ENABLED": "true", "ROUTING_CONFIG_PATH": pools,
+            "ROUTING_AFFINITY_ENABLED": "true" if affinity else "false",
+            "ROUTING_AFFINITY_PREFIX_BYTES": "256",
+            "SERVER_PORT": "0", "TELEMETRY_ENABLE": "true",
+            "TELEMETRY_METRICS_PORT": "0",
+            "RESILIENCE_PROBE_ENABLED": "false",
+        })
+        gw_port = await gw.start("127.0.0.1", 0)
+        return gw, gw_port, sidecars
+
+    async def one_stream(gw_port: int, body: bytes) -> tuple[float, bytes]:
+        client = HTTPClient()
+        t0 = time.perf_counter()
+        resp = await client.post(
+            f"http://127.0.0.1:{gw_port}/v1/chat/completions", body, stream=True)
+        ttft = None
+        out = b""
+        async for block in resp.iter_raw():
+            if ttft is None and b'"content":' in block:
+                ttft = time.perf_counter() - t0
+            out += block
+        return (ttft if ttft is not None else time.perf_counter() - t0), out
+
+    import tempfile
+
+    async def run_variant(affinity: bool) -> dict:
+        with tempfile.TemporaryDirectory() as tmp:
+            gw, gw_port, sidecars = await build_fleet(tmp, affinity)
+            try:
+                ttfts = []
+                for i in range(requests):
+                    ttft, _ = await one_stream(gw_port,
+                                               chat_body(f"question {i}", max_tokens))
+                    ttfts.append(ttft)
+                stats = [sc.engine.prefix_cache.stats() for sc in sidecars]
+                hits = sum(s["hits"] for s in stats)
+                misses = sum(s["misses"] for s in stats)
+                ttfts.sort()
+                return {
+                    "mean_ttft_ms": round(sum(ttfts) / len(ttfts) * 1000, 3),
+                    "p99_ttft_ms": round(
+                        ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] * 1000, 3),
+                    "prefix_cache_hits": hits,
+                    "prefix_cache_misses": misses,
+                    "prefix_cache_hit_rate": round(hits / max(1, hits + misses), 3),
+                }
+            finally:
+                await gw.shutdown()
+                for sc in sidecars:
+                    await sc.shutdown()
+
+    async def run_chaos() -> dict:
+        with tempfile.TemporaryDirectory() as tmp:
+            gw, gw_port, sidecars = await build_fleet(tmp, True)
+            try:
+                # Planned drain mid-stream: the serving replica is
+                # drained after the first content frames; the stream
+                # must complete via the continuation splice.
+                client = HTTPClient()
+                body = chat_body("chaos drain", chaos_tokens)
+                resp = await client.post(
+                    f"http://127.0.0.1:{gw_port}/v1/chat/completions", body,
+                    stream=True)
+                served = resp.headers.get("X-Selected-Model")
+                out = b""
+                drained = False
+                async for block in resp.iter_raw():
+                    out += block
+                    if not drained and out.count(b'"content":') >= 2:
+                        drained = True
+                        await gw.migrator.drain("tpu", served)
+                migrated = gw.otel.streams_migrated_counter.values()
+                await gw.migrator.undrain("tpu", served)
+
+                # Unplanned kill riding Fault.cut_stream: same splice,
+                # counted as recovery (not migration). Delta, so the
+                # drain case's own recovery doesn't inflate it.
+                before = sum(gw.otel.streams_recovered_counter.values().values())
+                script = (FaultScript()
+                          .script("/proxy/tpu/", Fault.cut_stream(after_frames=4))
+                          .default("/proxy/tpu/", Fault.passthrough()))
+                real = gw.router_impl.client
+                gw.router_impl.client = FaultInjectingClient(script, inner=real)
+                try:
+                    _ttft, cut_out = await one_stream(
+                        gw_port, chat_body("chaos cut", chaos_tokens))
+                finally:
+                    gw.router_impl.client = real
+                recovered_delta = (sum(
+                    gw.otel.streams_recovered_counter.values().values()) - before)
+                return {
+                    "drain_completed": out.endswith(b"data: [DONE]\n\n"),
+                    "drain_migrated": sum(v for k, v in migrated.items()
+                                          if k[-1] == "drain"),
+                    "cut_completed": cut_out.endswith(b"data: [DONE]\n\n"),
+                    "cut_recovered": recovered_delta,
+                }
+            finally:
+                await gw.shutdown()
+                for sc in sidecars:
+                    await sc.shutdown()
+
+    on = await run_variant(True)
+    off = await run_variant(False)
+    chaos = await run_chaos()
+    return {
+        "bench": "affinity_routing",
+        "requests": requests,
+        "affinity_on": on,
+        "affinity_off": off,
+        "hit_rate_gain": round(on["prefix_cache_hit_rate"]
+                               - off["prefix_cache_hit_rate"], 3),
+        "chaos": chaos,
+    }
+
+
 async def main() -> None:
     results = [
         await bench_chat_completions(),
@@ -785,6 +952,7 @@ async def main() -> None:
         await bench_compute_efficiency(),
         await bench_accounting_overhead(),
         await bench_preemption_overhead(),
+        await bench_affinity_routing(),
     ]
     for r in results:
         print(json.dumps(r))

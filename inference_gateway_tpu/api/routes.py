@@ -75,11 +75,19 @@ def _failure_category(e: Exception) -> str:
 @dataclass
 class _Candidate:
     """One failover target: a built provider plus its (provider, model)
-    breaker key — Deployment-shaped for Resilience.execute."""
+    breaker key — Deployment-shaped for Resilience.execute. ``model`` is
+    the replica IDENTITY (breakers, probes, ring, telemetry);
+    ``serve_model`` is the model name actually sent upstream (ISSUE 11:
+    fleet replicas of one model carry unique routing ids)."""
 
     provider_obj: Any
     provider: str
     model: str
+    serve_model: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.serve_model:
+            self.serve_model = self.model
 
 
 class _MessagesPassthrough(Exception):
@@ -109,6 +117,7 @@ class RouterImpl:
         selector: routing.Selector | None = None,
         resilience: Resilience | None = None,
         overload=None,
+        fleet_urls: dict[str, set[str]] | None = None,
     ) -> None:
         self.cfg = cfg
         self.registry = registry
@@ -124,6 +133,11 @@ class RouterImpl:
         # Admission/drain ledger (ISSUE 2): the health handler consults
         # it so LBs see readiness fail the moment a drain begins.
         self.overload = overload
+        # Per-provider allowlist of fleet deployment base URLs (ISSUE
+        # 11): the only values the /proxy hop's X-Fleet-Url override may
+        # take — sourced from the operator's own pools file, so the hop
+        # can never be steered to an arbitrary host.
+        self.fleet_urls = fleet_urls or {}
 
     # -- wiring --------------------------------------------------------
     def build_router(self) -> Router:
@@ -142,8 +156,8 @@ class RouterImpl:
         return r
 
     # -- helpers -------------------------------------------------------
-    def _build_provider(self, provider_id: str):
-        return self.registry.build_provider(provider_id, self.client)
+    def _build_provider(self, provider_id: str, url: str | None = None):
+        return self.registry.build_provider(provider_id, self.client, url=url)
 
     def _provider_error(self, e: Exception, provider_id: str, envelope=error_json) -> Response:
         if isinstance(e, ProviderNotConfiguredError):
@@ -287,7 +301,7 @@ class RouterImpl:
             return error_json("Invalid request: " + "; ".join(problems), 400)
 
         original_model = body.get("model") or ""
-        route = self._resolve_route(req, original_model)
+        route = self._resolve_route(req, original_model, body)
         if isinstance(route, Response):
             return route
         candidates, alias = route
@@ -300,9 +314,13 @@ class RouterImpl:
 
         def request_for(cand: _Candidate) -> dict[str, Any]:
             out = dict(body)
-            out["model"] = cand.model
+            # serve_model, not the replica id: upstream envelopes must be
+            # identical across fleet replicas (the migration splice's
+            # byte-identity depends on it).
+            out["model"] = cand.serve_model
             out["messages"] = self._vision_gate(
-                cand.provider_obj, cand.provider, cand.model, body.get("messages") or [])
+                cand.provider_obj, cand.provider, cand.serve_model,
+                body.get("messages") or [])
             return out
 
         if body.get("stream"):
@@ -369,7 +387,8 @@ class RouterImpl:
         return resp
 
     # ------------------------------------------------------------------
-    def _resolve_route(self, req: Request, original_model: str):
+    def _resolve_route(self, req: Request, original_model: str,
+                       body: dict[str, Any] | None = None):
         """Shared model-routing for chat-shaped endpoints (chat
         completions + responses): routing-pool alias resolution,
         provider/model prefix parsing, allow/deny enforcement on the
@@ -378,13 +397,26 @@ class RouterImpl:
         (healthy replicas first for pool routes; a single candidate for
         direct routes; ``alias`` is the pool alias or "") — or an error
         Response. One implementation so the two endpoints can never
-        drift (code-review round 3)."""
+        drift (code-review round 3).
+
+        With a fleet selector (ISSUE 11) and a request ``body``, pool
+        ordering is prefix-affine: the prompt head's affinity key steers
+        the request to the deployment whose PrefixCache already holds
+        its pages. The key is derived only when the selector advertises
+        affinity, so non-fleet routes pay nothing."""
         model = original_model
         provider_id = req.query_get("provider")
         alias = ""
         deployments: list[routing.Deployment] | None = None
         if self.selector is not None and not provider_id:
-            deployments = self.selector.select_candidates(model)
+            akey = None
+            if body is not None and getattr(self.selector, "affinity_enabled", False):
+                from inference_gateway_tpu.fleet.affinity import affinity_key
+
+                akey = affinity_key(
+                    body.get("messages") or body.get("input"),
+                    getattr(self.selector, "affinity_prefix_bytes", 1024))
+            deployments = self.selector.select_candidates(model, affinity_key=akey)
             if deployments:
                 alias = original_model
                 self.logger.debug("routed logical model", "alias", original_model,
@@ -411,14 +443,15 @@ class RouterImpl:
         build_err_pid = ""
         for d in deployments:
             try:
-                provider = self._build_provider(d.provider)
+                provider = self._build_provider(d.provider, url=d.url or None)
             except (ProviderNotFoundError, ProviderNotConfiguredError) as e:
                 build_err, build_err_pid = e, d.provider
                 if alias:
                     self.logger.warn("pool deployment provider unavailable",
                                      "alias", alias, "provider", d.provider)
                 continue
-            candidates.append(_Candidate(provider, d.provider, d.model))
+            candidates.append(_Candidate(provider, d.provider, d.model,
+                                         serve_model=getattr(d, "serve_model", "")))
         if not candidates:
             return self._provider_error(build_err, build_err_pid)
         return candidates, alias
@@ -497,7 +530,7 @@ class RouterImpl:
         # one implementation (routes.py _resolve_route), so pool aliases,
         # allow/deny semantics, and the vision gate can never drift
         # between the two endpoints.
-        route = self._resolve_route(req, original_model)
+        route = self._resolve_route(req, original_model, body)
         if isinstance(route, Response):
             return route
         candidates, alias = route
@@ -509,9 +542,10 @@ class RouterImpl:
             event["alias"] = alias
 
         def chat_req_for(cand: _Candidate) -> dict[str, Any]:
-            chat_req = responses_to_chat_request(dict(body, model=cand.model))
+            chat_req = responses_to_chat_request(dict(body, model=cand.serve_model))
             chat_req["messages"] = self._vision_gate(
-                cand.provider_obj, cand.provider, cand.model, chat_req.get("messages") or [])
+                cand.provider_obj, cand.provider, cand.serve_model,
+                chat_req.get("messages") or [])
             return chat_req
 
         if body.get("stream"):
@@ -818,6 +852,17 @@ class RouterImpl:
         headers.remove("Host")
         headers.remove("Content-Length")
         headers.remove("Connection")
+        # Fleet replica routing (ISSUE 11): the provider layer re-targets
+        # the hop to one deployment's own base URL via X-Fleet-Url. Only
+        # URLs the operator's pools file declares for THIS provider are
+        # honored — anything else is rejected, so the hop (which attaches
+        # provider credentials below) can never become an open proxy.
+        fleet_url = (req.headers.get("X-Fleet-Url") or "").strip()
+        headers.remove("X-Fleet-Url")
+        if fleet_url and fleet_url not in (self.fleet_urls.get(provider_id) or set()):
+            self.logger.warn("rejected unregistered fleet url", "provider",
+                             provider_id, "url", fleet_url)
+            return error_json("Unknown fleet deployment URL", 403)
         try:
             query = apply_provider_auth(headers, provider.cfg, req.query)
         except ValueError:
@@ -825,7 +870,7 @@ class RouterImpl:
         if req.ctx.get("traceparent"):
             headers.set("traceparent", req.ctx["traceparent"])
 
-        base = provider.cfg.url.rstrip("/")
+        base = (fleet_url or provider.cfg.url).rstrip("/")
         path = req.params.get("path", "/")
         url = base + "/" + path.lstrip("/")
         if query:

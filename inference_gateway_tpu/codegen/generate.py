@@ -165,6 +165,27 @@ _RESILIENCE_FAILURE_MODES = [
 ]
 
 
+# Emitted under the Routing section of Configurations.md: the fleet
+# data plane in one paragraph (ISSUE 11); details in docs/routing.md.
+_ROUTING_FLEET_DOC = [
+    "### Fleet routing",
+    "",
+    "With pools configured, the gateway routes by prompt-prefix affinity:",
+    "the leading `ROUTING_AFFINITY_PREFIX_BYTES` of the message list hash",
+    "onto a consistent-hash ring over the pool's deployments, so requests",
+    "sharing a system prompt land where the sidecar's PrefixCache already",
+    "holds their pages. An affine deployment whose `/health` load report",
+    "says it is saturated (`ROUTING_SPILL_*`) is skipped for the next ring",
+    "candidate (bounded load). Live streams migrate off a draining or",
+    "restarting replica via the continuation splice",
+    "(`POST /debug/fleet/drain?provider=&model=` on the metrics listener),",
+    "and the cluster's reported backlog feeds admission control. Ring",
+    "layout, key derivation, migration lifecycle, and pool-admission",
+    "semantics: [docs/routing.md](docs/routing.md).",
+    "",
+]
+
+
 # Emitted under the Overload section of Configurations.md: shed-order
 # table + LB readiness semantics (ISSUE 2 satellite).
 _OVERLOAD_DRAIN_DOC = [
@@ -216,6 +237,8 @@ def generate_configurations_md(spec: dict) -> str:
         elif section == "serving":
             out.extend(_SERVING_DATA_PLANE_DOC)
             out.extend(_SERVING_FAULT_TOLERANCE_DOC)
+        elif section == "routing":
+            out.extend(_ROUTING_FLEET_DOC)
         elif section == "resilience":
             out.extend(_RESILIENCE_FAILURE_MODES)
         elif section == "overload":
@@ -431,6 +454,8 @@ def check_config_defaults(spec: dict) -> list[str]:
         "SERVING_WATCHDOG_INTERVAL": cfg.serving.watchdog_interval,
         "SERVING_WATCHDOG_MULTIPLIER": cfg.serving.watchdog_multiplier,
         "SERVING_WATCHDOG_MIN_DEADLINE": cfg.serving.watchdog_min_deadline,
+        "SERVING_MIGRATE_STREAMS": cfg.serving.migrate_streams,
+        "SERVING_ADMIN_ENABLED": cfg.serving.admin_enabled,
         "CLIENT_TIMEOUT": cfg.client.timeout,
         "CLIENT_MAX_IDLE_CONNS": cfg.client.max_idle_conns,
         "CLIENT_MAX_IDLE_CONNS_PER_HOST": cfg.client.max_idle_conns_per_host,
@@ -441,6 +466,11 @@ def check_config_defaults(spec: dict) -> list[str]:
         "CLIENT_EXPECT_CONTINUE_TIMEOUT": cfg.client.expect_continue_timeout,
         "ROUTING_ENABLED": cfg.routing.enabled,
         "ROUTING_CONFIG_PATH": cfg.routing.config_path,
+        "ROUTING_AFFINITY_ENABLED": cfg.routing.affinity_enabled,
+        "ROUTING_AFFINITY_PREFIX_BYTES": cfg.routing.affinity_prefix_bytes,
+        "ROUTING_AFFINITY_VNODES": cfg.routing.affinity_vnodes,
+        "ROUTING_SPILL_QUEUE_DEPTH": cfg.routing.spill_queue_depth,
+        "ROUTING_SPILL_KV_HIGH_WATER": cfg.routing.spill_kv_high_water,
         "RESILIENCE_ENABLED": cfg.resilience.enabled,
         "RESILIENCE_BREAKER_FAILURE_THRESHOLD": cfg.resilience.breaker_failure_threshold,
         "RESILIENCE_BREAKER_COOLDOWN": cfg.resilience.breaker_cooldown,

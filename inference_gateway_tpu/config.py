@@ -374,6 +374,16 @@ class ServingConfig:
     watchdog_interval: float = 1.0
     watchdog_multiplier: float = 20.0
     watchdog_min_deadline: float = 60.0
+    # Planned live migration (ISSUE 11): drain/restart end live SSE
+    # streams at a token boundary with no terminal frame so a
+    # continuation-capable gateway splices them onto another replica.
+    # False restores terminal "error" frames on restart (and drain only
+    # blocks new work) — the pre-fleet contract for bare clients.
+    migrate_streams: bool = True
+    # The sidecar /admin/* surface (drain/undrain/migration record) is
+    # unauthenticated like the rest of the listener; false removes the
+    # routes for deployments exposed beyond the gateway network.
+    admin_enabled: bool = True
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "SERVING_") -> "ServingConfig":
@@ -394,21 +404,36 @@ class ServingConfig:
             watchdog_interval=_get_duration(env, prefix + "WATCHDOG_INTERVAL", "1s"),
             watchdog_multiplier=_get_float(env, prefix + "WATCHDOG_MULTIPLIER", 20.0),
             watchdog_min_deadline=_get_duration(env, prefix + "WATCHDOG_MIN_DEADLINE", "60s"),
+            migrate_streams=_get_bool(env, prefix + "MIGRATE_STREAMS", True),
+            admin_enabled=_get_bool(env, prefix + "ADMIN_ENABLED", True),
         )
 
 
 @dataclass
 class RoutingConfig:
-    """ROUTING_* (config.go:98-101)."""
+    """ROUTING_* (config.go:98-101), plus the fleet-router surface
+    (ISSUE 11): prefix-affinity consistent-hash routing over pool
+    deployments (``AFFINITY_*``) and the bounded-load spill thresholds
+    (``SPILL_*``) fed by the /health load reports the prober collects."""
 
     enabled: bool = False
     config_path: str = ""
+    affinity_enabled: bool = True
+    affinity_prefix_bytes: int = 1024
+    affinity_vnodes: int = 64
+    spill_queue_depth: int = 4
+    spill_kv_high_water: float = 0.9
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "ROUTING_") -> "RoutingConfig":
         return cls(
             enabled=_get_bool(env, prefix + "ENABLED", False),
             config_path=_get_str(env, prefix + "CONFIG_PATH"),
+            affinity_enabled=_get_bool(env, prefix + "AFFINITY_ENABLED", True),
+            affinity_prefix_bytes=_get_int(env, prefix + "AFFINITY_PREFIX_BYTES", 1024),
+            affinity_vnodes=_get_int(env, prefix + "AFFINITY_VNODES", 64),
+            spill_queue_depth=_get_int(env, prefix + "SPILL_QUEUE_DEPTH", 4),
+            spill_kv_high_water=_get_float(env, prefix + "SPILL_KV_HIGH_WATER", 0.9),
         )
 
 

@@ -1,0 +1,21 @@
+"""Fleet router: the multi-sidecar data plane (ISSUE 11).
+
+Grows ``providers/routing`` from a failover list into a serving-aware
+scheduler over the pool:
+
+- :mod:`ring` / :mod:`affinity` — deterministic consistent-hash ring +
+  prompt-prefix affinity keys, so requests sharing a system prompt land
+  where ``PrefixCache`` already holds their pages.
+- :mod:`router` — ``FleetRouter``, the affinity- and load-aware
+  ``Selector`` with bounded-load spill and the cluster admission signal.
+- :mod:`migration` — ``FleetMigrator``, the gateway-side coordinator for
+  planned live stream migration off a draining or restarting sidecar
+  (rides the PR 9 continuation splice; clients never notice).
+"""
+
+from inference_gateway_tpu.fleet.affinity import affinity_key
+from inference_gateway_tpu.fleet.migration import FleetMigrator, admin_url
+from inference_gateway_tpu.fleet.ring import HashRing
+from inference_gateway_tpu.fleet.router import FleetRouter
+
+__all__ = ["HashRing", "FleetRouter", "FleetMigrator", "affinity_key", "admin_url"]

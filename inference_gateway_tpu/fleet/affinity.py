@@ -1,0 +1,113 @@
+"""Affinity-key derivation (ISSUE 11 tentpole a).
+
+The cheapest prefill is the one the replica already holds: pages for a
+shared system prompt + few-shot head sit in that replica's
+``PrefixCache`` (Ragged Paged Attention context, arxiv 2604.15464), so
+the router keys each request by the prompt's LEADING bytes and hashes
+that key onto the ring. Only the head participates — the user's tail
+varies per request, and including it would spray one logical workload
+across the whole fleet.
+
+The key is derived from the request's message list, not its token ids:
+the gateway never tokenizes (that is the sidecar's job), and byte
+prefixes are tokenizer-agnostic across mixed-runtime pools. Role and
+content are joined with unambiguous separators so ("ab", "c") can never
+collide with ("a", "bc") across message boundaries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+DEFAULT_PREFIX_BYTES = 1024
+
+# Unit separator / record separator: cannot appear in the role strings
+# and survive json round trips inside content untouched (they are just
+# bytes to the hash — the framing only has to be injective).
+_FIELD_SEP = b"\x1f"
+_RECORD_SEP = b"\x1e"
+
+
+# Structured-content clipping bounds: parts beyond these never reach
+# the hash anyway (the budget is spent long before), they just cost.
+_CLIP_MAX_ITEMS = 32
+_CLIP_MAX_DEPTH = 6
+
+
+def _clip(obj: Any, limit: int, depth: int = 0) -> Any:
+    """Deterministically truncate structured content before
+    serialization: the key only ever consumes the first ``limit``-ish
+    bytes, so serializing a 10 MB inline image part in full would be
+    pure hot-path waste (code-review finding). String leaves clip to
+    ``limit`` chars (≥ limit bytes in UTF-8 — more than the budget can
+    consume); containers clip in size and depth."""
+    if isinstance(obj, str):
+        return obj[:limit]
+    if depth >= _CLIP_MAX_DEPTH:
+        return None
+    if isinstance(obj, list):
+        return [_clip(v, limit, depth + 1) for v in obj[:_CLIP_MAX_ITEMS]]
+    if isinstance(obj, dict):
+        return {k: _clip(v, limit, depth + 1)
+                for k in sorted(map(str, obj))[:_CLIP_MAX_ITEMS]
+                for v in (obj.get(k),)}
+    return obj
+
+
+def _content_bytes(content: Any, limit: int) -> bytes:
+    """Canonical bytes for a message's content field, bounded to ~the
+    key budget. Strings pass through (clipped); structured content
+    (vision parts, tool results) serializes with sorted keys so
+    logically-equal requests key identically."""
+    if isinstance(content, str):
+        return content[:limit].encode("utf-8", "surrogatepass")
+    if content is None:
+        return b""
+    try:
+        return json.dumps(_clip(content, limit), sort_keys=True,
+                          ensure_ascii=True, default=str).encode()
+    except (TypeError, ValueError):
+        return repr(content)[:limit].encode("utf-8", "surrogatepass")
+
+
+def affinity_key(messages: Any, prefix_bytes: int = DEFAULT_PREFIX_BYTES) -> str | None:
+    """Hash of the prompt's leading ``prefix_bytes`` bytes.
+
+    Accepts a chat ``messages`` list (each a role/content dict) or a
+    bare string (the Responses API's string ``input``). Returns a hex
+    digest, or None when there is nothing to key on — the router then
+    falls back to round-robin, so a keyless request costs nothing.
+
+    Requests sharing a head longer than ``prefix_bytes`` produce the
+    SAME key regardless of their tails; heads that diverge inside the
+    budget produce different keys (they would not share prefix pages
+    anyway).
+    """
+    budget = max(1, int(prefix_bytes))
+    h = hashlib.sha1()
+    used = 0
+
+    def feed(seg: bytes) -> bool:
+        nonlocal used
+        take = seg[: budget - used]
+        h.update(take)
+        used += len(take)
+        return used >= budget
+
+    if isinstance(messages, str):
+        if messages:
+            feed(messages[:budget].encode("utf-8", "surrogatepass"))
+    elif isinstance(messages, list):
+        for m in messages:
+            if not isinstance(m, dict):
+                continue
+            role = str(m.get("role") or "").encode("utf-8", "surrogatepass")
+            seg = (role + _FIELD_SEP
+                   + _content_bytes(m.get("content"), budget) + _RECORD_SEP)
+            if feed(seg):
+                break
+    if used == 0:
+        return None
+    return h.hexdigest()

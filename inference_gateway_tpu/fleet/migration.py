@@ -1,0 +1,196 @@
+"""Planned live stream migration (ISSUE 11 tentpole b), gateway side.
+
+PR 9 built the hard half: a stream that dies after the first byte
+re-establishes on the next continuation-capable replica with the
+generated-so-far prefix and splices byte-identically. This module makes
+that machinery PROACTIVE: ``FleetMigrator.drain`` marks a deployment
+draining at the gateway (it immediately leaves the healthy ordering) and
+posts the sidecar's ``/admin/drain`` endpoint, which ends every live SSE
+stream at a token boundary WITHOUT a terminal frame — exactly the death
+shape the continuation splice resumes from — so in-flight streams flow
+onto another replica with byte-identical client output, one trace id,
+and once-only billing. The same classification covers engine-watchdog
+restarts (PR 7): the sidecar migrates its streams before aborting the
+wedged scheduler, and the prober's last /health verdict ("degraded")
+attributes the hop.
+
+``fetch_migration`` is what distinguishes a *migration* from a mere
+*recovery*: the replica that cut a stream over publishes a per-stream
+record (exact resume ids + reason), and only that evidence makes the
+death planned — counted as
+``inference_gateway.streams_migrated{reason}`` rather than just
+``streams_recovered``, exempted from the circuit breaker (a replica
+taken out on purpose is not ill), and resumed from authoritative ids.
+Deaths at a draining-or-degraded replica WITHOUT a record stay plain
+failures, so a stalled engine can never launder its errors as planned
+migrations.
+
+What cannot migrate is unchanged from the continuation contract
+(docs/routing.md "Migration lifecycle"): completed streams, overflowed
+prefixes, non-continuation-capable providers, and sampled
+(temperature>0) streams only resume semantically, not byte-identically.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Any, Iterable, Mapping
+
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
+from inference_gateway_tpu.resilience.prober import service_origin
+
+
+def admin_url(base_url: str, action: str) -> str:
+    """Sidecar admin endpoint for a deployment base URL: ``/admin/*``
+    lives at the service origin (one shared normalization with the
+    health prober's ``probe_url``)."""
+    return f"{service_origin(base_url)}/admin/{action}"
+
+
+class FleetMigrator:
+    """Drain coordination + migration-reason attribution for one pool set.
+
+    ``urls`` maps each (provider, model) deployment to its base URL (the
+    per-deployment override or the provider default). ``admin_keys``
+    names the deployments that actually SPEAK the sidecar admin surface
+    (the TPU provider); all deployments can be drained at the routing
+    level, but /admin/drain posts and migration-record fetches go only
+    to admin-capable ones — a foreign cloud API must never receive
+    /admin/* requests (or completion ids) on a stream death
+    (code-review finding).
+    """
+
+    def __init__(self, urls: Mapping[tuple[str, str], str], client: Any = None, *,
+                 admin_keys: Iterable[tuple[str, str]] | None = None,
+                 otel: Any = None, logger: Any = None,
+                 clock: Clock | None = None) -> None:
+        self._urls: dict[tuple[str, str], str] = dict(urls)
+        self._admin_keys: set[tuple[str, str]] = (
+            set(admin_keys) if admin_keys is not None else set(self._urls))
+        self.client = client
+        self.otel = otel
+        self.logger = logger
+        self.clock: Clock = clock or MonotonicClock()
+        # (provider, model) -> clock.now() when the gateway began the
+        # drain. Gateway-initiated state is authoritative for ROUTING:
+        # it flips the health verdict the moment the operator asks, with
+        # no probe round-trip in between. (Migration ATTRIBUTION is
+        # evidence-based instead — see fetch_migration.)
+        self._draining: dict[tuple[str, str], float] = {}
+
+    # -- state -----------------------------------------------------------
+    def known(self, provider: str, model: str) -> bool:
+        return (provider, model) in self._urls
+
+    def draining(self, provider: str, model: str) -> bool:
+        return (provider, model) in self._draining
+
+    # -- drain orchestration --------------------------------------------
+    async def drain(self, provider: str, model: str) -> dict[str, Any]:
+        """Begin draining one deployment: demote it in routing NOW, then
+        tell its sidecar to migrate live streams and refuse new work.
+        Raises KeyError for a deployment no pool defines."""
+        key = (provider, model)
+        url = self._urls.get(key)
+        if url is None:
+            raise KeyError(f"unknown fleet deployment {provider}/{model}")
+        self._draining[key] = self.clock.now()
+        result: dict[str, Any] = {"provider": provider, "model": model,
+                                  "draining": True}
+        if self.logger is not None:
+            self.logger.info("fleet deployment draining", "provider", provider,
+                             "model", model)
+        if self.client is not None and key in self._admin_keys:
+            try:
+                resp = await self.client.post(admin_url(url, "drain"), b"")
+                result["sidecar_status"] = getattr(resp, "status", None)
+                try:
+                    result["sidecar"] = resp.json()
+                except (ValueError, AttributeError):
+                    pass
+            except Exception as e:
+                # The routing-side drain stands either way — an already
+                # dead sidecar has nothing left to migrate.
+                result["sidecar_error"] = repr(e)
+                if self.logger is not None:
+                    self.logger.warn("sidecar drain call failed", "provider",
+                                     provider, "model", model, "error", repr(e))
+        return result
+
+    async def undrain(self, provider: str, model: str) -> dict[str, Any]:
+        """Reverse a drain: readmit the deployment to routing and flip
+        the sidecar back to accepting work."""
+        key = (provider, model)
+        url = self._urls.get(key)
+        if url is None:
+            raise KeyError(f"unknown fleet deployment {provider}/{model}")
+        self._draining.pop(key, None)
+        result: dict[str, Any] = {"provider": provider, "model": model,
+                                  "draining": False}
+        if self.logger is not None:
+            self.logger.info("fleet deployment undrained", "provider", provider,
+                             "model", model)
+        if self.client is not None and key in self._admin_keys:
+            try:
+                resp = await self.client.post(admin_url(url, "undrain"), b"")
+                result["sidecar_status"] = getattr(resp, "status", None)
+            except Exception as e:
+                result["sidecar_error"] = repr(e)
+        return result
+
+    # Keep the post-death evidence fetch snappy: the replica is expected
+    # alive (drain/restart leave the process up); a wedged host must not
+    # stall the client's stream recovery for the full client timeout.
+    FETCH_TIMEOUT = 2.0
+
+    # -- migration-record handoff ----------------------------------------
+    async def fetch_migration(self, provider: str, model: str,
+                              completion_id: str) -> tuple[list[int], str] | None:
+        """The migration record a replica published for one stream it
+        migrated out (``GET /admin/migration?id=``): the EXACT resume
+        token ids plus the reason ("drain"/"restart").
+
+        This is the gateway's EVIDENCE that the death was planned — the
+        record exists only for streams the sidecar itself cut over, so a
+        merely-degraded (stalled) or merely-draining replica whose
+        stream died for real reasons is still treated as a failure
+        (breaker charged, counted as recovery, text-based resume). The
+        ids make the splice byte-identical even when the cut landed
+        mid-UTF-8 or mid-merge, where re-encoding the relayed text is
+        lossy. None on any failure — the PR 9 contract is the fallback,
+        not an error."""
+        key = (provider, model)
+        url = self._urls.get(key)
+        if (url is None or key not in self._admin_keys
+                or not completion_id or self.client is None):
+            return None
+        try:
+            # The id is ingested verbatim from upstream SSE frames —
+            # quote it, or a reserved character truncates the query.
+            resp = await self.client.get(
+                admin_url(url, "migration")
+                + "?id=" + urllib.parse.quote(completion_id, safe=""),
+                timeout=self.FETCH_TIMEOUT)
+            if getattr(resp, "status", 0) != 200:
+                return None
+            body = resp.json()
+            ids = body.get("token_ids") if isinstance(body, dict) else None
+            if not isinstance(ids, list):
+                return None
+            reason = str(body.get("reason") or "drain")
+            return [int(t) for t in ids], reason
+        except Exception:
+            return None
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        now = self.clock.now()
+        return {
+            "deployments": [
+                {"provider": p, "model": m, "url": u,
+                 "draining": (p, m) in self._draining,
+                 "draining_for_s": (round(now - self._draining[(p, m)], 3)
+                                    if (p, m) in self._draining else None)}
+                for (p, m), u in sorted(self._urls.items())
+            ],
+        }

@@ -1,0 +1,77 @@
+"""Deterministic consistent-hash ring (ISSUE 11 tentpole a).
+
+Prefix-affinity routing needs one property round-robin cannot give:
+requests carrying the same affinity key must land on the same deployment
+— across requests, across gateway processes, and across restarts — while
+adding or removing a deployment moves only ~1/N of the keyspace. A
+consistent-hash ring with virtual nodes is the standard construction;
+hashing goes through SHA-1 (any stable digest works) because Python's
+builtin ``hash`` is salted per process and would silently re-shard the
+whole fleet on every restart, defeating the ``PrefixCache`` locality the
+ring exists to protect.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Iterable
+
+
+def _point(data: bytes) -> int:
+    """Ring position: the first 8 bytes of SHA-1, as a big-endian int.
+    Stable across processes, platforms, and Python versions."""
+    return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+
+class HashRing:
+    """A consistent-hash ring over opaque node ids.
+
+    ``vnodes`` virtual points per node smooth the keyspace split (the
+    classic variance fix); ``candidates(key)`` returns EVERY node in ring
+    order from the key's position, so the caller gets the affine target
+    AND its deterministic spill order in one walk.
+    """
+
+    def __init__(self, nodes: Iterable[str], vnodes: int = 64) -> None:
+        self.vnodes = max(1, int(vnodes))
+        self.nodes: list[str] = []
+        seen: set[str] = set()
+        points: list[tuple[int, str]] = []
+        for node in nodes:
+            if node in seen:
+                continue
+            seen.add(node)
+            self.nodes.append(node)
+            for i in range(self.vnodes):
+                points.append((_point(f"{node}\x00{i}".encode()), node))
+        points.sort()
+        self._points: list[int] = [p for p, _ in points]
+        self._owners: list[str] = [n for _, n in points]
+
+    def owner(self, key: str) -> str | None:
+        """The affine node for ``key`` (None on an empty ring)."""
+        walk = self.candidates(key)
+        return walk[0] if walk else None
+
+    def candidates(self, key: str) -> list[str]:
+        """All nodes, ordered by the ring walk clockwise from ``key``.
+
+        The first entry is the affine target; each later entry is the
+        next distinct owner encountered — the deterministic spill chain
+        bounded-load routing falls through.
+        """
+        n = len(self._points)
+        if n == 0:
+            return []
+        idx = bisect.bisect_right(self._points, _point(key.encode()))
+        out: list[str] = []
+        seen: set[str] = set()
+        for k in range(n):
+            owner = self._owners[(idx + k) % n]
+            if owner not in seen:
+                seen.add(owner)
+                out.append(owner)
+                if len(out) == len(self.nodes):
+                    break
+        return out

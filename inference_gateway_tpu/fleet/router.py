@@ -1,0 +1,219 @@
+"""The serving-aware fleet router (ISSUE 11 tentpole a + c).
+
+``FleetRouter`` is a drop-in ``Selector`` that turns the routing pools
+from a failover list into a data plane:
+
+- **Prefix affinity** — with an affinity key (fleet/affinity.py) the
+  candidate order follows the pool's consistent-hash ring
+  (fleet/ring.py), so requests sharing a prompt head land where
+  ``PrefixCache`` already holds their pages. Keyless requests (and
+  ``ROUTING_AFFINITY_ENABLED=false``) keep the round-robin rotation.
+- **Bounded-load spill** — the affine target is skipped while its
+  reported load (the HealthProber's /health load report) says it is
+  saturated: scheduler queue backed up past ``ROUTING_SPILL_QUEUE_DEPTH``
+  or KV pages past ``ROUTING_SPILL_KV_HIGH_WATER``. Spill follows the
+  RING order (the next candidate is deterministic too), so a hot key's
+  overflow reuses at most one extra replica's cache instead of spraying.
+  When every replica is saturated the affine target leads anyway —
+  locality is still the cheapest place to queue.
+- **Pool admission signal** — ``cluster_queue_depth()`` (the MAXIMUM
+  over pools of each pool's min-healthy-replica backlog) feeds the
+  gateway ``OverloadController``: shedding and Retry-After hints see
+  cluster state, not one process. Min within a pool, because a pool has
+  headroom while any of its replicas does; max across pools, because
+  replicas never absorb another pool's work — an idle pool must not
+  mask a saturated one. An unreported deployment counts as 0, so
+  ignorance never sheds.
+
+Unhealthy replicas (breaker-open, probe-ejected, draining) are demoted
+to the tail exactly like ``Pool.candidates`` does — the failover walk
+contract is unchanged, only the healthy-head ordering is smarter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+from inference_gateway_tpu.fleet.ring import HashRing
+from inference_gateway_tpu.providers.routing import Deployment, Pool, Selector
+
+# (provider, model) -> the deployment's latest /health load report, or
+# None when it never reported (non-TPU deployments, probing off).
+LoadReporter = Callable[[str, str], Mapping[str, Any] | None]
+
+
+class FleetRouter(Selector):
+    """Affinity- and load-aware pool selector."""
+
+    def __init__(self, pools: dict[str, Pool], *,
+                 health: Callable[[Deployment], bool] | None = None,
+                 load: LoadReporter | None = None,
+                 affinity_enabled: bool = True,
+                 affinity_prefix_bytes: int = 1024,
+                 vnodes: int = 64,
+                 spill_queue_depth: int = 4,
+                 spill_kv_high_water: float = 0.9,
+                 otel: Any = None, logger: Any = None) -> None:
+        super().__init__(pools, health=health)
+        self.affinity_enabled = bool(affinity_enabled)
+        self.affinity_prefix_bytes = max(1, int(affinity_prefix_bytes))
+        self.spill_queue_depth = max(1, int(spill_queue_depth))
+        self.spill_kv_high_water = float(spill_kv_high_water)
+        self._load = load
+        self.otel = otel
+        self.logger = logger
+        self._rings: dict[str, HashRing] = {}
+        # node id -> every deployment sharing it: legacy pools may list
+        # the same (provider, model) twice (no per-replica URLs); the
+        # ring hashes distinct ids, the expansion keeps the duplicate
+        # failover targets the pool promised.
+        self._by_node: dict[str, dict[str, list[Deployment]]] = {}
+        for alias, pool in pools.items():
+            nodes: dict[str, list[Deployment]] = {}
+            for d in pool.deployments:
+                nodes.setdefault(self._node_id(d), []).append(d)
+            self._rings[alias] = HashRing(nodes, vnodes=vnodes)
+            self._by_node[alias] = nodes
+
+    @staticmethod
+    def _node_id(d: Deployment) -> str:
+        return f"{d.provider}/{d.model}"
+
+    # -- load interpretation --------------------------------------------
+    def load_report(self, d: Deployment) -> Mapping[str, Any] | None:
+        if self._load is None:
+            return None
+        return self._load(d.provider, d.model)
+
+    def saturated(self, d: Deployment) -> bool:
+        """Whether the deployment's reported load says new work would
+        queue there: scheduler backlog at/past the spill mark, or KV
+        pages past the high water (admission would preempt or wait).
+        No report → not saturated: the router only ever spills on
+        EVIDENCE, never on ignorance."""
+        rep = self.load_report(d)
+        if not rep:
+            return False
+        try:
+            if int(rep.get("queue_depth") or 0) >= self.spill_queue_depth:
+                return True
+            if float(rep.get("kv_page_utilization") or 0.0) >= self.spill_kv_high_water:
+                return True
+        except (TypeError, ValueError):
+            return False
+        return False
+
+    def pool_queue_depth(self, alias: str) -> int:
+        """One pool's backlog: the MINIMUM reported scheduler queue
+        depth across its healthy deployments — 0 while any replica (or
+        any deployment that never reported) can absorb that pool's
+        work."""
+        pool = self._pools.get(alias)
+        if pool is None:
+            return 0
+        best: int | None = None
+        for d in pool.deployments:
+            if self._health is not None and not self._health(d):
+                continue
+            rep = self.load_report(d)
+            try:
+                q = int(rep.get("queue_depth") or 0) if rep else 0
+            except (TypeError, ValueError):
+                q = 0
+            best = q if best is None else min(best, q)
+        return best or 0
+
+    def cluster_queue_depth(self) -> int:
+        """The pool-admission signal for ``OverloadController``: the
+        MAXIMUM over pools of each pool's min-healthy-replica backlog.
+        Per-pool min, because a pool has headroom while any of its
+        replicas does; max across pools, because replicas do not absorb
+        another pool's work — an idle pool must never mask a saturated
+        one (code-review finding)."""
+        return max((self.pool_queue_depth(alias) for alias in self._pools),
+                   default=0)
+
+    # -- selection -------------------------------------------------------
+    def select_candidates(self, alias: str,
+                          affinity_key: str | None = None) -> list[Deployment] | None:
+        """Ordered failover candidates for one request.
+
+        With a key: ring order, healthy first, affine-or-spilled leader;
+        without (or affinity off): the base round-robin rotation. None
+        when the alias is unknown — same contract as ``Selector``.
+        """
+        pool = self._pools.get(alias)
+        if pool is None:
+            return None
+        if not self.affinity_enabled or not affinity_key:
+            return pool.candidates(self._health)
+        ring = self._rings[alias]
+        by_node = self._by_node[alias]
+        order = [d for n in ring.candidates(affinity_key) for d in by_node[n]]
+        if self._health is None:
+            healthy, unhealthy = order, []
+        else:
+            healthy = [d for d in order if self._health(d)]
+            unhealthy = [d for d in order if not self._health(d)]
+        if not healthy:
+            # Nothing admittable: hand back the ring order and let the
+            # executor's breaker/probe gates decide (same second-chance
+            # contract as Pool.candidates' demoted tail).
+            return order
+        lead_idx = next((i for i, d in enumerate(healthy)
+                         if not self.saturated(d)), None)
+        if lead_idx is None:
+            # Every healthy replica is saturated: stay affine — its
+            # PrefixCache still makes it the cheapest place to queue.
+            lead_idx = 0
+        lead = healthy[lead_idx]
+        if lead is order[0]:
+            self._record_hit(alias, lead)
+        else:
+            reason = "saturated" if order[0] in healthy else "unhealthy"
+            self._record_spill(alias, reason)
+        ordered = [lead] + [d for d in healthy if d is not lead] + unhealthy
+        return ordered
+
+    # -- telemetry -------------------------------------------------------
+    def _record_hit(self, alias: str, d: Deployment) -> None:
+        if self.otel is not None:
+            self.otel.record_affinity_hit(alias)
+
+    def _record_spill(self, alias: str, reason: str) -> None:
+        if self.logger is not None:
+            self.logger.debug("affinity spill", "alias", alias, "reason", reason)
+        if self.otel is not None:
+            self.otel.record_affinity_spill(alias, reason)
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """The /debug/status view of the routing plane: per-pool ring
+        layout and per-deployment health/saturation/load."""
+        pools: dict[str, Any] = {}
+        for alias, pool in self._pools.items():
+            deployments = []
+            for d in pool.deployments:
+                rep = self.load_report(d)
+                deployments.append({
+                    "provider": d.provider,
+                    "model": d.model,
+                    "serve_model": d.serve_model,
+                    "url": d.url or None,
+                    "healthy": self._health(d) if self._health is not None else True,
+                    "saturated": self.saturated(d),
+                    "load": dict(rep) if rep else None,
+                })
+            pools[alias] = {
+                "deployments": deployments,
+                "ring_nodes": list(self._rings[alias].nodes),
+            }
+        return {
+            "affinity_enabled": self.affinity_enabled,
+            "affinity_prefix_bytes": self.affinity_prefix_bytes,
+            "vnodes": next(iter(self._rings.values())).vnodes if self._rings else 0,
+            "spill_queue_depth": self.spill_queue_depth,
+            "spill_kv_high_water": self.spill_kv_high_water,
+            "cluster_queue_depth": self.cluster_queue_depth(),
+            "pools": pools,
+        }

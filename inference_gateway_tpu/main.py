@@ -32,7 +32,7 @@ from inference_gateway_tpu.otel.profiling import (
     SlowRequestLog,
     handle_profile_query,
 )
-from inference_gateway_tpu.providers import routing
+from inference_gateway_tpu.providers import constants, routing
 from inference_gateway_tpu.providers.registry import ProviderRegistry
 from inference_gateway_tpu.resilience import OverloadController, Resilience, admission_middleware
 from inference_gateway_tpu.version import APPLICATION_NAME, VERSION
@@ -54,6 +54,7 @@ class Gateway:
     overload: OverloadController | None = None
     resilience: Any = None
     prober: Any = None
+    migrator: Any = None
     access_log: Any = None
     profiler: SamplingProfiler | None = None
     watchdog: EventLoopWatchdog | None = None
@@ -213,16 +214,30 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
 
     selector = None
     prober = None
+    migrator = None
+    fleet_urls: dict[str, set[str]] = {}
     if cfg.routing.enabled:
         if not cfg.routing.config_path:
             raise ValueError("ROUTING_CONFIG_PATH is required when ROUTING_ENABLED is true")
         pools = routing.load_pools_config(cfg.routing.config_path)
+
+        def deployment_url(d) -> str:
+            # Per-deployment base URL override (ISSUE 11) or the
+            # provider default — the replica's actual home.
+            return d.url or cfg.providers[d.provider].url
+
+        for pool in pools.values():
+            for d in pool.deployments:
+                if d.url:
+                    fleet_urls.setdefault(d.provider, set()).add(d.url)
         # Active pool health probing (ISSUE 9): a background /health
         # probe per pool deployment ejects dead replicas after K
         # consecutive failures — the selector demotes them AND the
         # executor skips them outright (zero establishment attempts)
         # until a probe succeeds again. Passive breaker health still
-        # covers direct (non-pool) routes.
+        # covers direct (non-pool) routes. The probe body doubles as the
+        # fleet load report (ISSUE 11): queue depth / KV utilization /
+        # slot occupancy feed the router's bounded-load spill.
         health = resilience.healthy
         if cfg.resilience.enabled and cfg.resilience.probe_enabled:
             from inference_gateway_tpu.resilience.prober import (
@@ -232,8 +247,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             )
 
             targets = [
-                ProbeTarget(d.provider, d.model,
-                            probe_url(cfg.providers[d.provider].url))
+                ProbeTarget(d.provider, d.model, probe_url(deployment_url(d)))
                 for pool in pools.values() for d in pool.deployments
             ]
             prober = HealthProber(
@@ -246,9 +260,49 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             def health(d, _breakers=resilience.healthy, _probes=prober.healthy):
                 return _breakers(d) and _probes(d.provider, d.model)
 
-        selector = routing.Selector(pools, health=health)
-        logger.info("routing pools loaded", "aliases", selector.aliases(),
-                    "active_probing", prober is not None)
+        # Fleet migrator (ISSUE 11 tentpole b): gateway-side drain
+        # coordination + planned-migration attribution. A draining
+        # deployment leaves the healthy ordering the moment the operator
+        # asks, and its live streams' deaths are counted (and breaker-
+        # exempted) as migrations, not failures.
+        from inference_gateway_tpu.fleet import FleetMigrator, FleetRouter
+
+        all_deployments = [d for pool in pools.values() for d in pool.deployments]
+        migrator = FleetMigrator(
+            {(d.provider, d.model): deployment_url(d) for d in all_deployments},
+            client,
+            # Only the TPU sidecar speaks the /admin surface: foreign
+            # cloud deployments are drainable at the routing level but
+            # never receive /admin/* requests or completion ids.
+            admin_keys={(d.provider, d.model) for d in all_deployments
+                        if d.provider == constants.TPU_ID},
+            otel=otel, logger=logger, clock=resilience.clock)
+        resilience.migrator = migrator
+
+        def fleet_health(d, _h=health, _m=migrator):
+            return _h(d) and not _m.draining(d.provider, d.model)
+
+        # Fleet router (ISSUE 11 tentpole a): prefix-affinity consistent-
+        # hash ordering with bounded-load spill; keyless requests (and
+        # ROUTING_AFFINITY_ENABLED=false) keep round-robin.
+        selector = FleetRouter(
+            pools, health=fleet_health,
+            load=(prober.load if prober is not None else None),
+            affinity_enabled=cfg.routing.affinity_enabled,
+            affinity_prefix_bytes=cfg.routing.affinity_prefix_bytes,
+            vnodes=cfg.routing.affinity_vnodes,
+            spill_queue_depth=cfg.routing.spill_queue_depth,
+            spill_kv_high_water=cfg.routing.spill_kv_high_water,
+            otel=otel, logger=logger)
+        # Pool-level admission (ISSUE 11 tentpole c): the cluster's
+        # minimum reported scheduler backlog feeds shedding
+        # (OVERLOAD_ENGINE_DEPTH_HIGH_WATER) and Retry-After hints, so
+        # overload decisions see the fleet, not one process.
+        overload.add_depth_probe(selector.cluster_queue_depth)
+        logger.info("fleet routing pools loaded", "aliases", selector.aliases(),
+                    "affinity", cfg.routing.affinity_enabled,
+                    "active_probing", prober is not None,
+                    "fleet_urls", sum(len(v) for v in fleet_urls.values()))
 
     # MCP subsystem (main.go:181-213).
     if mcp_client is None and cfg.mcp.enable and cfg.mcp.servers:
@@ -261,7 +315,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     router_impl = RouterImpl(
         cfg, registry, client, logger=logger, otel=otel,
         mcp_client=mcp_client, mcp_agent=mcp_agent, selector=selector,
-        resilience=resilience, overload=overload,
+        resilience=resilience, overload=overload, fleet_urls=fleet_urls,
     )
 
     # Middleware order matters (main.go:238-254): the wide-event access
@@ -327,8 +381,8 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
         router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
         mcp_client=mcp_client, overload=overload, resilience=resilience,
-        prober=prober, access_log=access_log, profiler=profiler, watchdog=watchdog,
-        slow_log=slow_log,
+        prober=prober, migrator=migrator, access_log=access_log,
+        profiler=profiler, watchdog=watchdog, slow_log=slow_log,
     )
     # Uptime reads through the resilience clock (graftlint
     # clock-discipline): stamp the start on the same timebase.
@@ -353,6 +407,14 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             }
             if prober is not None:
                 status["probes"] = prober.snapshot()
+            if selector is not None and hasattr(selector, "snapshot"):
+                # Fleet routing snapshot (ISSUE 11): ring layout,
+                # per-deployment health/saturation/load, and the drain
+                # ledger — the operator's one-stop view of the data
+                # plane.
+                status["routing"] = selector.snapshot()
+            if migrator is not None:
+                status["migration"] = migrator.snapshot()
             if access_log is not None:
                 status["access_log_tail"] = list(access_log.tail)[-8:]
                 status["access_log_dropped"] = access_log.dropped
@@ -376,6 +438,34 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             return Response.text(body, status=status, content_type=ctype)
 
         metrics_router.get("/debug/profile", debug_profile_handler)
+
+        if migrator is not None:
+            # Fleet drain orchestration (ISSUE 11): POST
+            # /debug/fleet/drain?provider=tpu&model=llama@a marks the
+            # deployment draining (instant routing demotion) and tells
+            # its sidecar to migrate live streams out; undrain reverses
+            # it. On the metrics listener: operator surface, not data
+            # plane.
+            def _fleet_admin(action):
+                async def handler(req: Request) -> Response:
+                    provider = req.query_get("provider")
+                    model = req.query_get("model")
+                    if not provider or not model:
+                        return Response.json(
+                            {"error": "provider and model query params required"},
+                            status=400)
+                    try:
+                        result = await action(provider, model)
+                    except KeyError:
+                        return Response.json(
+                            {"error": f"unknown fleet deployment {provider}/{model}"},
+                            status=404)
+                    return Response.json(result)
+
+                return handler
+
+            metrics_router.post("/debug/fleet/drain", _fleet_admin(migrator.drain))
+            metrics_router.post("/debug/fleet/undrain", _fleet_admin(migrator.undrain))
 
     return gw
 

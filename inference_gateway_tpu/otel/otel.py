@@ -288,6 +288,37 @@ class OpenTelemetry:
             "Probe-ejected pool deployments readmitted on probe recovery",
             ("gen_ai_provider_name", "gen_ai_request_model"), unit="{readmission}",
         )
+        # Fleet routing instruments (ISSUE 11): prefix-affinity outcomes,
+        # planned live migrations, and the per-deployment load reported
+        # through the /health body the prober doubles as collector for.
+        self.affinity_hit_counter = r.counter(
+            "inference_gateway.routing.affinity_hits",
+            "Pool requests routed to their ring-affine deployment "
+            "(prefix-cache locality preserved)",
+            ("alias",), unit="{request}",
+        )
+        self.affinity_spill_counter = r.counter(
+            "inference_gateway.routing.affinity_spills",
+            "Pool requests spilled off their affine deployment, by reason "
+            "(saturated = bounded-load spill, unhealthy = breaker/probe/drain)",
+            ("alias", "reason"), unit="{request}",
+        )
+        self.streams_migrated_counter = r.counter(
+            "inference_gateway.streams_migrated",
+            "Live streams PROACTIVELY moved to another replica via the "
+            "continuation splice, by reason (drain = planned drain, "
+            "restart = supervised engine restart) — a subset of "
+            "streams_recovered{phase=post_first_byte}",
+            ("alias", "from_provider", "to_provider", "reason"), unit="{stream}",
+        )
+        self.deployment_load_gauge = r.gauge(
+            "inference_gateway.routing.deployment_load",
+            "Last load report per pool deployment, by signal "
+            "(queue_depth / kv_page_utilization / active_slots / max_slots) "
+            "— parsed from the /health body by the health prober",
+            ("gen_ai_provider_name", "gen_ai_request_model", "signal"),
+            ttl=EFFICIENCY_GAUGE_TTL,
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -484,6 +515,25 @@ class OpenTelemetry:
     def record_probe_readmission(self, provider: str, model: str) -> None:
         self.probe_readmission_counter.add(1, {
             "gen_ai_provider_name": provider, "gen_ai_request_model": model})
+
+    # -- fleet routing (ISSUE 11) ----------------------------------------
+    def record_affinity_hit(self, alias: str) -> None:
+        self.affinity_hit_counter.add(1, {"alias": alias})
+
+    def record_affinity_spill(self, alias: str, reason: str) -> None:
+        self.affinity_spill_counter.add(1, {"alias": alias, "reason": reason})
+
+    def record_stream_migrated(self, alias: str, from_provider: str,
+                               to_provider: str, reason: str) -> None:
+        self.streams_migrated_counter.add(1, {
+            "alias": alias, "from_provider": from_provider,
+            "to_provider": to_provider, "reason": reason})
+
+    def set_deployment_load(self, provider: str, model: str, signal: str,
+                            value: float) -> None:
+        self.deployment_load_gauge.set(value, {
+            "gen_ai_provider_name": provider, "gen_ai_request_model": model,
+            "signal": signal})
 
     def remove_efficiency_gauges(self, model: str) -> None:
         """Engine teardown: the accounting gauges describe a gone engine
@@ -745,4 +795,16 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_probe_readmission(self, *a, **k) -> None:
+        pass
+
+    def record_affinity_hit(self, *a, **k) -> None:
+        pass
+
+    def record_affinity_spill(self, *a, **k) -> None:
+        pass
+
+    def record_stream_migrated(self, *a, **k) -> None:
+        pass
+
+    def set_deployment_load(self, *a, **k) -> None:
         pass

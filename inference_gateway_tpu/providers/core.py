@@ -106,6 +106,13 @@ class Provider:
             h.set("Authorization", f"Bearer {token}")
         # Self-calls must skip MCP re-interception (mcp.go:25).
         h.set("X-MCP-Bypass", "true")
+        if self.cfg.fleet_url:
+            # Fleet replica routing (ISSUE 11): the /proxy hop resolves
+            # this provider's DEFAULT URL; the header re-targets it to
+            # this replica's own base. proxy_handler honors it only for
+            # URLs the operator's pools file declares (allowlist), so the
+            # hop can never become an open proxy.
+            h.set("X-Fleet-Url", self.cfg.fleet_url)
         return h
 
     @staticmethod

@@ -36,6 +36,13 @@ class ProviderConfig:
     supports_vision: bool = False
     extra_headers: dict[str, list[str]] = field(default_factory=dict)
     endpoints: Endpoints = field(default_factory=lambda: Endpoints("/models", "/chat/completions"))
+    # Fleet replica routing (ISSUE 11): set when this provider instance
+    # targets one specific pool deployment's base URL instead of the
+    # provider default. The /proxy loopback hop resolves URLs from the
+    # registry, so the override rides an allowlisted header
+    # (core.Provider stamps X-Fleet-Url; routes.proxy_handler honors it
+    # only for URLs the operator's own pools file declares).
+    fleet_url: str = ""
 
     def copy(self) -> "ProviderConfig":
         return replace(
@@ -84,7 +91,7 @@ class ProviderRegistry:
     def get_providers(self) -> dict[str, ProviderConfig]:
         return self._cfg
 
-    def build_provider(self, provider_id: str, client):
+    def build_provider(self, provider_id: str, client, url: str | None = None):
         # Import here to avoid a cycle: core imports registry types.
         from inference_gateway_tpu.providers.core import Provider
 
@@ -93,4 +100,11 @@ class ProviderRegistry:
             raise ProviderNotFoundError(f"provider {provider_id} not found")
         if cfg.auth_type != constants.AUTH_TYPE_NONE and not cfg.token:
             raise ProviderNotConfiguredError(f"provider {provider_id} token not configured")
+        if url:
+            # Per-deployment base URL (ISSUE 11): a copied config so the
+            # shared registry entry — and every other replica — stays
+            # untouched.
+            cfg = cfg.copy()
+            cfg.url = url
+            cfg.fleet_url = url
         return Provider(cfg, client, logger=self._logger)

@@ -68,8 +68,25 @@ def is_model_allowed(model_id: str, allowed: str, disallowed: str) -> bool:
 # -- routing pools (pool.go) ------------------------------------------------
 @dataclass
 class Deployment:
+    """One pool target. ``model`` is the deployment's IDENTITY — the key
+    breakers, probes, the affinity ring, and telemetry all share. Fleet
+    extensions (ISSUE 11): ``url`` lets N replicas of one model live
+    behind one provider id, each with its own sidecar base URL (capacity
+    scales by adding sidecars, not by tuning one process), and
+    ``serve_model`` is the model name actually sent upstream when
+    ``model`` is a replica-unique routing id (e.g. ``llama@a`` /
+    ``llama@b`` both serving ``llama-3-8b`` — upstream envelopes stay
+    identical across replicas, which is what keeps the migration splice
+    byte-exact)."""
+
     provider: str
     model: str
+    url: str = ""
+    serve_model: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.serve_model:
+            self.serve_model = self.model
 
 
 @dataclass
@@ -112,8 +129,22 @@ class PoolConfigError(ValueError):
     pass
 
 
+def _str_field(d: dict[str, Any], key: str, where: str) -> str:
+    """A deployment field that must be a string (or absent): malformed
+    types get a structured error naming the pool, entry, and field
+    instead of an AttributeError deep in request handling."""
+    val = d.get(key)
+    if val is None:
+        return ""
+    if not isinstance(val, str):
+        raise PoolConfigError(
+            f"{where}: field {key!r} must be a string, got {type(val).__name__}")
+    return val.strip()
+
+
 def load_pools_config(path: str) -> dict[str, Pool]:
-    """Parse the YAML pools file. Schema (pool.go:52-66):
+    """Parse the YAML pools file. Schema (pool.go:52-66, plus the fleet
+    extensions — ISSUE 11):
 
         pools:
           - model: logical-alias
@@ -121,35 +152,78 @@ def load_pools_config(path: str) -> dict[str, Pool]:
               - provider: openai
                 model: gpt-4o
               - provider: tpu
-                model: llama-3-8b
+                model: llama@a            # replica-unique routing id
+                serve_model: llama-3-8b   # model name sent upstream
+                url: http://sidecar-a:8000/v1  # per-replica base URL
+
+    Every misconfiguration raises ``PoolConfigError`` with a message
+    naming the pool and entry — a malformed fleet file must fail the
+    process at startup, never a request at runtime.
     """
     import yaml
 
     with open(path) as f:
         raw = yaml.safe_load(f) or {}
     pools: dict[str, Pool] = {}
-    for entry in raw.get("pools") or []:
+    for n, entry in enumerate(raw.get("pools") or []):
+        if not isinstance(entry, dict):
+            raise PoolConfigError(
+                f"pool entry #{n} must be a mapping, got {type(entry).__name__}: {entry!r}")
         alias = (entry.get("model") or "").strip()
         if not alias:
-            raise PoolConfigError("pool entry missing model alias")
-        deployments = [
-            Deployment(provider=(d.get("provider") or "").strip(), model=(d.get("model") or "").strip())
-            for d in entry.get("deployments") or []
-        ]
+            raise PoolConfigError(f"pool entry #{n} missing model alias")
+        raw_deployments = entry.get("deployments")
+        if raw_deployments is not None and not isinstance(raw_deployments, list):
+            raise PoolConfigError(
+                f"pool {alias!r}: deployments must be a list, "
+                f"got {type(raw_deployments).__name__}")
+        deployments: list[Deployment] = []
+        for i, d in enumerate(raw_deployments or []):
+            if not isinstance(d, dict):
+                raise PoolConfigError(
+                    f"pool {alias!r} deployment #{i} must be a mapping, "
+                    f"got {type(d).__name__}: {d!r}")
+            where = f"pool {alias!r} deployment #{i}"
+            deployments.append(Deployment(
+                provider=_str_field(d, "provider", where),
+                model=_str_field(d, "model", where),
+                url=_str_field(d, "url", where),
+                serve_model=_str_field(d, "serve_model", where),
+            ))
+        if not deployments:
+            raise PoolConfigError(f"pool {alias!r} has no deployments")
         if len(deployments) < 2:
             # Round-robin over <2 targets is a misconfiguration
             # (pool.go:77).
             raise PoolConfigError(f"pool {alias!r} needs at least 2 deployments")
-        for d in deployments:
+        for i, d in enumerate(deployments):
             if d.provider not in REGISTRY:
                 raise PoolConfigError(f"pool {alias!r} references unknown provider {d.provider!r}")
             if not d.model:
-                raise PoolConfigError(f"pool {alias!r} has a deployment without a model")
+                raise PoolConfigError(f"pool {alias!r} deployment #{i} has no model")
         if alias in pools:
             # Last-write-wins would silently shadow an earlier pool — an
             # operator typo that deserves a hard startup failure.
             raise PoolConfigError(f"duplicate pool alias {alias!r}")
         pools[alias] = Pool(alias, deployments)
+    # (provider, model) is the replica identity EVERYWHERE downstream —
+    # breakers, health probes, the affinity ring, the migrator's URL map
+    # — and that keyspace is global, not per pool. Two deployments
+    # sharing an identity but disagreeing on url/serve_model would
+    # silently collapse onto one replica (probe state flapping between
+    # hosts, drains posted to the wrong sidecar), in ANY order and
+    # across pools. Identical duplicates (the legacy weighted-rotation
+    # idiom, and one replica shared by two pools) stay legal.
+    shapes: dict[tuple[str, str], tuple[str, str]] = {}
+    for pool in pools.values():
+        for d in pool.deployments:
+            key = (d.provider, d.model)
+            shape = (d.url, d.serve_model)
+            if shapes.setdefault(key, shape) != shape:
+                raise PoolConfigError(
+                    f"deployment id {d.provider}/{d.model} is defined with "
+                    f"conflicting url/serve_model — give each replica a "
+                    f"unique model id (use serve_model for the upstream name)")
     return pools
 
 
@@ -164,13 +238,22 @@ class Selector:
         self._pools = pools
         self._health = health
 
+    # Handlers probe this before paying for affinity-key derivation; the
+    # fleet subclass (inference_gateway_tpu/fleet/router.py) flips it on.
+    affinity_enabled: bool = False
+    affinity_prefix_bytes: int = 1024
+
     def select(self, alias: str) -> Deployment | None:
         candidates = self.select_candidates(alias)
         return candidates[0] if candidates else None
 
-    def select_candidates(self, alias: str) -> list[Deployment] | None:
+    def select_candidates(self, alias: str,
+                          affinity_key: str | None = None) -> list[Deployment] | None:
         """Ordered failover candidates for one request: round-robin
-        rotated, healthy replicas first. None when the alias is unknown."""
+        rotated, healthy replicas first. None when the alias is unknown.
+        ``affinity_key`` is accepted for interface parity with the fleet
+        router (ISSUE 11) and ignored here — the base selector has no
+        ring."""
         pool = self._pools.get(alias)
         if pool is None:
             return None

@@ -80,6 +80,13 @@ class ChatStreamContinuation:
         self.completion_id = ""
         self.created: int | None = None
         self.model = ""
+        # Authoritative resume ids (ISSUE 11): set by the fleet migrator
+        # when the PLANNED death's replica published the exact
+        # prompt-relative generated ids at the cut — byte-exact resume
+        # even where text re-encoding is lossy (mid-UTF-8/mid-merge).
+        # Invalidated by any further ingested content (they describe one
+        # specific cut point).
+        self.token_ids: list[int] | None = None
         # True once a finish_reason or [DONE] was relayed: the stream is
         # complete (or close enough that resuming would fabricate
         # content past the model's own stop) — never resume.
@@ -148,6 +155,9 @@ class ChatStreamContinuation:
                     self.text += content
                     self._text_bytes += len(content.encode("utf-8"))
                     self.frames += 1
+                    # New content extends the stream past the cut the
+                    # fetched ids described — they are stale now.
+                    self.token_ids = None
                 if choice.get("finish_reason"):
                     self.complete = True
 
@@ -164,8 +174,12 @@ class ChatStreamContinuation:
     def payload(self) -> dict[str, Any]:
         """The chat-request ``continuation`` extension (openapi.yaml
         ``StreamContinuation``): generated-so-far text, a diagnostic
-        relayed-frame count, and the original envelope identity."""
+        relayed-frame count, the original envelope identity, and — for
+        planned migrations — the authoritative resume ids (the sidecar
+        prefers them over re-encoding the text)."""
         out: dict[str, Any] = {"text": self.text, "emitted_tokens": self.frames}
+        if self.token_ids is not None:
+            out["token_ids"] = list(self.token_ids)
         if self.completion_id:
             out["id"] = self.completion_id
         if self.created is not None:

@@ -80,6 +80,12 @@ class Resilience:
         # ZERO establishment attempts (stronger than breaker demotion,
         # which only re-orders) until the prober readmits it.
         self.prober: Any = None
+        # Fleet migrator (ISSUE 11): wired by the gateway assembly when
+        # routing pools exist. Classifies a post-first-byte stream death
+        # as a PLANNED migration (drain / supervised restart) — counted
+        # as streams_migrated{reason} and NOT charged to the dead
+        # replica's breaker (a replica taken out on purpose is not ill).
+        self.migrator: Any = None
         self.retry_policy = RetryPolicy(
             max_attempts=getattr(cfg, "retry_max_attempts", 3) if self.enabled else 1,
             base_backoff=getattr(cfg, "retry_base_backoff", 0.1),
@@ -311,7 +317,7 @@ class Resilience:
             f"all deployments unavailable ({reason}){' for ' + alias if alias else ''}"
         )
 
-    # -- mid-stream recovery (ISSUE 7 + ISSUE 9) -------------------------
+    # -- mid-stream recovery (ISSUE 7 + ISSUE 9 + ISSUE 11) --------------
     def _record_stream_recovered(self, alias: str, from_provider: str,
                                  to_provider: str, phase: str) -> None:
         if self.logger is not None:
@@ -320,6 +326,42 @@ class Resilience:
         if self.otel is not None:
             self.otel.record_stream_recovered(alias, from_provider, to_provider,
                                               phase)
+
+    def _record_stream_migrated(self, alias: str, from_provider: str,
+                                to_provider: str, reason: str) -> None:
+        if self.logger is not None:
+            self.logger.info("stream migrated", "alias", alias, "reason", reason,
+                             "from", from_provider, "to", to_provider)
+        if self.otel is not None:
+            self.otel.record_stream_migrated(alias, from_provider, to_provider,
+                                             reason)
+
+    async def _fetch_migration(self, cand: Any, continuation: Any) -> str | None:
+        """Evidence-based planned-migration verdict (ISSUE 11): ask the
+        dead candidate's replica whether IT migrated this very stream
+        out. A successful fetch returns the reason ("drain"/"restart")
+        and installs the published EXACT resume ids on the continuation
+        (byte-identical resume even where text re-encoding is lossy).
+        Anything else — no migrator, no record, unreachable replica —
+        is None: an unplanned failure, charged and counted as plain
+        recovery. Per-stream evidence, so a merely-degraded (stalled)
+        or draining replica can never launder real failures as planned
+        migrations (code-review finding)."""
+        if self.migrator is None or continuation is None:
+            return None
+        fetch = getattr(self.migrator, "fetch_migration", None)
+        if fetch is None:
+            return None
+        try:
+            record = await fetch(cand.provider, cand.model,
+                                 continuation.completion_id)
+        except Exception:
+            return None
+        if record is None:
+            return None
+        ids, reason = record
+        continuation.token_ids = list(ids)
+        return str(reason)
 
     async def execute_streaming(
         self,
@@ -383,6 +425,11 @@ class Resilience:
             hops = 0
             pending_phase: str | None = None
             pending_from = served.provider
+            # Planned-migration verdict for the in-flight hop (ISSUE 11):
+            # captured at death time, recorded when the new replica
+            # delivers its first byte (a hop that dies silently migrated
+            # nothing).
+            pending_migration: str | None = None
             first_provider = served.provider
             while True:
                 err: Exception | None = None
@@ -415,6 +462,14 @@ class Resilience:
                             phase = pending_phase or "pre_first_byte"
                             self._record_stream_recovered(
                                 alias, pending_from, cand.provider, phase)
+                            if pending_migration and phase == "post_first_byte":
+                                # The splice completed a PLANNED move
+                                # (drain/restart): count the migration.
+                                self._record_stream_migrated(
+                                    alias, pending_from, cand.provider,
+                                    pending_migration)
+                                if event is not None:
+                                    event["stream_migrated"] = pending_migration
                             if event is not None:
                                 # The wide event is written at request
                                 # end: correct the serving attribution
@@ -428,6 +483,7 @@ class Resilience:
                                 event["served_provider"] = cand.provider
                                 event["served_model"] = cand.model
                         pending_phase = None
+                        pending_migration = None
                     yield chunk
 
                 # The attempt's stream is over — decide whether this is a
@@ -460,8 +516,19 @@ class Resilience:
 
                 # Dead: the upstream failed this request even though
                 # establishment "succeeded" — charge its breaker and move
-                # on like any establishment failure.
-                self.breakers.get(cand.provider, cand.model).record_failure()
+                # on like any establishment failure. Exception (ISSUE
+                # 11): a PLANNED death — the replica itself published a
+                # migration record for this stream (drain or supervised
+                # restart) — is not upstream illness: no breaker charge,
+                # the published exact resume ids arm the continuation,
+                # and the hop is counted as a migration once it
+                # completes.
+                post_candidate = relayed and continuation is not None \
+                    and continuation.can_resume()
+                planned = (await self._fetch_migration(cand, continuation)
+                           if post_candidate else None)
+                if planned is None:
+                    self.breakers.get(cand.provider, cand.model).record_failure()
                 hops += 1
                 post = relayed
                 avail = (remaining if not post
@@ -486,6 +553,7 @@ class Resilience:
                                      "provider", cand.provider,
                                      "post_first_byte", post, "error", death)
                 pending_from = cand.provider if post else first_provider
+                pending_migration = planned if post else None
                 try:
                     if post:
                         # A fresh establishment budget: the original one

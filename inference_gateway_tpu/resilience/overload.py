@@ -274,11 +274,31 @@ class OverloadController:
                     continue  # a broken probe must never take the gateway down
         return False
 
+    def _cluster_backlog(self) -> int:
+        """Pool-admission signal (ISSUE 11): the LARGEST backlog any
+        registered depth probe reports. Each probe already encodes its
+        own "can this capacity pool absorb work" verdict (the fleet
+        router reports max-over-pools of min-over-healthy-replicas; a
+        co-hosted engine reports its scheduler queue) — probes measure
+        different capacity pools, so one idle probe must never mask
+        another's saturation (code-review finding). 0 with no probes."""
+        best = 0
+        for probe in self._depth_probes:
+            try:
+                best = max(best, int(probe()))
+            except Exception:
+                continue
+        return best
+
     def estimate_retry_after(self, endpoint_class: str) -> float:
         """Monotone in the wait-queue length, so a deepening burst tells
-        clients to back off progressively longer."""
+        clients to back off progressively longer. Cluster-aware (ISSUE
+        11): backlog the fleet's least-loaded replica reports is added,
+        so shed clients of a saturated POOL back off for the cluster's
+        drain time, not just this gateway's queue."""
         st = self._classes[endpoint_class]
-        return st.service.retry_after(len(st.waiters) + 1, st.cap)
+        return st.service.retry_after(
+            len(st.waiters) + 1 + self._cluster_backlog(), st.cap)
 
     # -- admission -----------------------------------------------------
     async def admit(self, endpoint_class: str, priority: int) -> Ticket:

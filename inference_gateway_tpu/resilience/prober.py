@@ -31,14 +31,22 @@ from typing import Any, Iterable
 from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock, VirtualClock
 
 
-def probe_url(base_url: str) -> str:
-    """Health endpoint for a provider base URL: the API version segment
-    is an API namespace, not a host path — ``/health`` lives at the
-    origin (the TPU sidecar, llama.cpp, and Ollama all serve it there)."""
+def service_origin(base_url: str) -> str:
+    """A provider base URL's origin: the ``/v1`` segment is an API
+    namespace, not a host path — service endpoints (``/health``, the
+    sidecar ``/admin/*``) live at the origin. ONE implementation, shared
+    with the fleet migrator, so the rule can never drift between probes
+    and drains."""
     base = (base_url or "").rstrip("/")
     if base.endswith("/v1"):
         base = base[: -len("/v1")].rstrip("/")
-    return base + "/health"
+    return base
+
+
+def probe_url(base_url: str) -> str:
+    """Health endpoint for a provider base URL (the TPU sidecar,
+    llama.cpp, and Ollama all serve ``/health`` at the origin)."""
+    return service_origin(base_url) + "/health"
 
 
 @dataclass(frozen=True)
@@ -73,7 +81,7 @@ class HealthProber:
             self._state[key] = {
                 "url": t.url, "failures": 0, "ejected": False,
                 "ejections": 0, "readmissions": 0, "last_ok": None,
-                "last_checked": None,
+                "last_checked": None, "status": None, "load": None,
             }
         self._task: asyncio.Task | None = None
 
@@ -87,6 +95,30 @@ class HealthProber:
             st = self._state.get((provider, model))
             return st is None or not st["ejected"]
 
+    # -- the load reporter (ISSUE 11 satellite) --------------------------
+    def status(self, provider: str, model: str) -> str | None:
+        """The deployment's last self-reported /health status ("ok" /
+        "draining" / "degraded"), or None before the first parseable
+        probe. Introspection only (the /debug/status snapshot and
+        operator tooling) — migration ATTRIBUTION is evidence-based via
+        ``FleetMigrator.fetch_migration``, never this. Preserved across
+        unreachable probes: a replica that said "draining" and then
+        stopped answering keeps its last word."""
+        with self._lock:
+            st = self._state.get((provider, model))
+            return st["status"] if st is not None else None
+
+    def load(self, provider: str, model: str) -> dict[str, Any] | None:
+        """The deployment's last /health load report (queue_depth,
+        kv_page_utilization, active_slots, max_slots) — the TPU sidecar
+        enriches its body with these so one probe feeds both health and
+        the fleet router's bounded-load spill; deployments with
+        status-only bodies (foreign runtimes) report None."""
+        with self._lock:
+            st = self._state.get((provider, model))
+            load = st["load"] if st is not None else None
+            return dict(load) if load else None
+
     # -- probing ---------------------------------------------------------
     async def probe_once(self) -> None:
         """One probe round (concurrently) — one GET per DISTINCT url,
@@ -98,8 +130,31 @@ class HealthProber:
             by_url.setdefault(t.url, []).append(t)
         await asyncio.gather(*(self._probe(url, ts) for url, ts in by_url.items()))
 
+    # /health body fields copied into the load report when present (the
+    # TPU sidecar's enriched body, ISSUE 11 satellite). Anything else —
+    # foreign runtimes' bodies, non-JSON — parses to no report at all:
+    # the status-only probing contract is unchanged.
+    _LOAD_FIELDS = ("queue_depth", "kv_page_utilization", "active_slots",
+                    "max_slots")
+
+    @classmethod
+    def _parse_body(cls, resp: Any) -> tuple[str | None, dict[str, Any] | None]:
+        """(status, load) from a probe response body, best-effort."""
+        try:
+            body = resp.json()
+        except Exception:
+            return None, None
+        if not isinstance(body, dict):
+            return None, None
+        status = str(body["status"]) if body.get("status") else None
+        load = {k: body[k] for k in cls._LOAD_FIELDS
+                if isinstance(body.get(k), (int, float))}
+        return status, (load or None)
+
     async def _probe(self, url: str, targets: list[ProbeTarget]) -> None:
         ok = False
+        status: str | None = None
+        load: dict[str, Any] | None = None
         try:
             resp = await self.clock.wait_for(
                 self.client.get(url, timeout=self.timeout), self.timeout)
@@ -110,14 +165,21 @@ class HealthProber:
             # otherwise permanently remove every cloud deployment from
             # its pool ~K intervals after boot; code-review finding).
             ok = getattr(resp, "status", 599) < 500
+            # The body is parsed for BOTH verdicts: a 503 body carries
+            # the reason ("draining"/"degraded") the fleet migrator
+            # attributes planned stream migrations with (ISSUE 11).
+            status, load = self._parse_body(resp)
         except Exception:
             ok = False
         for t in targets:
-            self.record(t.provider, t.model, ok)
+            self.record(t.provider, t.model, ok, status=status, load=load)
 
-    def record(self, provider: str, model: str, ok: bool) -> None:
+    def record(self, provider: str, model: str, ok: bool, *,
+               status: str | None = None,
+               load: dict[str, Any] | None = None) -> None:
         """Apply one probe outcome (thread-safe; the transition decision
-        happens under the lock, telemetry outside it)."""
+        happens under the lock, telemetry outside it). ``status``/``load``
+        carry the parsed /health body when the target reported one."""
         key = (provider, model)
         ejected_now = readmitted_now = False
         with self._lock:
@@ -126,6 +188,18 @@ class HealthProber:
                 return
             st["last_ok"] = ok
             st["last_checked"] = self.clock.now()
+            if ok or status is not None:
+                # A fresh verdict replaces the old one; an UNREACHABLE
+                # probe (no body at all) keeps the last self-report —
+                # "said draining, then went silent" is more informative
+                # than None (code-review finding).
+                st["status"] = status
+            if load is not None or not ok:
+                # A fresh report replaces the old one; an unreachable
+                # replica's stale load must not keep steering the router
+                # (its health ejection handles routing, but the snapshot
+                # and gauges should tell the truth too).
+                st["load"] = load
             if ok:
                 st["failures"] = 0
                 if st["ejected"]:
@@ -153,6 +227,12 @@ class HealthProber:
             if self.otel is not None:
                 self.otel.record_probe_readmission(provider, model)
                 self.otel.set_pool_healthy(provider, model, 1)
+        if load and self.otel is not None:
+            # Per-deployment load gauge (ISSUE 11 satellite): one series
+            # per reported signal, refreshed every probe round.
+            for signal, value in load.items():
+                self.otel.set_deployment_load(provider, model, signal,
+                                              float(value))
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -204,6 +284,8 @@ class HealthProber:
                     "ejections": st["ejections"],
                     "readmissions": st["readmissions"],
                     "last_ok": st["last_ok"],
+                    "status": st["status"],
+                    "load": dict(st["load"]) if st["load"] else None,
                     "seconds_since_probe": (round(now - st["last_checked"], 3)
                                             if st["last_checked"] is not None else None),
                 })

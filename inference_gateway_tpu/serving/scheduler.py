@@ -304,6 +304,24 @@ class Scheduler:
         if self._thread:
             self._thread.join(timeout=10)
 
+    def cancel(self, req: GenRequest) -> None:
+        """Best-effort deschedule of ONE request — the planned-migration
+        path (ISSUE 11): the serving edge has already ended the client
+        stream (no terminal frame; a continuation-capable gateway splices
+        it onto another replica), so this replica must stop spending
+        compute on it. A still-queued request is dropped before it ever
+        prefills; an admitted one is marked disconnected, which the next
+        emission turns into termination + slot/KV release (the existing
+        abandoned-stream path). Never raises; safe from the event loop."""
+        with self._wake:
+            try:
+                self._waiting.remove(req)
+                self.queue_depth = len(self._waiting)
+            except ValueError:
+                pass
+            self._wake.notify()
+        req.disconnected = True
+
     def abort_all(self) -> int:
         """Fail every queued and in-flight request with finish_reason
         "error" (retryable at the gateway edge) and stop the loop —

@@ -69,6 +69,23 @@ _MAX_PENDING_SAMPLES = 65536
 # so spliced frames stay byte-identical to full-envelope serialization.
 _json_escape = json.encoder.encode_basestring_ascii
 
+# Queue sentinel for planned live migration (ISSUE 11): drain/restart
+# inject ``(_MIGRATE, reason)`` into a live stream's token queue, ending
+# the SSE generator at the current frame boundary with NO terminal frame
+# — the exact death shape the gateway's continuation splice (PR 9)
+# resumes byte-identically on another replica. A bare (non-gateway)
+# client sees a truncated stream (missing [DONE]), which the OpenAI wire
+# shape defines as detectable.
+_MIGRATE = object()
+
+
+def _migrate_signal(item: object) -> str | None:
+    """The migration reason when ``item`` is the sentinel, else None
+    (regular queue items are LISTS of token tuples, never tuples)."""
+    if isinstance(item, tuple) and len(item) == 2 and item[0] is _MIGRATE:
+        return str(item[1])
+    return None
+
 
 class SidecarServer:
     def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
@@ -84,7 +101,8 @@ class SidecarServer:
                  accounting_window: float = 10.0,
                  accounting_chip: str | None = None,
                  preempt_max: int = 3, preempt_high_water: float = 0.0,
-                 engine_watchdog=None, engine_factory=None, clock=None):
+                 engine_watchdog=None, engine_factory=None, clock=None,
+                 migrate_streams: bool = True, admin_enabled: bool = True):
         self.engine = engine
         self.logger = logger or new_logger()
         # Injectable monotonic clock (graftlint clock-discipline): all
@@ -105,6 +123,38 @@ class SidecarServer:
         self.state = "ok"
         self.restarts = 0
         self.last_restart: dict[str, Any] | None = None
+        # Planned live migration (ISSUE 11): live SSE streams tracked so
+        # a drain (or supervised restart) can end each one at a token
+        # boundary with no terminal frame — the continuation-capable
+        # gateway splices them onto another replica. migrate_streams=False
+        # restores the pre-fleet contract (restart fails streams with a
+        # terminal "error" frame; drain only blocks new work).
+        self.migrate_streams = migrate_streams
+        # The /admin/* surface (drain/undrain/migration) is mutating and
+        # unauthenticated like the rest of this listener: it assumes the
+        # sidecar port is reachable only from the gateway network (the
+        # same trust model as /v1/chat/completions, which is equally
+        # open). SERVING_ADMIN_ENABLED=false removes the routes for
+        # deployments that expose the sidecar more widely.
+        self.admin_enabled = admin_enabled
+        # Drain intent, separate from ``state``: a drain requested while
+        # a supervised restart is in flight ("degraded") must survive
+        # the restart's completion instead of being clobbered back to
+        # "ok" (code-review finding). ``state`` stays the single
+        # externally-visible verdict; this flag is what restart
+        # completion restores it from.
+        self._drain_requested = False
+        self._active_streams: dict[str, tuple[GenRequest, asyncio.Queue]] = {}
+        self.migrated_out = 0
+        # Authoritative resume material per migrated stream (ISSUE 11):
+        # completion id -> {token_ids, reason}. The gateway's
+        # continuation holds only TEXT (frames carry no ids), and text
+        # re-encoding is lossy when the cut lands mid-UTF-8 or mid-merge
+        # — but a PLANNED migration leaves this replica alive, so it
+        # publishes the exact prompt-relative generated ids + the reason
+        # (GET /admin/migration?id=...) and the new replica resumes
+        # byte-identically from them. Bounded FIFO.
+        self._migration_resume: dict[str, dict[str, Any]] = {}
         self.engine_factory = engine_factory
         self.engine_watchdog = engine_watchdog
         self.preempt_max = preempt_max
@@ -210,6 +260,10 @@ class SidecarServer:
         r.get("/debug/status", self.debug_status)
         r.get("/debug/profile", self.debug_profile)
         r.get("/debug/jax_trace", self.debug_jax_trace)
+        if self.admin_enabled:
+            r.post("/admin/drain", self.admin_drain)
+            r.post("/admin/undrain", self.admin_undrain)
+            r.get("/admin/migration", self.admin_migration)
         return r
 
     async def start(self, host: str = "127.0.0.1", port: int = 8000) -> int:
@@ -255,6 +309,92 @@ class SidecarServer:
         gateway sheds batch work when the engine queue backs up)."""
         return self.scheduler.queue_depth
 
+    # -- planned live migration (ISSUE 11) -----------------------------
+    def _migrate_active_streams(self, reason: str) -> int:
+        """End every live SSE stream at its current frame boundary with
+        no terminal frame and deschedule it, so a continuation-capable
+        gateway resumes each one on another replica (byte-identical,
+        once-only billing — the PR 9 splice contract). Runs on the event
+        loop. Returns how many streams were cut over."""
+        if not self.migrate_streams:
+            return 0
+        n = 0
+        for _rid, (gen, q) in list(self._active_streams.items()):
+            # Deschedule FIRST: a queued request is dropped before it
+            # ever prefills; an admitted one terminates at its next
+            # emission and frees its slot + KV pages. The sentinel
+            # carries the reason, which rides the published migration
+            # record so the gateway attributes the hop from EVIDENCE.
+            self.scheduler.cancel(gen)
+            q.put_nowait((_MIGRATE, reason))
+            n += 1
+        if n:
+            self.migrated_out += n
+            self.logger.info("live streams migrated off this replica",
+                             "streams", n, "reason", reason)
+        return n
+
+    def begin_drain(self, reason: str = "drain") -> dict[str, Any]:
+        """Planned drain (ISSUE 11 tentpole b): flip /health to 503
+        "draining" (LBs and the gateway prober route away), refuse new
+        generation work with a retryable 503, and migrate live streams
+        out. Reversible via ``undrain`` — the engine and scheduler stay
+        warm; drain is a routing verdict, not a teardown. A drain
+        arriving during a restart window keeps reporting "degraded"
+        (both 503) and takes effect when the restart completes."""
+        already = self._drain_requested
+        self._drain_requested = True
+        if self.state == "ok":
+            self.state = "draining"
+        migrated = 0 if already else self._migrate_active_streams(reason)
+        if not already:
+            self.logger.info("sidecar draining", "reason", reason,
+                             "migrated_streams", migrated)
+        return {"state": self.state, "migrated_streams": migrated,
+                "already_draining": already}
+
+    def undrain(self) -> dict[str, Any]:
+        """Readmit the replica: only a drain is reversible — a degraded
+        state (supervised restart in flight) clears itself."""
+        if self._drain_requested:
+            self._drain_requested = False
+            if self.state == "draining":
+                self.state = "ok"
+            self.logger.info("sidecar undrained; accepting work")
+        return {"state": self.state}
+
+    _MIGRATION_RESUME_CAP = 128
+
+    def _record_migration_resume(self, completion_id: str, ids: list[int],
+                                 reason: str) -> None:
+        """Publish a migrated stream's exact resume ids + the migration
+        reason for the gateway to fetch (dict preserves insertion order;
+        oldest evicted). The record doubles as the gateway's EVIDENCE
+        that this very stream's death was planned — without it, a death
+        at a draining/degraded replica is still charged as a failure."""
+        self._migration_resume[completion_id] = {"token_ids": list(ids),
+                                                 "reason": reason}
+        while len(self._migration_resume) > self._MIGRATION_RESUME_CAP:
+            del self._migration_resume[next(iter(self._migration_resume))]
+
+    async def admin_drain(self, req: Request) -> Response:
+        return Response.json(self.begin_drain())
+
+    async def admin_undrain(self, req: Request) -> Response:
+        return Response.json(self.undrain())
+
+    async def admin_migration(self, req: Request) -> Response:
+        """GET /admin/migration?id=<completion id> — the authoritative
+        resume token ids for a stream this replica migrated out (kept
+        until FIFO eviction: the gateway's re-establishment walk may
+        retry the fetch)."""
+        cid = req.query_get("id")
+        rec = self._migration_resume.get(cid)
+        if rec is None:
+            return Response.json({"error": "unknown migrated stream"}, status=404)
+        return Response.json({"id": cid, "token_ids": list(rec["token_ids"]),
+                              "reason": rec["reason"]})
+
     # -- serving-path fault tolerance (ISSUE 7) ------------------------
     def _on_preempt(self, reason: str) -> None:
         """Scheduler-thread hook: KV-pressure preemption telemetry."""
@@ -285,6 +425,13 @@ class SidecarServer:
         info: dict[str, Any] = {"reason": reason,
                                 "at": time.time(),  # graftlint: disable=clock-discipline -- epoch forensics stamp
                                 "forensics": forensics or {}}
+        # Migrate live streams BEFORE aborting the wedged scheduler
+        # (ISSUE 11): the migrate sentinel reaches each stream's queue
+        # ahead of abort_all's terminal-error token, so the generator
+        # ends with no terminal frame and a continuation-capable gateway
+        # splices the stream onto another replica — a PR 7 restart
+        # becomes invisible to streaming clients, not merely recoverable.
+        info["migrated_streams"] = self._migrate_active_streams("restart")
         info["failed_requests"] = old_sched.abort_all()
         self.logger.error("engine wedged; supervised in-place restart", None,
                           "reason", reason,
@@ -334,7 +481,10 @@ class SidecarServer:
         self._own_scheduler = True
         self.restarts += 1
         self.last_restart = info
-        self.state = "ok"
+        # A drain requested before or during the restart window survives
+        # it: the rebuilt replica must stay out of rotation until the
+        # operator undrains (code-review finding).
+        self.state = "draining" if self._drain_requested else "ok"
         if self.otel is not None:
             self.otel.set_engine_degraded(self.model_name, 0)
             self.otel.record_engine_restart(self.model_name, reason)
@@ -473,18 +623,42 @@ class SidecarServer:
     # -- handlers ------------------------------------------------------
     HEALTH_STALL_SECONDS = 60.0
 
+    def _load_report(self) -> dict[str, Any]:
+        """The /health load fields (ISSUE 11 satellite): queue depth, KV
+        page utilization, and slot occupancy ride the body the gateway's
+        ``HealthProber`` already fetches — it doubles as the fleet load
+        reporter with no second probe endpoint. Foreign (non-TPU)
+        deployments keep their status-only contract; the prober parses
+        these fields only when present."""
+        return {
+            "queue_depth": self.scheduler.queue_depth,
+            "kv_page_utilization": round(self.engine.kv_utilization(), 4),
+            "active_slots": self.scheduler.active_requests(),
+            "max_slots": self.engine.config.max_slots,
+        }
+
     async def health(self, req: Request) -> Response:
         """Liveness + device-stall detection: active requests with no
         completed engine step for HEALTH_STALL_SECONDS means the
         accelerator (or its tunnel) is wedged — report degraded with 503
         so orchestrators can recycle the replica. During a supervised
-        engine restart (ISSUE 7) the same 503 "degraded" flows, so
-        failover pools route around the window without external help."""
+        engine restart (ISSUE 7) the same 503 "degraded" flows, and a
+        planned drain (ISSUE 11) reports 503 "draining", so failover
+        pools route around both windows without external help. Every
+        body carries the load report (ISSUE 11 satellite)."""
+        load = self._load_report()
+        if self.state == "draining":
+            return Response.json({
+                "status": "draining",
+                "reason": "planned drain in progress; streams migrated",
+                **load,
+            }, status=503)
         if self.state == "degraded":
             return Response.json({
                 "status": "degraded",
                 "reason": "supervised engine restart in progress",
                 "restarts": self.restarts,
+                **load,
             }, status=503)
         stalled = (
             self.scheduler.active_requests() > 0
@@ -495,8 +669,9 @@ class SidecarServer:
                 "status": "degraded",
                 "reason": "no engine step completed recently with active requests",
                 "seconds_since_last_step": round(self._clock.now() - self.scheduler.last_step_time, 1),
+                **load,
             }, status=503)
-        return Response.json({"status": "ok"})
+        return Response.json({"status": "ok", **load})
 
     async def list_models(self, req: Request) -> Response:
         return Response.json({
@@ -534,6 +709,7 @@ class SidecarServer:
         m["uptime_seconds"] = round(self._clock.now() - self._started, 3)
         m["preemptions"] = self.scheduler.preemptions
         m["engine_restarts"] = self.restarts
+        m["streams_migrated_out"] = self.migrated_out
         gauges = self.sample_engine_gauges()  # refresh on every scrape
         m["slot_occupancy"] = round(gauges["slot_occupancy"], 4)
         m["kv_page_utilization"] = round(gauges["kv_page_utilization"], 4)
@@ -626,6 +802,7 @@ class SidecarServer:
             "state": self.state,
             "preemptions": self.scheduler.preemptions,
             "engine_restarts": self.restarts,
+            "streams_migrated_out": self.migrated_out,
         }
         if self.last_restart is not None:
             status["last_restart"] = self.last_restart
@@ -825,6 +1002,17 @@ class SidecarServer:
         # BEFORE any SSE headers go out (ISSUE 2). A stopped scheduler
         # (supervised engine restart in flight, ISSUE 7) is a retryable
         # 503 — submitting there would hang the client forever.
+        if self.state == "draining":
+            # Planned drain (ISSUE 11): this replica is leaving the pool
+            # — a retryable 503 sends the gateway's establishment walk to
+            # the next candidate before any SSE headers go out.
+            resp = Response.json({"error": {
+                "message": "sidecar is draining; retry another replica",
+                "type": "server_error",
+                "code": "draining",
+            }}, status=503)
+            resp.headers.set("Retry-After", "1")
+            return resp
         try:
             if self.state == "degraded":
                 raise SchedulerStoppedError("engine restart in progress")
@@ -844,6 +1032,10 @@ class SidecarServer:
             return resp
 
         if stream:
+            # Live-stream registry (ISSUE 11): drain/restart inject the
+            # migrate sentinel through this map; the generator's finally
+            # removes the entry on every exit path.
+            self._active_streams[gen.request_id] = (gen, q)
             return StreamingResponse.sse(
                 self._stream_chunks(gen, meta, q, include_usage, arrival, traceparent))
 
@@ -1089,6 +1281,7 @@ class SidecarServer:
         completion_tokens = 0
         reason = "stop"
         completed = False
+        migrated: str | None = None
         try:
             yield chunk({"role": "assistant", "content": ""}, None)
 
@@ -1099,8 +1292,17 @@ class SidecarServer:
             emitted_len = len(detok.emitted)
             stopped_early = False
             done = False
-            while not done:
-                batch = list(await q.get())
+            while not done and migrated is None:
+                item = await q.get()
+                migrated = _migrate_signal(item)
+                if migrated is not None:
+                    # Planned migration (ISSUE 11): stop at this frame
+                    # boundary with NO terminal frame — the gateway's
+                    # continuation splice resumes the stream on another
+                    # replica; tokens already framed here stay billed
+                    # here, everything after is the new replica's.
+                    break
+                batch = list(item)
                 if coalesce_s > 0 and not batch[-1][2]:  # last item not finished
                     deadline = loop.time() + coalesce_s
                     while not batch[-1][2]:
@@ -1108,9 +1310,13 @@ class SidecarServer:
                         if remaining <= 0:
                             break
                         try:
-                            batch.extend(await asyncio.wait_for(q.get(), remaining))
+                            nxt = await asyncio.wait_for(q.get(), remaining)
                         except asyncio.TimeoutError:
                             break
+                        migrated = _migrate_signal(nxt)
+                        if migrated is not None:
+                            break
+                        batch.extend(nxt)
                 parts: list[str] = []
                 for token, _logprob, finished, fin_reason in batch:
                     completion_tokens += 1
@@ -1145,6 +1351,19 @@ class SidecarServer:
                 if parts:
                     yield content_frame("".join(parts))
 
+            if migrated is not None:
+                # No finish chunk, no usage, no [DONE]: ending inside the
+                # content phase is what makes the stream resumable — a
+                # terminal frame would disarm the gateway continuation.
+                # detok.ids is the exact prompt-relative generated
+                # sequence at the cut (seeded resume ids + this
+                # replica's pushes, INCLUDING tokens whose text is still
+                # held back mid-UTF-8) — published so the new replica
+                # resumes byte-identically where text re-encoding would
+                # be lossy.
+                self._record_migration_resume(meta["id"], detok.ids, migrated)
+                reason = "migrated"
+                return
             self._observe_service(self._clock.now() - arrival)
             yield chunk({}, reason)
             if include_usage:
@@ -1171,11 +1390,14 @@ class SidecarServer:
             # Runs for completed AND abandoned streams (the server
             # acloses the generator on dead clients): phase spans, the
             # queue-wait sample, and the access-log line must not leak.
+            self._active_streams.pop(gen.request_id, None)
             if not completed:
                 # Abandoned mid-stream: the scheduler decodes on to the
                 # finish condition, but those tokens are wasted work —
                 # flag the request so the accounting bills them to
                 # engine.wasted_tokens{reason="disconnected"} (ISSUE 6).
+                # (A migrated stream was already descheduled by
+                # Scheduler.cancel; setting the flag again is harmless.)
                 gen.disconnected = True
             self._finalize_request(gen, meta, traceparent, completion_tokens,
                                    stream=True, finish_reason=reason)
@@ -1260,7 +1482,9 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                            accounting_chip=tcfg.accounting_chip or None,
                            preempt_max=preempt_budget,
                            preempt_high_water=svcfg.preempt_high_water,
-                           engine_watchdog=engine_watchdog)
+                           engine_watchdog=engine_watchdog,
+                           migrate_streams=svcfg.migrate_streams,
+                           admin_enabled=svcfg.admin_enabled)
     bound = await server.start(host, port)
     logger.info("tpu sidecar listening", "host", host, "port", bound)
     try:

@@ -1,0 +1,499 @@
+"""Fleet router (ISSUE 11): ring determinism, affinity keys, the
+affinity/spill selector, pool-config failure paths, and the
+gateway-level affinity acceptance on a VirtualClock (zero real sleeps).
+"""
+
+import json
+import random
+
+import pytest
+
+from inference_gateway_tpu.config import Config
+from inference_gateway_tpu.fleet.affinity import affinity_key
+from inference_gateway_tpu.fleet.migration import admin_url
+from inference_gateway_tpu.fleet.ring import HashRing
+from inference_gateway_tpu.fleet.router import FleetRouter
+from inference_gateway_tpu.netio.server import Headers, Request
+from inference_gateway_tpu.otel.otel import OpenTelemetry
+from inference_gateway_tpu.providers.registry import ProviderRegistry
+from inference_gateway_tpu.providers.routing import (
+    Deployment,
+    Pool,
+    PoolConfigError,
+    Selector,
+    load_pools_config,
+)
+from inference_gateway_tpu.resilience import Resilience, VirtualClock
+
+
+# ---------------------------------------------------------------------------
+# HashRing
+# ---------------------------------------------------------------------------
+def test_ring_deterministic_across_rebuilds():
+    """Same prefix → same deployment across process restarts: the ring
+    hashes through SHA-1 (never Python's per-process-salted hash), so
+    two independently built rings agree on every key."""
+    nodes = ["tpu/llama@a", "tpu/llama@b", "tpu/llama@c"]
+    r1 = HashRing(nodes, vnodes=64)
+    r2 = HashRing(list(reversed(nodes)), vnodes=64)  # build order irrelevant
+    for i in range(200):
+        key = f"key-{i}"
+        assert r1.candidates(key) == r2.candidates(key)
+
+
+def test_ring_pinned_owner():
+    """Determinism pin: these exact mappings must survive refactors —
+    a silent hash change would re-shard every fleet on upgrade."""
+    ring = HashRing(["tpu/a", "tpu/b"], vnodes=64)
+    owners = {key: ring.owner(key) for key in ("alpha", "beta", "gamma")}
+    # Both nodes appear across these keys (sanity that the pin is not
+    # degenerate), and each mapping is stable.
+    assert set(owners.values()) == {"tpu/a", "tpu/b"}
+    assert owners == {key: HashRing(["tpu/a", "tpu/b"], vnodes=64).owner(key)
+                      for key in owners}
+
+
+def test_ring_walk_covers_all_nodes_distinct():
+    ring = HashRing([f"n{i}" for i in range(5)], vnodes=16)
+    walk = ring.candidates("some-key")
+    assert sorted(walk) == [f"n{i}" for i in range(5)]
+    assert len(set(walk)) == 5
+
+
+def test_ring_distribution_roughly_even():
+    ring = HashRing(["a", "b", "c", "d"], vnodes=64)
+    counts = {n: 0 for n in "abcd"}
+    for i in range(2000):
+        counts[ring.owner(f"key-{i}")] += 1
+    # Loose bound: vnodes smooth the split; nobody owns <10% or >50%.
+    for n, c in counts.items():
+        assert 200 <= c <= 1000, counts
+
+
+def test_ring_empty_and_single():
+    assert HashRing([]).candidates("x") == []
+    assert HashRing([]).owner("x") is None
+    assert HashRing(["only"]).candidates("x") == ["only"]
+
+
+# ---------------------------------------------------------------------------
+# Affinity keys
+# ---------------------------------------------------------------------------
+def test_affinity_key_tail_insensitive_past_budget():
+    """The shared head fills the budget → user tails never change the
+    key (the whole point: a shared system prompt pins the deployment)."""
+    system = {"role": "system", "content": "S" * 300}
+    keys = {affinity_key([system, {"role": "user", "content": f"tail {i}"}],
+                         prefix_bytes=256)
+            for i in range(10)}
+    assert len(keys) == 1
+
+
+def test_affinity_key_diverges_within_budget():
+    k1 = affinity_key([{"role": "user", "content": "hello"}], prefix_bytes=256)
+    k2 = affinity_key([{"role": "user", "content": "world"}], prefix_bytes=256)
+    assert k1 != k2
+
+
+def test_affinity_key_message_boundaries_injective():
+    """("ab","c") must not collide with ("a","bc") across messages."""
+    k1 = affinity_key([{"role": "u", "content": "ab"}, {"role": "u", "content": "c"}])
+    k2 = affinity_key([{"role": "u", "content": "a"}, {"role": "u", "content": "bc"}])
+    assert k1 != k2
+
+
+def test_affinity_key_inputs():
+    assert affinity_key(None) is None
+    assert affinity_key([]) is None
+    assert affinity_key("") is None
+    assert affinity_key(123) is None
+    assert affinity_key("a raw responses input") is not None
+    # Structured content (vision parts) keys deterministically.
+    parts = [{"type": "text", "text": "hi"}, {"type": "image_url", "image_url": {"url": "data:x"}}]
+    assert (affinity_key([{"role": "user", "content": parts}])
+            == affinity_key([{"role": "user", "content": list(parts)}]))
+
+
+def test_admin_url_strips_v1():
+    assert admin_url("http://h:8000/v1", "drain") == "http://h:8000/admin/drain"
+    assert admin_url("http://h:8000/", "undrain") == "http://h:8000/admin/undrain"
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter selection
+# ---------------------------------------------------------------------------
+def _pool(*deployments):
+    return {"alias": Pool("alias", list(deployments))}
+
+
+def test_fleet_router_affine_and_stable():
+    a, b = Deployment("tpu", "m@a"), Deployment("tpu", "m@b")
+    router = FleetRouter(_pool(a, b))
+    key = affinity_key([{"role": "system", "content": "shared head " * 20}])
+    first = router.select_candidates("alias", affinity_key=key)
+    assert first is not None and len(first) == 2
+    hits = sum(router.select_candidates("alias", affinity_key=key)[0] is first[0]
+               for _ in range(20))
+    assert hits == 20  # consistent hashing: 100% ≥ the 90% acceptance bar
+
+
+def test_fleet_router_keyless_falls_back_to_round_robin():
+    a, b = Deployment("tpu", "m@a"), Deployment("tpu", "m@b")
+    router = FleetRouter(_pool(a, b))
+    firsts = {router.select_candidates("alias")[0].model for _ in range(4)}
+    assert firsts == {"m@a", "m@b"}  # the rotation still rotates
+
+
+def test_fleet_router_affinity_disabled_falls_back():
+    a, b = Deployment("tpu", "m@a"), Deployment("tpu", "m@b")
+    router = FleetRouter(_pool(a, b), affinity_enabled=False)
+    key = affinity_key([{"role": "user", "content": "x"}])
+    firsts = {router.select_candidates("alias", affinity_key=key)[0].model
+              for _ in range(4)}
+    assert firsts == {"m@a", "m@b"}
+
+
+def test_fleet_router_spills_on_saturation_then_returns():
+    """Acceptance: saturation spills to the NEXT RING CANDIDATE instead
+    of queueing behind the affine target; when the load clears, the key
+    goes home."""
+    a, b = Deployment("tpu", "m@a"), Deployment("tpu", "m@b")
+    loads = {}
+    otel = OpenTelemetry()
+    router = FleetRouter(_pool(a, b), load=lambda p, m: loads.get((p, m)),
+                         spill_queue_depth=4, spill_kv_high_water=0.9,
+                         otel=otel)
+    key = affinity_key([{"role": "system", "content": "pinned prompt " * 30}])
+    affine = router.select_candidates("alias", affinity_key=key)[0]
+    other = next(d for d in (a, b) if d is not affine)
+
+    # Queue backlog at the spill mark → next ring candidate leads.
+    loads[(affine.provider, affine.model)] = {"queue_depth": 4}
+    spilled = router.select_candidates("alias", affinity_key=key)
+    assert spilled[0] is other and spilled[1] is affine
+    # KV pressure spills too.
+    loads[(affine.provider, affine.model)] = {"queue_depth": 0,
+                                              "kv_page_utilization": 0.95}
+    assert router.select_candidates("alias", affinity_key=key)[0] is other
+    # Below both marks → affine again.
+    loads[(affine.provider, affine.model)] = {"queue_depth": 3,
+                                              "kv_page_utilization": 0.5}
+    assert router.select_candidates("alias", affinity_key=key)[0] is affine
+    # Everyone saturated → stay affine (locality is the cheapest queue).
+    loads[(affine.provider, affine.model)] = {"queue_depth": 9}
+    loads[(other.provider, other.model)] = {"queue_depth": 9}
+    assert router.select_candidates("alias", affinity_key=key)[0] is affine
+
+    hits = sum(otel.affinity_hit_counter.values().values())
+    spills = otel.affinity_spill_counter.values()
+    assert hits == 3  # first select + below-marks + everyone-saturated
+    assert spills[("alias", "saturated")] == 2
+
+
+def test_fleet_router_demotes_unhealthy_and_counts_spill():
+    a, b = Deployment("tpu", "m@a"), Deployment("tpu", "m@b")
+    otel = OpenTelemetry()
+    down = set()
+    router = FleetRouter(_pool(a, b), health=lambda d: d.model not in down,
+                         otel=otel)
+    key = affinity_key([{"role": "system", "content": "x" * 200}])
+    affine = router.select_candidates("alias", affinity_key=key)[0]
+    down.add(affine.model)
+    reordered = router.select_candidates("alias", affinity_key=key)
+    assert reordered[0] is not affine and reordered[-1] is affine
+    assert otel.affinity_spill_counter.values()[("alias", "unhealthy")] == 1
+    # Nobody healthy: ring order returned for the executor's gates.
+    down.update({a.model, b.model})
+    assert len(router.select_candidates("alias", affinity_key=key)) == 2
+
+
+def test_fleet_router_duplicate_deployments_keep_failover_width():
+    """Legacy pools list the same (provider, model) twice: the ring
+    collapses them to one node, but the candidate walk must keep both
+    entries (the continuation resume target depends on it)."""
+    a1, a2 = Deployment("tpu", "same"), Deployment("tpu", "same")
+    router = FleetRouter(_pool(a1, a2))
+    key = affinity_key([{"role": "user", "content": "x"}])
+    assert len(router.select_candidates("alias", affinity_key=key)) == 2
+
+
+def test_fleet_router_cluster_queue_depth_pool_min_cluster_max():
+    a, b = Deployment("tpu", "m@a"), Deployment("tpu", "m@b")
+    loads = {("tpu", "m@a"): {"queue_depth": 7}, ("tpu", "m@b"): {"queue_depth": 2}}
+    down = set()
+    router = FleetRouter(_pool(a, b), load=lambda p, m: loads.get((p, m)),
+                         health=lambda d: d.model not in down)
+    assert router.pool_queue_depth("alias") == 2  # min over healthy
+    assert router.cluster_queue_depth() == 2
+    down.add("m@b")
+    assert router.cluster_queue_depth() == 7
+    # No reports → 0 (ignorance never sheds).
+    assert FleetRouter(_pool(a, b)).cluster_queue_depth() == 0
+
+
+def test_cluster_queue_depth_idle_pool_never_masks_saturated_pool():
+    """Review finding: the admission signal is per pool (max across
+    pools of min within pool) — a different model's idle pool must not
+    hide a saturated pool from shedding/Retry-After."""
+    heavy1, heavy2 = Deployment("tpu", "h@1"), Deployment("tpu", "h@2")
+    light = Deployment("tpu", "l@1"), Deployment("tpu", "l@2")
+    pools = {"heavy": Pool("heavy", [heavy1, heavy2]),
+             "light": Pool("light", list(light))}
+    loads = {("tpu", "h@1"): {"queue_depth": 50},
+             ("tpu", "h@2"): {"queue_depth": 50}}
+    router = FleetRouter(pools, load=lambda p, m: loads.get((p, m)))
+    assert router.pool_queue_depth("heavy") == 50
+    assert router.pool_queue_depth("light") == 0
+    assert router.cluster_queue_depth() == 50
+
+
+def test_fleet_router_snapshot_shape():
+    a = Deployment("tpu", "m@a", url="http://h:1/v1")
+    b = Deployment("tpu", "m@b")
+    router = FleetRouter(_pool(a, b), load=lambda p, m: {"queue_depth": 1})
+    snap = router.snapshot()
+    assert snap["affinity_enabled"] is True
+    assert snap["cluster_queue_depth"] == 1
+    deps = snap["pools"]["alias"]["deployments"]
+    assert {d["model"] for d in deps} == {"m@a", "m@b"}
+    assert any(d["url"] == "http://h:1/v1" for d in deps)
+    assert sorted(snap["pools"]["alias"]["ring_nodes"]) == ["tpu/m@a", "tpu/m@b"]
+
+
+def test_base_selector_ignores_affinity_key():
+    pool = {"alias": Pool("alias", [Deployment("tpu", "a"), Deployment("tpu", "b")])}
+    sel = Selector(pool)
+    assert sel.affinity_enabled is False
+    assert len(sel.select_candidates("alias", affinity_key="whatever")) == 2
+
+
+# ---------------------------------------------------------------------------
+# load_pools_config failure paths + fleet fields
+# ---------------------------------------------------------------------------
+def _write(tmp_path, text):
+    p = tmp_path / "pools.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_pools_config_fleet_fields_parse(tmp_path):
+    path = _write(tmp_path, """
+pools:
+  - model: llama
+    deployments:
+      - {provider: tpu, model: llama@a, serve_model: llama-3-8b, url: "http://a:8000/v1"}
+      - {provider: tpu, model: llama@b, serve_model: llama-3-8b, url: "http://b:8000/v1"}
+""")
+    pools = load_pools_config(path)
+    d = pools["llama"].deployments[0]
+    assert (d.model, d.serve_model, d.url) == ("llama@a", "llama-3-8b", "http://a:8000/v1")
+    # serve_model defaults to model when omitted.
+    assert Deployment("tpu", "m").serve_model == "m"
+
+
+def test_pools_config_identical_duplicates_and_shared_replicas_legal(tmp_path):
+    """Legacy weighted-rotation duplicates and one replica shared by two
+    pools (same url/serve_model) must keep loading."""
+    path = _write(tmp_path, """
+pools:
+  - model: legacy
+    deployments:
+      - {provider: tpu, model: same}
+      - {provider: tpu, model: same}
+  - model: p1
+    deployments:
+      - {provider: tpu, model: rep, serve_model: m, url: "http://a/v1"}
+      - {provider: tpu, model: other}
+  - model: p2
+    deployments:
+      - {provider: tpu, model: rep, serve_model: m, url: "http://a/v1"}
+      - {provider: tpu, model: other}
+""")
+    pools = load_pools_config(path)
+    assert len(pools["legacy"].deployments) == 2
+    assert pools["p1"].deployments[0].url == pools["p2"].deployments[0].url
+
+
+@pytest.mark.parametrize("yaml_text, fragment", [
+    ("pools:\n  - model: a\n    deployments:\n      - {provider: tpu, model: x}\n"
+     "      - {provider: tpu, model: y}\n  - model: a\n    deployments:\n"
+     "      - {provider: tpu, model: x}\n      - {provider: tpu, model: y}\n",
+     "duplicate pool alias 'a'"),
+    ("pools:\n  - model: empty\n    deployments: []\n", "'empty' has no deployments"),
+    ("pools:\n  - model: empty2\n", "'empty2' has no deployments"),
+    ("pools:\n  - model: one\n    deployments:\n      - {provider: tpu, model: x}\n",
+     "needs at least 2 deployments"),
+    ("pools:\n  - model: bad\n    deployments:\n      - just-a-string\n"
+     "      - {provider: tpu, model: y}\n",
+     "deployment #0 must be a mapping, got str"),
+    ("pools:\n  - model: bad2\n    deployments:\n      - {provider: tpu, model: [1, 2]}\n"
+     "      - {provider: tpu, model: y}\n",
+     "field 'model' must be a string, got list"),
+    ("pools:\n  - not-a-mapping\n", "pool entry #0 must be a mapping"),
+    ("pools:\n  - model: q\n    deployments: {provider: tpu}\n",
+     "deployments must be a list"),
+    ("pools:\n  - model: unk\n    deployments:\n      - {provider: nosuch, model: x}\n"
+     "      - {provider: tpu, model: y}\n",
+     "unknown provider 'nosuch'"),
+    ("pools:\n  - model: dup\n    deployments:\n"
+     "      - {provider: tpu, model: x, url: \"http://a/v1\"}\n"
+     "      - {provider: tpu, model: x, url: \"http://b/v1\"}\n",
+     "deployment id tpu/x is defined with conflicting url/serve_model"),
+    # Order-insensitive: a url-less duplicate AFTER a url-bearing one
+    # conflicts just the same (review finding).
+    ("pools:\n  - model: dup2\n    deployments:\n"
+     "      - {provider: tpu, model: x, url: \"http://a/v1\"}\n"
+     "      - {provider: tpu, model: x}\n",
+     "deployment id tpu/x is defined with conflicting url/serve_model"),
+    # Cross-pool conflicts too: the identity keyspace is global.
+    ("pools:\n"
+     "  - model: p1\n    deployments:\n"
+     "      - {provider: tpu, model: x, url: \"http://a/v1\"}\n"
+     "      - {provider: tpu, model: y}\n"
+     "  - model: p2\n    deployments:\n"
+     "      - {provider: tpu, model: x, url: \"http://b/v1\"}\n"
+     "      - {provider: tpu, model: z}\n",
+     "deployment id tpu/x is defined with conflicting url/serve_model"),
+    ("pools:\n  - deployments:\n      - {provider: tpu, model: x}\n",
+     "missing model alias"),
+])
+def test_pools_config_failure_paths_structured(tmp_path, yaml_text, fragment):
+    with pytest.raises(PoolConfigError) as exc:
+        load_pools_config(_write(tmp_path, yaml_text))
+    assert fragment in str(exc.value), str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# Gateway-level affinity acceptance (VirtualClock, zero real sleeps)
+# ---------------------------------------------------------------------------
+SHARED_SYSTEM = "You are a meticulous assistant. " * 20  # > prefix budget
+
+
+class _RecordingUpstream:
+    """Minimal OpenAI-compatible streaming upstream that records which
+    model each request targeted (the routing outcome under test)."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.models = []
+
+    async def request(self, method, url, headers=None, body=b"", timeout=None,
+                      stream=False, traceparent=None):
+        from inference_gateway_tpu.netio import sse
+        from inference_gateway_tpu.netio.client import ClientResponse
+
+        parsed = json.loads(body)
+        self.models.append(parsed.get("model"))
+        resp = ClientResponse(status=200, headers=Headers())
+        resp.headers.set("Content-Type", "text/event-stream")
+
+        async def chunks():
+            yield sse.format_event({
+                "id": "c1", "object": "chat.completion.chunk", "created": 1,
+                "model": parsed.get("model"),
+                "choices": [{"index": 0, "delta": {"content": "ok"},
+                             "finish_reason": "stop"}]})
+            yield sse.DONE_FRAME
+
+        resp._inproc_chunks = chunks()
+        return resp
+
+    async def post(self, url, body, headers=None, timeout=None, stream=False,
+                   traceparent=None):
+        return await self.request("POST", url, headers=headers, body=body,
+                                  timeout=timeout, stream=stream,
+                                  traceparent=traceparent)
+
+
+def _fleet_router_impl(upstream, otel=None, loads=None):
+    from inference_gateway_tpu.api.routes import RouterImpl
+
+    cfg = Config.load({"ROUTING_AFFINITY_PREFIX_BYTES": "256"})
+    registry = ProviderRegistry({"tpu": cfg.providers["tpu"]})
+    res = Resilience(cfg.resilience, otel=otel, clock=upstream.clock,
+                     rng=random.Random(0))
+    pools = {"pool-m": Pool("pool-m", [Deployment("tpu", "rep-a", serve_model="m"),
+                                       Deployment("tpu", "rep-b", serve_model="m")])}
+    selector = FleetRouter(
+        pools, health=res.healthy,
+        load=(lambda p, m: (loads or {}).get((p, m))),
+        affinity_prefix_bytes=256, otel=otel)
+    return RouterImpl(cfg, registry, upstream, otel=otel, selector=selector,
+                      resilience=res)
+
+
+def _chat_req(user_text):
+    body = {"model": "pool-m", "stream": True, "temperature": 0,
+            "messages": [{"role": "system", "content": SHARED_SYSTEM},
+                         {"role": "user", "content": user_text}]}
+    return Request(method="POST", path="/v1/chat/completions", query={},
+                   headers=Headers(), body=json.dumps(body).encode())
+
+
+async def test_affinity_acceptance_shared_prefix_lands_affine():
+    """Acceptance: two deployments, 20 shared-prefix requests → ≥90%
+    land on the affine deployment (here: 100%, consistent hashing), on
+    a VirtualClock with zero real sleeps; the upstream sees serve_model,
+    never the replica id."""
+    clk = VirtualClock()
+    upstream = _RecordingUpstream(clk)
+    otel = OpenTelemetry()
+    router = _fleet_router_impl(upstream, otel=otel)
+    responses = []
+    for i in range(20):
+        resp = await router.chat_completions_handler(_chat_req(f"question {i}"))
+        assert resp.status == 200
+        async for _ in resp.chunks:
+            pass
+        responses.append(resp)
+    served = {r.headers.get("X-Selected-Model") for r in responses}
+    assert len(served) == 1, served  # 100% ≥ the 90% acceptance bar
+    assert sum(otel.affinity_hit_counter.values().values()) == 20
+    # The wire model is the serve_model, identical across replicas.
+    assert set(upstream.models) == {"m"}
+
+
+async def test_affinity_acceptance_saturation_spills_not_queues():
+    """Acceptance: saturating the affine deployment's load report makes
+    the SAME key spill to the other replica instead of queueing."""
+    clk = VirtualClock()
+    upstream = _RecordingUpstream(clk)
+    otel = OpenTelemetry()
+    loads = {}
+    router = _fleet_router_impl(upstream, otel=otel, loads=loads)
+    resp = await router.chat_completions_handler(_chat_req("q"))
+    affine = resp.headers.get("X-Selected-Model")
+    async for _ in resp.chunks:
+        pass
+    loads[("tpu", affine)] = {"queue_depth": 99}
+    resp2 = await router.chat_completions_handler(_chat_req("q2"))
+    spilled_to = resp2.headers.get("X-Selected-Model")
+    async for _ in resp2.chunks:
+        pass
+    assert spilled_to != affine
+    assert otel.affinity_spill_counter.values()[("pool-m", "saturated")] == 1
+
+
+def test_affinity_key_bounds_work_on_huge_content():
+    """Review finding: the key consumes only the leading budget bytes —
+    a 10MB inline image part must not be serialized in full on the
+    routing hot path. Clipping is deterministic (same head → same key)."""
+    import time
+
+    huge = "data:image/png;base64," + "A" * (10 << 20)
+    msgs = [{"role": "user", "content": [
+        {"type": "text", "text": "hi"},
+        {"type": "image_url", "image_url": {"url": huge}}]}]
+    t0 = time.perf_counter()
+    k1 = affinity_key(msgs, prefix_bytes=1024)
+    elapsed = time.perf_counter() - t0
+    assert k1 is not None
+    assert elapsed < 0.05, f"affinity_key took {elapsed:.3f}s on huge content"
+    # Deterministic: a second identical request keys the same...
+    assert affinity_key(msgs, prefix_bytes=1024) == k1
+    # ...and a huge STRING content is equally bounded.
+    t0 = time.perf_counter()
+    k2 = affinity_key([{"role": "user", "content": "S" * (10 << 20)}],
+                      prefix_bytes=1024)
+    assert time.perf_counter() - t0 < 0.05
+    assert k2 is not None

@@ -1,0 +1,546 @@
+"""Planned live stream migration (ISSUE 11 tentpole b).
+
+Three layers:
+
+- Sidecar drain mechanics against a real engine: a live greedy stream
+  ends at a token boundary with NO terminal frame, the request is
+  descheduled, /health flips to 503 "draining" with the load report,
+  new work 503s retryably, and undrain restores everything.
+- ``FleetMigrator`` unit behavior: drain orchestration (sidecar admin
+  call + instant routing demotion) and the evidence-based migration
+  record fetch (exact resume ids + reason, published by the replica
+  that cut the stream over).
+- THE e2e acceptance: two real sidecars behind one pool with
+  per-deployment URLs; draining the serving replica mid-stream (via the
+  gateway's /debug/fleet/drain) migrates the stream via the
+  continuation splice to the other replica with byte-identical client
+  output, one trace id, once-only billing, and
+  ``streams_migrated{reason="drain"}`` incremented.
+"""
+
+import json
+
+import pytest
+
+from inference_gateway_tpu.fleet.migration import FleetMigrator
+from inference_gateway_tpu.netio import sse
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.netio.server import Headers
+from inference_gateway_tpu.otel.access_log import AccessLog
+from inference_gateway_tpu.resilience.clock import VirtualClock
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.server import SidecarServer
+
+TRACEPARENT = "00-abcdefabcdefabcdefabcdefabcdef99-1234567890abcdef-01"
+
+
+def _engine_cfg():
+    return EngineConfig(model="test-tiny", max_slots=4, max_seq_len=192,
+                        dtype="float32", max_prefill_batch=2, use_mesh=False,
+                        decode_chunk=2)
+
+
+def _chat_body(max_tokens=8, model="test-tiny", **extra):
+    return {"model": model, "stream": True, "temperature": 0,
+            "max_tokens": max_tokens,
+            "stream_options": {"include_usage": True},
+            "messages": [{"role": "user", "content": "migrate me"}], **extra}
+
+
+def _parse_frames(body: bytes):
+    frames = []
+    for part in body.split(b"\n\n"):
+        part = part.strip()
+        if not part.startswith(b"data:"):
+            continue
+        payload = part[5:].strip()
+        frames.append((part + b"\n\n",
+                       None if payload == b"[DONE]" else json.loads(payload)))
+    return frames
+
+
+def _content_frames(raw: bytes):
+    return [ev for _r, ev in _parse_frames(raw)
+            if ev and ev.get("choices")
+            and (ev["choices"][0].get("delta") or {}).get("content")]
+
+
+# ---------------------------------------------------------------------------
+# Sidecar drain mechanics (real engine)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def sidecar(aloop):
+    engine = Engine(_engine_cfg())
+    access_log = AccessLog(service="tpu-sidecar", tail_size=64)
+    server = SidecarServer(engine, served_model_name="test-tiny",
+                           access_log=access_log)
+    port = aloop.run(server.start("127.0.0.1", 0))
+    yield server, port, access_log
+    aloop.run(server.shutdown())
+
+
+async def _stream_with_mid_action(port, body, action, after_content_frames=2):
+    """POST a streaming chat request; run ``action`` once
+    ``after_content_frames`` complete content frames have been relayed;
+    return the full raw bytes."""
+    client = HTTPClient()
+    headers = Headers()
+    headers.set("Content-Type", "application/json")
+    headers.set("traceparent", TRACEPARENT)
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                             json.dumps(body).encode(), headers=headers,
+                             stream=True)
+    assert resp.status == 200
+    out = b""
+    acted = False
+    async for block in resp.iter_raw():
+        out += block
+        if not acted and len(_content_frames(out)) >= after_content_frames:
+            acted = True
+            await action(resp)
+    assert acted, "stream finished before the mid-stream action fired"
+    return out, resp
+
+
+async def test_sidecar_drain_migrates_live_stream(sidecar):
+    server, port, access_log = sidecar
+    client = HTTPClient()
+
+    async def drain(_resp):
+        r = await client.post(f"http://127.0.0.1:{port}/admin/drain", b"")
+        assert r.status == 200
+        body = r.json()
+        assert body["state"] == "draining" and body["migrated_streams"] == 1
+
+    raw, _resp = await _stream_with_mid_action(
+        port, _chat_body(max_tokens=96), drain)
+    # Migration shape: content frames were relayed, then the stream ended
+    # with NO terminal frame — no finish chunk, no usage, no [DONE].
+    assert len(_content_frames(raw)) >= 2
+    assert sse.DONE_FRAME not in raw
+    assert b'"finish_reason":"stop"' not in raw and b'"finish_reason": "stop"' not in raw
+    assert server.migrated_out == 1
+
+    # /health reports draining + the load report (ISSUE 11 satellite).
+    h = await client.get(f"http://127.0.0.1:{port}/health")
+    assert h.status == 503
+    hb = h.json()
+    assert hb["status"] == "draining"
+    for field in ("queue_depth", "kv_page_utilization", "active_slots", "max_slots"):
+        assert field in hb
+
+    # New generation work is refused retryably.
+    r = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                          json.dumps(_chat_body(max_tokens=4)).encode())
+    assert r.status == 503
+    assert r.json()["error"]["code"] == "draining"
+    assert r.headers.get("Retry-After") is not None
+
+    # The migrated request's access line is flagged and bills only the
+    # tokens it actually framed.
+    lines = [e for e in access_log.tail if e.get("route") == "/v1/chat/completions"]
+    assert lines[-1]["finish_reason"] == "migrated"
+    assert 0 < lines[-1]["output_tokens"] < 96
+
+    # Undrain restores service end to end.
+    r = await client.post(f"http://127.0.0.1:{port}/admin/undrain", b"")
+    assert r.status == 200 and r.json()["state"] == "ok"
+    h2 = await client.get(f"http://127.0.0.1:{port}/health")
+    assert h2.status == 200 and h2.json()["status"] == "ok"
+    r2 = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                           json.dumps(_chat_body(max_tokens=4)).encode())
+    assert r2.status == 200
+
+
+async def test_health_body_carries_load_report_when_ok(sidecar):
+    _server, port, _log = sidecar
+    h = await HTTPClient().get(f"http://127.0.0.1:{port}/health")
+    assert h.status == 200
+    body = h.json()
+    assert body["status"] == "ok"
+    assert body["max_slots"] == 4
+    assert body["queue_depth"] == 0 and body["active_slots"] == 0
+    assert 0.0 <= body["kv_page_utilization"] <= 1.0
+
+
+def test_migrate_streams_off_restores_error_frames(aloop):
+    """SERVING_MIGRATE_STREAMS=false: a supervised restart fails live
+    streams with the terminal "error" frame (the pre-fleet contract for
+    deployments without a continuation-capable gateway in front)."""
+    import asyncio
+
+    cfg = _engine_cfg()
+    engine = Engine(cfg)
+    server = SidecarServer(engine, served_model_name="test-tiny",
+                           engine_factory=lambda: Engine(cfg),
+                           migrate_streams=False)
+    port = aloop.run(server.start("127.0.0.1", 0))
+    try:
+        async def run():
+            async def restart(_resp):
+                await server.restart_engine("test-off-switch")
+
+            return await _stream_with_mid_action(
+                port, _chat_body(max_tokens=96), restart)
+
+        raw, _resp = aloop.run(run())
+        finishes = [ev["choices"][0].get("finish_reason")
+                    for _r, ev in _parse_frames(raw)
+                    if ev and ev.get("choices")]
+        assert "error" in finishes  # terminal frame, stream complete
+        assert server.migrated_out == 0
+    finally:
+        aloop.run(server.shutdown())
+
+
+def test_admin_surface_kill_switch(aloop):
+    """SERVING_ADMIN_ENABLED=false removes the mutating /admin routes
+    for sidecars exposed beyond the gateway network (review finding)."""
+    server = SidecarServer(Engine(_engine_cfg()), served_model_name="test-tiny",
+                           admin_enabled=False)
+    port = aloop.run(server.start("127.0.0.1", 0))
+    try:
+        client = HTTPClient()
+        for method, path in (("POST", "/admin/drain"), ("POST", "/admin/undrain"),
+                             ("GET", "/admin/migration?id=x")):
+            r = aloop.run(client.request(method, f"http://127.0.0.1:{port}{path}",
+                                         body=b""))
+            assert r.status == 404, (method, path, r.status)
+        # The data plane is unaffected.
+        h = aloop.run(client.get(f"http://127.0.0.1:{port}/health"))
+        assert h.status == 200
+    finally:
+        aloop.run(server.shutdown())
+
+
+# ---------------------------------------------------------------------------
+# FleetMigrator unit behavior
+# ---------------------------------------------------------------------------
+class _StubAdminClient:
+    def __init__(self, migration_records=None):
+        self.posts = []
+        self.gets = []
+        self.records = migration_records or {}
+
+    async def post(self, url, body, **kw):
+        self.posts.append(url)
+
+        class _R:
+            status = 200
+
+            @staticmethod
+            def json():
+                return {"state": "draining", "migrated_streams": 2}
+
+        return _R()
+
+    async def get(self, url, **kw):
+        self.gets.append(url)
+        cid = url.split("id=")[-1]
+        rec = self.records.get(cid)
+
+        class _R:
+            status = 200 if rec is not None else 404
+
+            @staticmethod
+            def json():
+                return rec if rec is not None else {"error": "unknown"}
+
+        return _R()
+
+
+async def test_migrator_drain_round_trip():
+    client = _StubAdminClient(migration_records={
+        "chatcmpl-1": {"id": "chatcmpl-1", "token_ids": [1, 2, 3],
+                       "reason": "restart"}})
+    m = FleetMigrator({("tpu", "rep-a"): "http://a:8000/v1",
+                       ("tpu", "rep-b"): "http://b:8000"},
+                      client, clock=VirtualClock())
+    assert not m.draining("tpu", "rep-a")
+
+    result = await m.drain("tpu", "rep-a")
+    assert result["draining"] is True
+    assert result["sidecar_status"] == 200
+    assert result["sidecar"]["migrated_streams"] == 2
+    assert client.posts == ["http://a:8000/admin/drain"]
+    assert m.draining("tpu", "rep-a")
+    snap = m.snapshot()
+    a = next(d for d in snap["deployments"] if d["model"] == "rep-a")
+    assert a["draining"] and a["draining_for_s"] is not None
+
+    await m.undrain("tpu", "rep-a")
+    assert not m.draining("tpu", "rep-a")
+    assert client.posts[-1] == "http://a:8000/admin/undrain"
+
+    # Evidence-based attribution: a published record yields (ids,
+    # reason); no record — or an unknown deployment — yields None.
+    assert await m.fetch_migration("tpu", "rep-a", "chatcmpl-1") == \
+        ([1, 2, 3], "restart")
+    assert client.gets[-1] == "http://a:8000/admin/migration?id=chatcmpl-1"
+    assert await m.fetch_migration("tpu", "rep-a", "chatcmpl-unknown") is None
+    assert await m.fetch_migration("tpu", "nope", "chatcmpl-1") is None
+    assert await m.fetch_migration("tpu", "rep-a", "") is None
+
+    with pytest.raises(KeyError):
+        await m.drain("tpu", "nope")
+
+
+async def test_migrator_drain_stands_when_sidecar_unreachable():
+    class _DeadClient:
+        async def post(self, url, body, **kw):
+            raise ConnectionError("down")
+
+    m = FleetMigrator({("tpu", "rep-a"): "http://a/v1"}, _DeadClient(),
+                      clock=VirtualClock())
+    result = await m.drain("tpu", "rep-a")
+    assert result["draining"] is True and "sidecar_error" in result
+    assert m.draining("tpu", "rep-a")  # routing demotion stands
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: two sidecars, gateway drain, continuation splice
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def fleet_stack(aloop, tmp_path):
+    from inference_gateway_tpu.main import build_gateway
+
+    cfg = _engine_cfg()
+    sidecars = []
+    logs = []
+    ports = []
+    for name in ("a", "b"):
+        log = AccessLog(service=f"tpu-sidecar-{name}", tail_size=64)
+        sc = SidecarServer(Engine(cfg), served_model_name="test-tiny",
+                           access_log=log)
+        ports.append(aloop.run(sc.start("127.0.0.1", 0)))
+        sidecars.append(sc)
+        logs.append(log)
+
+    pools_yaml = tmp_path / "pools.yaml"
+    pools_yaml.write_text(
+        "pools:\n"
+        "  - model: pool-fleet\n"
+        "    deployments:\n"
+        f"      - {{provider: tpu, model: tiny@a, serve_model: test-tiny, url: \"http://127.0.0.1:{ports[0]}/v1\"}}\n"
+        f"      - {{provider: tpu, model: tiny@b, serve_model: test-tiny, url: \"http://127.0.0.1:{ports[1]}/v1\"}}\n"
+    )
+    env = {
+        "TPU_API_URL": f"http://127.0.0.1:{ports[0]}/v1",
+        "ROUTING_ENABLED": "true",
+        "ROUTING_CONFIG_PATH": str(pools_yaml),
+        "SERVER_PORT": "0",
+        # Tracing on so the edge traceparent rides both establishments
+        # (the one-trace-id acceptance assertion).
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_TRACING_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": "0",
+        # Drain attribution is gateway-authoritative; probing has its
+        # own tests. Keep the surfaces independent here.
+        "RESILIENCE_PROBE_ENABLED": "false",
+    }
+    gw = build_gateway(env=env)
+    gw_port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, gw_port, sidecars, logs, ports
+    aloop.run(gw.shutdown())
+    for sc in sidecars:
+        aloop.run(sc.shutdown())
+
+
+async def _gateway_stream(gw_port, body, on_frames=None, after_frames=2):
+    client = HTTPClient()
+    headers = Headers()
+    headers.set("Content-Type", "application/json")
+    headers.set("traceparent", TRACEPARENT)
+    resp = await client.post(
+        f"http://127.0.0.1:{gw_port}/v1/chat/completions",
+        json.dumps(body).encode(), headers=headers, stream=True)
+    assert resp.status == 200
+    out = b""
+    acted = on_frames is None
+    async for block in resp.iter_raw():
+        out += block
+        if not acted and len(_content_frames(out)) >= after_frames:
+            acted = True
+            await on_frames(resp)
+    assert acted, "stream finished before the drain fired"
+    return out, resp
+
+
+async def test_e2e_drain_migrates_stream_byte_identical(fleet_stack):
+    """THE acceptance e2e: draining the serving sidecar mid-stream (via
+    the gateway's fleet drain endpoint) migrates the live greedy stream
+    to the other replica via the continuation splice — byte-identical
+    client output, one trace id, once-only billing, and
+    streams_migrated{reason="drain"} incremented."""
+    gw, gw_port, sidecars, logs, ports = fleet_stack
+    body = _chat_body(max_tokens=96, model="pool-fleet")
+
+    # Baseline: the unkilled run (affinity pins the same replica).
+    unkilled, resp0 = await _gateway_stream(gw_port, body)
+    assert sse.DONE_FRAME in unkilled
+    usage = next(ev["usage"] for _r, ev in _parse_frames(unkilled)
+                 if ev and ev.get("usage"))
+    assert usage["completion_tokens"] >= 6
+    affine = resp0.headers.get("X-Selected-Model")
+    assert affine in ("tiny@a", "tiny@b")
+    drained_idx = 0 if affine == "tiny@a" else 1
+
+    client = HTTPClient()
+
+    async def drain(resp):
+        assert resp.headers.get("X-Selected-Model") == affine
+        r = await client.post(
+            f"http://127.0.0.1:{gw.metrics_port}/debug/fleet/drain"
+            f"?provider=tpu&model={affine}", b"")
+        assert r.status == 200
+        assert r.json()["draining"] is True
+
+    migrated, _resp = await _gateway_stream(gw_port, body, on_frames=drain)
+
+    # Byte-identity modulo the per-run envelope identity (two runs mint
+    # different ids/created); within the migrated run ONE id spans the
+    # drain — the splice keeps the original envelope.
+    def normalize(raw: bytes) -> bytes:
+        frames = _parse_frames(raw)
+        ids = {ev["id"] for _r, ev in frames if ev and ev.get("id")}
+        created = {ev["created"] for _r, ev in frames if ev and "created" in ev}
+        assert len(ids) == 1 and len(created) == 1, (ids, created)
+        return (raw.replace(ids.pop().encode(), b"ID")
+                   .replace(b'"created":%d' % created.pop(), b'"created":0'))
+
+    assert normalize(migrated) == normalize(unkilled)
+
+    # streams_migrated{reason="drain"} — the tentpole counter.
+    vals = gw.otel.streams_migrated_counter.values()
+    assert vals[("pool-fleet", "tpu", "tpu", "drain")] == 1
+    # And it is a subset of post-first-byte recoveries.
+    rec = gw.otel.streams_recovered_counter.values()
+    assert rec[("pool-fleet", "tpu", "tpu", "post_first_byte")] == 1
+
+    # Planned drain must NOT have charged any breaker — a replica taken
+    # out on purpose is not ill.
+    assert all(state == "closed"
+               for state in gw.resilience.breaker_snapshot().values()), (
+        gw.resilience.breaker_snapshot())
+
+    # Once-only billing: the drained replica's line is flagged
+    # "migrated" and bills only what it framed; the resume replica's
+    # line bills exactly the remainder (resume prefix excluded).
+    drained_lines = [e for e in logs[drained_idx].tail
+                     if e.get("route") == "/v1/chat/completions"]
+    migrated_line = next(e for e in drained_lines
+                         if e.get("finish_reason") == "migrated")
+    other_idx = 1 - drained_idx
+    resume_lines = [e for e in logs[other_idx].tail if e.get("resume_tokens")]
+    assert len(resume_lines) == 1
+    resume = resume_lines[0]["resume_tokens"]
+    assert 0 < resume < usage["completion_tokens"]
+    assert resume_lines[0]["output_tokens"] == usage["completion_tokens"] - resume
+    assert migrated_line["output_tokens"] >= 2  # frames it relayed pre-drain
+
+    # One trace id spans the whole migrated request on BOTH replicas.
+    trace_id = TRACEPARENT.split("-")[1]
+    assert migrated_line["trace_id"] == trace_id
+    assert resume_lines[0]["trace_id"] == trace_id
+
+    # The drained sidecar is out of rotation; /debug/status shows it.
+    assert sidecars[drained_idx].state == "draining"
+    status = (await client.get(
+        f"http://127.0.0.1:{gw.metrics_port}/debug/status")).json()
+    drained_dep = next(d for d in status["migration"]["deployments"]
+                       if d["model"] == affine)
+    assert drained_dep["draining"] is True
+    routing_dep = next(d for d in status["routing"]["pools"]["pool-fleet"]["deployments"]
+                       if d["model"] == affine)
+    assert routing_dep["healthy"] is False
+
+    # New requests for the SAME prefix route to the surviving replica.
+    fresh, resp_fresh = await _gateway_stream(gw_port, _chat_body(
+        max_tokens=4, model="pool-fleet"))
+    assert resp_fresh.headers.get("X-Selected-Model") != affine
+    assert sse.DONE_FRAME in fresh
+
+    # Undrain restores the fleet.
+    r = await client.post(
+        f"http://127.0.0.1:{gw.metrics_port}/debug/fleet/undrain"
+        f"?provider=tpu&model={affine}", b"")
+    assert r.status == 200
+    assert sidecars[drained_idx].state == "ok"
+
+
+async def test_e2e_unplanned_cut_is_recovery_not_migration(fleet_stack):
+    """Review finding: migration attribution is EVIDENCE-based. An
+    unplanned relay kill (Fault.cut_stream, no sidecar migration record)
+    at a healthy replica still recovers via the splice but is charged as
+    a failure and NEVER counted as streams_migrated."""
+    from inference_gateway_tpu.resilience.faults import (
+        Fault,
+        FaultInjectingClient,
+        FaultScript,
+    )
+
+    gw, gw_port, _sidecars, _logs, _ports = fleet_stack
+    body = _chat_body(max_tokens=12, model="pool-fleet")
+    script = (FaultScript()
+              .script("/proxy/tpu/", Fault.cut_stream(after_frames=4))
+              .default("/proxy/tpu/", Fault.passthrough()))
+    real = gw.router_impl.client
+    gw.router_impl.client = FaultInjectingClient(script, inner=real)
+    try:
+        raw, _resp = await _gateway_stream(gw_port, body)
+    finally:
+        gw.router_impl.client = real
+    assert sse.DONE_FRAME in raw  # spliced to completion...
+    recovered = gw.otel.streams_recovered_counter.values()
+    assert sum(v for k, v in recovered.items()
+               if k[-1] == "post_first_byte") >= 1
+    # ...but with no migration record it is NOT a migration.
+    assert gw.otel.streams_migrated_counter.values() == {}
+
+
+def test_drain_survives_restart_window(aloop):
+    """Review finding: a drain requested before (or during) a supervised
+    restart must survive its completion — the rebuilt replica stays out
+    of rotation until the operator undrains."""
+    cfg = _engine_cfg()
+    server = SidecarServer(Engine(cfg), served_model_name="test-tiny",
+                           engine_factory=lambda: Engine(cfg))
+    port = aloop.run(server.start("127.0.0.1", 0))
+    try:
+        server.begin_drain()
+        assert server.state == "draining"
+        aloop.run(server.restart_engine("test-while-draining"))
+        assert server.state == "draining"  # NOT clobbered back to ok
+        h = aloop.run(HTTPClient().get(f"http://127.0.0.1:{port}/health"))
+        assert h.status == 503 and h.json()["status"] == "draining"
+        # A drain arriving DURING the degraded window keeps reporting
+        # degraded (both 503) and sticks after completion.
+        server.undrain()
+        assert server.state == "ok"
+    finally:
+        aloop.run(server.shutdown())
+
+
+async def test_migrator_admin_calls_gated_to_capable_deployments():
+    """Review finding: foreign cloud deployments are drainable at the
+    ROUTING level only — no /admin/* POST, no migration-record fetch
+    (completion ids must never leak to a third-party API)."""
+    client = _StubAdminClient(migration_records={
+        "cmpl&odd id": {"id": "cmpl&odd id", "token_ids": [7], "reason": "drain"}})
+    m = FleetMigrator({("tpu", "rep"): "http://a/v1",
+                       ("openai", "gpt-4o"): "https://api.openai.com/v1"},
+                      client, admin_keys={("tpu", "rep")}, clock=VirtualClock())
+    result = await m.drain("openai", "gpt-4o")
+    assert result["draining"] is True and "sidecar_status" not in result
+    assert m.draining("openai", "gpt-4o")  # routing demotion stands
+    assert client.posts == []  # no /admin POST left the gateway
+    assert await m.fetch_migration("openai", "gpt-4o", "cmpl-x") is None
+    assert client.gets == []
+    await m.undrain("openai", "gpt-4o")
+    assert client.posts == []
+
+    # Capable deployments fetch with the id URL-quoted (reserved chars
+    # must not truncate the query).
+    rec = await m.fetch_migration("tpu", "rep", "cmpl&odd id")
+    assert rec is None or rec == ([7], "drain")  # stub does not decode
+    assert client.gets[-1].endswith("?id=cmpl%26odd%20id")

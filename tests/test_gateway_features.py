@@ -246,6 +246,10 @@ pools:
         "LLAMACPP_API_KEY": "k",
         "ROUTING_ENABLED": "true",
         "ROUTING_CONFIG_PATH": str(pools),
+        # This test pins the ROUND-ROBIN pool contract; with affinity on
+        # (the fleet default, ISSUE 11) identical prompts deliberately
+        # pin to one deployment — covered in tests/test_fleet.py.
+        "ROUTING_AFFINITY_ENABLED": "false",
         "SERVER_PORT": "0",
     })
     port = await gw.start("127.0.0.1", 0)

@@ -205,3 +205,42 @@ def test_efficiency_instruments_registered_with_expected_shapes():
     assert isinstance(wasted, Counter)
     assert wasted.label_names == ("gen_ai_request_model", "reason")
     assert wasted.unit == "{token}"
+
+
+def test_fleet_routing_instruments_registered_with_expected_shapes():
+    """ISSUE 11: the fleet-routing surface must expose exactly the
+    advertised names — the acceptance criteria and dashboards key on
+    them."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    hits = by_name["inference_gateway.routing.affinity_hits"]
+    assert isinstance(hits, Counter)
+    assert hits.label_names == ("alias",)
+    assert hits.unit == "{request}"
+    spills = by_name["inference_gateway.routing.affinity_spills"]
+    assert isinstance(spills, Counter)
+    assert spills.label_names == ("alias", "reason")
+    assert spills.unit == "{request}"
+    migrated = by_name["inference_gateway.streams_migrated"]
+    assert isinstance(migrated, Counter)
+    # reason distinguishes a planned drain from a supervised-restart
+    # migration; from/to mirror streams_recovered for joinability.
+    assert migrated.label_names == ("alias", "from_provider", "to_provider", "reason")
+    assert migrated.unit == "{stream}"
+    load = by_name["inference_gateway.routing.deployment_load"]
+    assert isinstance(load, Gauge)
+    assert load.label_names == ("gen_ai_provider_name", "gen_ai_request_model", "signal")
+    assert load.ttl > 0  # stale reports age out of the exposition
+
+
+def test_noop_fleet_recorders_record_nothing():
+    """NoopTelemetry drift guard for the ISSUE 11 recorders."""
+    noop = NoopTelemetry()
+    noop.record_affinity_hit("alias")
+    noop.record_affinity_spill("alias", "saturated")
+    noop.record_stream_migrated("alias", "a", "b", "drain")
+    noop.set_deployment_load("tpu", "m", "queue_depth", 3.0)
+    assert noop.affinity_hit_counter.values() == {}
+    assert noop.affinity_spill_counter.values() == {}
+    assert noop.streams_migrated_counter.values() == {}
+    assert noop.deployment_load_gauge.values() == {}

@@ -239,3 +239,86 @@ def test_build_gateway_wires_prober_from_pools(tmp_path):
         "RESILIENCE_PROBE_ENABLED": "false",
     })
     assert gw2.prober is None
+
+
+# ---------------------------------------------------------------------------
+# Load reporting (ISSUE 11 satellite): the /health body doubles as the
+# fleet load report — one probe, no second endpoint.
+# ---------------------------------------------------------------------------
+async def test_probe_body_doubles_as_load_report():
+    clk = VirtualClock()
+    body = {"status": "ok", "queue_depth": 3, "kv_page_utilization": 0.42,
+            "active_slots": 2, "max_slots": 4}
+    script = (FaultScript()
+              .default("http://a/health", Fault.ok(body))
+              .default("http://b/health", Fault.ok(b'{"status":"ok"}')))
+    otel = OpenTelemetry()
+    p = _prober(otel=otel, clk=clk, client=FaultInjectingClient(script, clock=clk))
+    await p.probe_once()
+    assert p.status("tpu", "model-a") == "ok"
+    assert p.load("tpu", "model-a") == {"queue_depth": 3,
+                                        "kv_page_utilization": 0.42,
+                                        "active_slots": 2, "max_slots": 4}
+    # Status-only body (foreign runtime contract): healthy, no report.
+    assert p.healthy("tpu", "model-b")
+    assert p.load("tpu", "model-b") is None
+    # Per-deployment load gauges refreshed from the report.
+    g = otel.deployment_load_gauge.values()
+    assert g[("tpu", "model-a", "queue_depth")] == 3
+    assert g[("tpu", "model-a", "kv_page_utilization")] == 0.42
+    snap = p.snapshot()
+    a = next(t for t in snap["targets"] if t["model"] == "model-a")
+    assert a["status"] == "ok" and a["load"]["queue_depth"] == 3
+
+
+async def test_probe_parses_draining_status_from_503_body():
+    """A draining/degraded sidecar 503s with a reasoned body: the probe
+    FAILS (ejection path) but the status still lands in the report —
+    the migrator attributes stream deaths with it."""
+    clk = VirtualClock()
+    body = json.dumps({"status": "draining", "queue_depth": 0,
+                       "kv_page_utilization": 0.1, "active_slots": 1,
+                       "max_slots": 4}).encode()
+    script = (FaultScript()
+              .default("http://a/health", Fault.error(503, body=body))
+              .default("http://b/health", Fault.ok(b'{"status":"ok"}')))
+    p = _prober(eject_after=2, clk=clk,
+                client=FaultInjectingClient(script, clock=clk))
+    await p.probe_once()
+    assert p.status("tpu", "model-a") == "draining"
+    assert p.healthy("tpu", "model-a")  # one failure < eject_after
+    await p.probe_once()
+    assert not p.healthy("tpu", "model-a")  # ejected; routing routes away
+    assert p.status("tpu", "model-a") == "draining"
+
+
+async def test_probe_non_json_body_keeps_status_only_contract():
+    clk = VirtualClock()
+    script = (FaultScript()
+              .default("http://a/health", Fault.ok(b"OK"))
+              .default("http://b/health", Fault.ok(b'["list"]')))
+    p = _prober(clk=clk, client=FaultInjectingClient(script, clock=clk))
+    await p.probe_once()
+    assert p.healthy("tpu", "model-a") and p.healthy("tpu", "model-b")
+    assert p.status("tpu", "model-a") is None
+    assert p.load("tpu", "model-b") is None
+
+
+async def test_unreachable_probe_keeps_last_self_reported_status():
+    """A replica that said "draining" and then went silent keeps its
+    last word in the introspection surface (review finding)."""
+    clk = VirtualClock()
+    body = json.dumps({"status": "draining"}).encode()
+    script = (FaultScript()
+              .default("http://a/health", Fault.error(503, body=body))
+              .default("http://b/health", Fault.ok(b'{"status":"ok"}')))
+    p = _prober(eject_after=2, clk=clk,
+                client=FaultInjectingClient(script, clock=clk))
+    await p.probe_once()
+    assert p.status("tpu", "model-a") == "draining"
+    script._defaults["http://a/health"] = Fault.reset()  # now unreachable
+    await p.probe_once()
+    assert p.status("tpu", "model-a") == "draining"  # last word preserved
+    script._defaults["http://a/health"] = Fault.ok(b'{"status":"ok"}')
+    await p.probe_once()
+    assert p.status("tpu", "model-a") == "ok"
