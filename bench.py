@@ -310,6 +310,8 @@ def kernel_microbench(interpret: bool = False) -> dict:
     from inference_gateway_tpu.ops.paged_attention import (
         paged_attention_jax,
         paged_attention_tpu,
+        ragged_paged_attention_jax,
+        ragged_paged_attention_tpu,
     )
 
     out = {}
@@ -350,6 +352,42 @@ def kernel_microbench(interpret: bool = False) -> dict:
         out["paged_kernel_us"] = round(t_kernel, 1)
         out["paged_kernel_max_err"] = float(
             jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)).max())
+
+    # Ragged mixed batch (ISSUE 12): decode rows + one prefill chunk in
+    # one launch, vs the ragged gather reference, vs the BUCKETED
+    # equivalent (separate decode-kernel + gather-prefill calls over the
+    # same work). kernels_tpu.ragged_* keys record the gather-kill
+    # against r03's 25,856 µs gather baseline next TPU window.
+    n_dec = B - 1
+    chunk = min(seq // 2, mp * ps - 1)
+    rq_lens = np.array([1] * n_dec + [chunk], np.int32)
+    rkv_lens = np.array([min(seq, mp * ps)] * n_dec + [chunk], np.int32)
+    rq_starts = np.concatenate([[0], np.cumsum(rq_lens)[:-1]]).astype(np.int32)
+    Tm = int(rq_lens.sum())
+    rq = jnp.asarray(rng.normal(size=(Tm, Hq, D)), jnp.bfloat16)
+    rqs, rql, rkl = (jnp.asarray(rq_starts), jnp.asarray(rq_lens), jnp.asarray(rkv_lens))
+    t_rg, rref = timeit(
+        lambda *a: ragged_paged_attention_jax(*a, Hkv), rq, k, v, pt, rqs, rql, rkl)
+    out["ragged_gather_us"] = round(t_rg, 1)
+    if on_tpu or interpret:
+        t_rk, rgot = timeit(
+            lambda *a: ragged_paged_attention_tpu(*a, Hkv, interpret=interpret),
+            rq, k, v, pt, rqs, rql, rkl)
+        out["ragged_kernel_us"] = round(t_rk, 1)
+        out["ragged_kernel_max_err"] = float(
+            jnp.abs(rgot.astype(jnp.float32) - rref.astype(jnp.float32)).max())
+        # Bucketed equivalent: the decode rows via the classic decode
+        # kernel + the prefill chunk via a separate gather attention —
+        # two launches (and bucket padding) where ragged pays one.
+        qd = rq[:n_dec]
+        t_dec, _ = timeit(
+            lambda *a: paged_attention_tpu(*a, Hkv, interpret=interpret),
+            qd, k, v, pt[:n_dec], rkl[:n_dec])
+        t_pre, _ = timeit(
+            lambda *a: ragged_paged_attention_jax(*a, Hkv),
+            rq[n_dec:], k, v, pt[n_dec:], jnp.asarray([0], jnp.int32),
+            rql[n_dec:], rkl[n_dec:])
+        out["ragged_bucketed_us"] = round(t_dec + t_pre, 1)
 
     # Prefill at long-prompt shape.
     q2 = jnp.asarray(rng.normal(size=(B2, T, Hq, D)), jnp.bfloat16)

@@ -206,6 +206,81 @@ def lab_paged_attention(
 
 
 # ---------------------------------------------------------------------------
+# bench_kernels (ISSUE 12): ragged-vs-gather-vs-bucketed across the
+# fallback-layout matrix from ops/paged_attention.paged_dispatch — the
+# layouts that USED to force the 10.6×-slower gather path (BENCH_r03:
+# 25,856 µs vs 2,448 µs) and now take a kernel. Each layout runs a mixed
+# batch (decode rows + one prefill chunk):
+#   ragged   — one ragged kernel launch for the whole batch
+#   gather   — the pure-JAX ragged reference (the old fallback's cost)
+#   bucketed — decode kernel + separate prefill attention (two launches,
+#              the pre-ISSUE-12 dispatch shape)
+# Run: python benchmarks/kernel_lab.py --suite kernels [--interpret]
+# ---------------------------------------------------------------------------
+def bench_kernels(iters: int = 30, interpret: bool = False) -> dict:
+    from inference_gateway_tpu.ops.paged_attention import (
+        paged_dispatch,
+        ragged_paged_attention_jax,
+        ragged_paged_attention_tpu,
+    )
+
+    rng = np.random.default_rng(0)
+    # (name, Hq, Hkv, D, tp): the documented fallback matrix. folded =
+    # Hkv*D; tp>1 rows report the mesh-dispatch verdict (the kernel
+    # itself is measured single-device here — the sharded launch is the
+    # same kernel per shard).
+    layouts = [
+        ("aligned_256", 32, 4, 64, 1),        # classic kernel layout
+        ("misaligned_192", 24, 3, 64, 1),     # folded axis off the lane grid
+        ("misaligned_head_48", 8, 4, 48, 1),  # odd head_dim, folded 192
+        ("gqa_odd_heads_6", 24, 6, 64, 4),    # non-tp-divisible → replicated
+        ("tp1_mesh", 32, 4, 64, 0),           # tp=1 multi-device → replicated
+    ]
+    B, ps, P, mp, seq = (16, 64, 128, 8, 512) if not interpret else (4, 16, 32, 4, 64)
+    out: dict = {"platform": jax.devices()[0].platform, "mode":
+                 "cpu-interpret (parity evidence)" if interpret else "on-chip"}
+    for name, Hq, Hkv, D, tp in layouts:
+        # tp=0 is the tp1-multi-device sentinel: tp=1 over an 8-chip mesh.
+        path, reason = paged_dispatch(Hkv, Hq, Hkv * D, tp=max(tp, 1),
+                                      platform="tpu",
+                                      n_devices=8 if tp == 0 else max(tp, 1))
+        entry: dict = {"dispatch": path, "reason": reason}
+        q_lens = np.array([1] * (B - 1) + [min(seq // 2, mp * ps - 1)], np.int32)
+        kv_lens = np.array([min(seq, mp * ps)] * (B - 1) + [int(q_lens[-1])], np.int32)
+        q_starts = np.concatenate([[0], np.cumsum(q_lens)[:-1]]).astype(np.int32)
+        T = int(q_lens.sum())
+        q = jnp.asarray(rng.normal(size=(T, Hq, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv * D)), jnp.bfloat16)
+        pt = jnp.asarray(rng.integers(0, P, (B, mp)), jnp.int32)
+        qs, ql, kl = map(jnp.asarray, (q_starts, q_lens, kv_lens))
+        try:
+            t_g, ref = timeit(lambda *a: ragged_paged_attention_jax(*a, Hkv),
+                              q, k, v, pt, qs, ql, kl, iters=iters)
+            entry["gather_us"] = round(t_g, 1)
+            t_r, got = timeit(
+                lambda *a: ragged_paged_attention_tpu(*a, Hkv, interpret=interpret),
+                q, k, v, pt, qs, ql, kl, iters=iters)
+            entry["ragged_us"] = round(t_r, 1)
+            entry["ragged_max_err"] = float(
+                jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)).max())
+            n_dec = B - 1
+            t_d, _ = timeit(
+                lambda *a: paged_attention_tpu(*a, Hkv, interpret=interpret),
+                q[:n_dec], k, v, pt[:n_dec], kl[:n_dec], iters=iters)
+            t_p, _ = timeit(lambda *a: ragged_paged_attention_jax(*a, Hkv),
+                            q[n_dec:], k, v, pt[n_dec:],
+                            jnp.asarray([0], jnp.int32), ql[n_dec:], kl[n_dec:],
+                            iters=iters)
+            entry["bucketed_us"] = round(t_d + t_p, 1)
+            if entry["ragged_us"]:
+                entry["gather_over_ragged"] = round(t_g / t_r, 2)
+        except Exception as e:  # keep measuring the other layouts
+            entry["error"] = repr(e)[:200]
+        out[name] = entry
+    return out
+
+
 from inference_gateway_tpu.utils.benchtime import timeit_device
 
 
@@ -221,8 +296,14 @@ def main():
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--interpret", action="store_true",
                     help="CPU interpret mode (parity only, tiny shapes)")
+    ap.add_argument("--suite", choices=("lab", "kernels"), default="lab",
+                    help="'kernels' = ragged-vs-gather-vs-bucketed across the "
+                         "paged_dispatch fallback-layout matrix (ISSUE 12)")
     args = ap.parse_args()
     interpret = args.interpret
+    if args.suite == "kernels":
+        print(json.dumps(bench_kernels(iters=args.iters, interpret=interpret), indent=1))
+        return
     out: dict = {"platform": jax.devices()[0].platform}
     rng = np.random.default_rng(0)
 

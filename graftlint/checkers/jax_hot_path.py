@@ -15,14 +15,19 @@ Two scopes:
 
 2. **Submit-path functions** (named, host-side): the functions whose
    contract is "dispatch without waiting" — ``Engine.decode_chunk_submit``
-   / ``Engine._scatter_admission`` and ``Scheduler._submit_chunk`` /
-   ``Scheduler.run`` / ``Scheduler._process_handles``. There, only the
-   genuine sync primitives are banned: ``.item()``,
-   ``.block_until_ready()``, ``jax.device_get``, and ``np.asarray`` /
-   ``np.array`` **on anything** — a submit function that materializes a
-   device value serializes the pipeline it exists to overlap. (Fetch
-   functions — ``decode_chunk_fetch``, ``prefill_fetch`` — are the
-   designated sync points and are not in scope.)
+   / ``Engine._scatter_admission`` / ``Engine.mixed_step_submit`` and
+   ``Scheduler._submit_chunk`` / ``Scheduler.run`` /
+   ``Scheduler._process_handles`` / ``Scheduler._build_mixed_rows``
+   (the ISSUE 12 ragged descriptor assembly: building the per-row
+   (start, length, kind) arrays must stay pure host bookkeeping — a
+   sync there serializes the mixed step against the previous step's
+   results). There, only the genuine sync primitives are banned:
+   ``.item()``, ``.block_until_ready()``, ``jax.device_get``, and
+   ``np.asarray`` / ``np.array`` **on anything** — a submit function
+   that materializes a device value serializes the pipeline it exists
+   to overlap. (Fetch functions — ``decode_chunk_fetch``,
+   ``prefill_fetch``, ``mixed_step_fetch`` — are the designated sync
+   points and are not in scope.)
 """
 
 from __future__ import annotations
@@ -36,10 +41,10 @@ CHECKER = "jax-hot-path"
 # relpath suffix -> function names forming the submit path.
 SUBMIT_SCOPES = {
     "serving/engine.py": {
-        "decode_chunk_submit", "_scatter_admission",
+        "decode_chunk_submit", "_scatter_admission", "mixed_step_submit",
     },
     "serving/scheduler.py": {
-        "_submit_chunk", "run", "_process_handles",
+        "_submit_chunk", "run", "_process_handles", "_build_mixed_rows",
     },
 }
 

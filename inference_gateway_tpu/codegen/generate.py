@@ -112,6 +112,25 @@ _SERVING_DATA_PLANE_DOC = [
 ]
 
 
+# Emitted under the Serving section: ragged mixed-step scheduling
+# (ISSUE 12) in one paragraph; design + tiling in docs/performance.md.
+_SERVING_RAGGED_DOC = [
+    "### Ragged mixed-step scheduling",
+    "",
+    "`SERVING_MIXED_STEP_ENABLE` (on by default for paged engines) serves",
+    "each engine step as ONE ragged kernel launch over per-sequence",
+    "(start, length) descriptors: decode rows and prefill-chunk rows share",
+    "the step, so a long prompt's chunked prefill interleaves with active",
+    "decode streams instead of serializing ahead of them, and paged",
+    "engines admit prompts up to the context window. Greedy streams are",
+    "byte-identical to the bucketed path. The dispatch verdict is exported",
+    "as `engine.attention_path{path}` and `/debug/status.attention_path` —",
+    "`gather` means the ~10.6×-slower GSPMD fallback is live (off-TPU",
+    "only, post-ISSUE-12). Design: [docs/performance.md](docs/performance.md).",
+    "",
+]
+
+
 # Emitted under the Serving section: the serving-path fault model in one
 # paragraph (ISSUE 7); the full story lives in docs/resilience.md.
 _SERVING_FAULT_TOLERANCE_DOC = [
@@ -236,6 +255,7 @@ def generate_configurations_md(spec: dict) -> str:
             out.extend(_TELEMETRY_OBSERVABILITY_DOC)
         elif section == "serving":
             out.extend(_SERVING_DATA_PLANE_DOC)
+            out.extend(_SERVING_RAGGED_DOC)
             out.extend(_SERVING_FAULT_TOLERANCE_DOC)
         elif section == "routing":
             out.extend(_ROUTING_FLEET_DOC)
@@ -456,6 +476,13 @@ def check_config_defaults(spec: dict) -> list[str]:
         "SERVING_WATCHDOG_MIN_DEADLINE": cfg.serving.watchdog_min_deadline,
         "SERVING_MIGRATE_STREAMS": cfg.serving.migrate_streams,
         "SERVING_ADMIN_ENABLED": cfg.serving.admin_enabled,
+        "SERVING_MIXED_STEP_ENABLE": cfg.serving.mixed_step_enable,
+        "SERVING_MIXED_STEP_TOKENS": cfg.serving.mixed_step_tokens,
+        # Read at import by ops/paged_attention (FORCE_PAGED_KERNEL),
+        # not through a Config dataclass — listed so the dispatch force
+        # flag appears in Configurations.md/.env.example without this
+        # check importing jax.
+        "IG_TPU_PAGED_KERNEL": "",
         "CLIENT_TIMEOUT": cfg.client.timeout,
         "CLIENT_MAX_IDLE_CONNS": cfg.client.max_idle_conns,
         "CLIENT_MAX_IDLE_CONNS_PER_HOST": cfg.client.max_idle_conns_per_host,

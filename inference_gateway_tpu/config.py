@@ -384,6 +384,15 @@ class ServingConfig:
     # unauthenticated like the rest of the listener; false removes the
     # routes for deployments exposed beyond the gateway network.
     admin_enabled: bool = True
+    # Ragged mixed-step serving (ISSUE 12): one kernel launch per engine
+    # step for any prefill/decode mix, so chunked prefill interleaves
+    # with decode (no prefill head-of-line blocking) and paged engines
+    # admit prompts up to the context window. Applies where the engine
+    # supports it (paged, non-speculative, dense family); tokens is the
+    # packed query budget per step — 0 = auto (largest prefill bucket +
+    # max_slots).
+    mixed_step_enable: bool = True
+    mixed_step_tokens: int = 0
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "SERVING_") -> "ServingConfig":
@@ -406,6 +415,8 @@ class ServingConfig:
             watchdog_min_deadline=_get_duration(env, prefix + "WATCHDOG_MIN_DEADLINE", "60s"),
             migrate_streams=_get_bool(env, prefix + "MIGRATE_STREAMS", True),
             admin_enabled=_get_bool(env, prefix + "ADMIN_ENABLED", True),
+            mixed_step_enable=_get_bool(env, prefix + "MIXED_STEP_ENABLE", True),
+            mixed_step_tokens=_get_int(env, prefix + "MIXED_STEP_TOKENS", 0),
         )
 
 

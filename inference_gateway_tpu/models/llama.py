@@ -510,6 +510,126 @@ def forward_paged_impl(
 
 
 # ---------------------------------------------------------------------------
+# Ragged mixed-batch forward (ISSUE 12): prefill chunks + decode rows in
+# one program over the paged cache.
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def forward_ragged(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # (1, T) packed token ids, rows back to back
+    positions: jnp.ndarray,  # (1, T) absolute positions
+    cache: Params,  # {"k","v"}: (L, P, page_size, Hkv*D)
+    write_idx: jnp.ndarray,  # (1, T) flat page*page_size+offset (OOB = drop)
+    page_table: jnp.ndarray,  # (R, max_pages) row-aligned
+    q_starts: jnp.ndarray,  # (R,) packed offset of row r's queries
+    q_lens: jnp.ndarray,  # (R,) query count (0 = inactive row)
+    kv_lens: jnp.ndarray,  # (R,) total kv length after this step
+    mesh=None,
+) -> tuple[jnp.ndarray, Params]:
+    """One MIXED engine step over the paged cache: the packed token axis
+    carries every row's new tokens (a decode row contributes its pending
+    token, a prefill row its whole chunk), per-row descriptors say which
+    span belongs to which slot, and attention is the ragged paged op
+    (ops/paged_attention.ragged_paged_attention) — ONE launch per layer
+    for the whole batch, whatever mix of prefill and decode it holds.
+    Returns per-ROW last-position logits (R, V) and the updated cache.
+
+    This replaces the bucketed ``_prefill_fn``/``_decode_fn`` family for
+    paged serving: one compiled program at one static packed width
+    instead of one program per prompt bucket, and no bucket padding —
+    only the packed tail beyond the live tokens is dead work."""
+    return forward_ragged_impl(params, cfg, tokens, positions, cache, write_idx,
+                               page_table, q_starts, q_lens, kv_lens, mesh, _dense_ffn)
+
+
+def forward_ragged_impl(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: Params,
+    write_idx: jnp.ndarray,
+    page_table: jnp.ndarray,
+    q_starts: jnp.ndarray,
+    q_lens: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+    mesh,
+    ffn,  # (x, lp, cfg) -> residual FFN contribution (MoE plugs in here)
+) -> tuple[jnp.ndarray, Params]:
+    """Shared ragged skeleton, same flat-carry cache discipline as
+    forward_paged_impl (the scatter lowers to an in-place row update;
+    attention reads pages straight out of the big buffer)."""
+    from inference_gateway_tpu.ops.paged_attention import ragged_paged_attention
+
+    B, T = tokens.shape  # B == 1: the packed axis IS the batch
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    L, P, page_size, HkvD = cache["k"].shape
+    flat = P * page_size
+    total = L * flat
+    R = page_table.shape[0]
+
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+    inv_freq = rope_inv_freq(cfg.hd, cfg.rope_theta, cfg.rope_scaling_dict)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    def body(carry, per_layer):
+        x, ck, cv = carry
+        lp, li = per_layer
+        h = rms_norm(x, _nw(lp["attn_norm"], cfg), cfg.rms_norm_eps)
+        q = qmatmul(h, lp["wq"])
+        k = qmatmul(h, lp["wk"])
+        v = qmatmul(h, lp["wv"])
+        if cfg.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, T, Hq, D)
+        k = k.reshape(B, T, Hkv, D)
+        v = v.reshape(B, T, Hkv, D)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_flat = k.reshape(B, T, HkvD).astype(ck.dtype)
+        v_flat = v.reshape(B, T, HkvD).astype(cv.dtype)
+        w_idx = jnp.where(write_idx >= flat, total, write_idx + li * flat)
+        ck = ck.at[w_idx].set(k_flat, mode="drop")
+        cv = cv.at[w_idx].set(v_flat, mode="drop")
+        pages_k = ck.reshape(L * P, page_size, HkvD)
+        pages_v = cv.reshape(L * P, page_size, HkvD)
+        layer_table = page_table + li * P
+
+        attn = ragged_paged_attention(
+            q[0], pages_k, pages_v, layer_table, q_starts, q_lens, kv_lens,
+            Hkv, window=cfg.sliding_window, mesh=mesh)[None]  # (1, T, Hq, D)
+        x = x + qmatmul(attn.reshape(B, T, Hq * D), lp["wo"])
+        x = x + ffn(x, lp, cfg)
+        return (x, ck, cv), None
+
+    ck0 = cache["k"].reshape(total, HkvD)
+    cv0 = cache["v"].reshape(total, HkvD)
+    (x, ck, cv), _ = jax.lax.scan(
+        body, (x, ck0, cv0), (params["layers"], jnp.arange(L))
+    )
+    new_cache = {"k": ck.reshape(L, P, page_size, HkvD),
+                 "v": cv.reshape(L, P, page_size, HkvD)}
+
+    x = rms_norm(x, _nw(params["final_norm"], cfg), cfg.rms_norm_eps)
+    # Per-ROW logits at each row's last packed query (inactive rows are
+    # clamped to index 0; the caller ignores them).
+    last = jnp.clip(q_starts + q_lens - 1, 0, T - 1)
+    x = x[0, last]  # (R, H)
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
 # Presets
 # ---------------------------------------------------------------------------
 

@@ -268,6 +268,18 @@ class OpenTelemetry:
             "degraded so pools route around the window), else 0",
             ("gen_ai_request_model",),
         )
+        # Paged-attention dispatch verdict (ISSUE 12 satellite): which
+        # path this engine's layouts take. 1 on the active path, 0 on
+        # the others — a silently-degraded gather deployment (the
+        # ~10.6×-slower fallback) alerts on engine.attention_path
+        # {path="gather"} == 1 instead of hiding in XLA dumps.
+        self.engine_attention_path_gauge = r.gauge(
+            "engine.attention_path",
+            "Active paged-attention dispatch path (1 = the engine's layouts "
+            "take this path): kernel / kernel_sharded / kernel_replicated / "
+            "gather (the ~10.6x-slower GSPMD fallback) / dense (no paging)",
+            ("gen_ai_request_model", "path"),
+        )
         # Active pool health probing (ISSUE 9): per-deployment probe
         # verdict plus ejection/readmission lifecycle counters. The
         # gauge is set to 1 for every probed target at prober start —
@@ -441,6 +453,9 @@ class OpenTelemetry:
                       self.engine_queue_depth_gauge, self.engine_spec_acceptance_gauge,
                       self.engine_degraded_gauge):
             gauge.remove(labels)
+        for p in self.ATTENTION_PATHS:
+            self.engine_attention_path_gauge.remove(
+                {"gen_ai_request_model": model, "path": p})
 
     def remove_overload_gauges(self, endpoint_class: str) -> None:
         """Drain completion: the admission ledger's per-class series stop
@@ -502,6 +517,17 @@ class OpenTelemetry:
 
     def set_engine_degraded(self, model: str, value: int) -> None:
         self.engine_degraded_gauge.set(value, {"gen_ai_request_model": model})
+
+    # -- paged-attention dispatch verdict (ISSUE 12) ---------------------
+    ATTENTION_PATHS = ("kernel", "kernel_sharded", "kernel_replicated",
+                      "gather", "dense")
+
+    def set_attention_path(self, model: str, path: str) -> None:
+        """1 on the active dispatch path, explicit 0 on every other —
+        an absent series must never read as 'not on gather'."""
+        for p in self.ATTENTION_PATHS:
+            self.engine_attention_path_gauge.set(
+                1 if p == path else 0, {"gen_ai_request_model": model, "path": p})
 
     # -- active pool health probing (ISSUE 9) ----------------------------
     def set_pool_healthy(self, provider: str, model: str, value: int) -> None:
@@ -783,6 +809,9 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_stream_recovered(self, *a, **k) -> None:
+        pass
+
+    def set_attention_path(self, *a, **k) -> None:
         pass
 
     def set_engine_degraded(self, *a, **k) -> None:

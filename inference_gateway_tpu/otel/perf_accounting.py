@@ -5,7 +5,9 @@ module says how long it *should* have taken, from nothing but the model
 config and the chip's datasheet. Three cooperating pieces:
 
 - ``StepCostModel`` — the analytic cost of one engine step per kind
-  (``prefill`` / ``decode`` / ``spec`` / ``spec_ngram``): FLOPs from the
+  (``prefill`` / ``decode`` / ``spec`` / ``spec_ngram`` / ``mixed`` —
+  the ISSUE 12 ragged step, priced per-row from the same descriptors
+  the kernel consumes): FLOPs from the
   2·N-params-per-token rule plus the attention terms, HBM traffic from
   the resident weight stream plus KV read/write, and the roofline time
   ``max(flops/peak, bytes/bw)`` with a compute- vs bandwidth-bound
@@ -246,14 +248,38 @@ class StepCostModel:
             hbm_bytes += self.spec_k * self.draft_weight_bytes
         return self._cost(flops, hbm_bytes)
 
+    def mixed(self, *, work_tokens: int, context_tokens: int = 0,
+              pair_tokens: int = 0) -> StepCost:
+        """One ragged MIXED step (ISSUE 12): ``work_tokens`` query
+        positions — decode rows plus prefill-chunk tokens — share one
+        weight stream; attention is priced from the exact per-row
+        descriptors the scheduler assembled: ``pair_tokens`` = Σ over
+        queries of their attended span (the FLOPs term), and
+        ``context_tokens`` = Σ over rows of their kv length (the KV read
+        stream). With only decode rows this reduces exactly to
+        ``decode(batch, 1, context)``; a lone fresh prefill row reduces
+        to ``prefill(T, T²)`` — pinned by tests."""
+        tokens = max(work_tokens, 1)
+        flops = (tokens * 2.0 * self.active_params
+                 + self.attn_flops_per_pair * pair_tokens)
+        hbm_bytes = (self._dense_weight_bytes
+                     + self._expert_stream_bytes(tokens)
+                     + context_tokens * self.kv_bytes_per_token  # KV read
+                     + tokens * self.kv_bytes_per_token)  # KV write
+        return self._cost(flops, hbm_bytes)
+
     def step_cost(self, kind: str, *, batch: int, n_steps: int = 1, tokens: int = 0,
-                  context_tokens: int = 0, sq_tokens: int = 0) -> StepCost:
+                  context_tokens: int = 0, sq_tokens: int = 0,
+                  pair_tokens: int = 0) -> StepCost:
         if kind == "prefill":
             return self.prefill(tokens=max(tokens, batch), sq_tokens=sq_tokens)
         if kind == "spec":
             return self.spec(batch, context_tokens, ngram=False)
         if kind == "spec_ngram":
             return self.spec(batch, context_tokens, ngram=True)
+        if kind == "mixed":
+            return self.mixed(work_tokens=max(tokens, batch),
+                              context_tokens=context_tokens, pair_tokens=pair_tokens)
         return self.decode(batch, n_steps=max(n_steps, 1), context_tokens=context_tokens)
 
     # -- constructors --------------------------------------------------
@@ -349,15 +375,17 @@ class PerfAccounting:
     # -- feeders (scheduler thread) ------------------------------------
     def on_step(self, kind: str, duration_s: float, *, batch: int, n_steps: int = 1,
                 tokens: int = 0, work_tokens: int = 0, context_tokens: int = 0,
-                sq_tokens: int = 0) -> dict[str, Any]:
+                sq_tokens: int = 0, pair_tokens: int = 0) -> dict[str, Any]:
         """Price one recorded engine step; returns the cost fields the
         StepTimeline merges into its record. ``tokens`` is what reached
         clients (the goodput numerator); ``work_tokens`` what the step
         actually processed (prefill prices prompt tokens, not the batch
-        of first tokens it emits)."""
+        of first tokens it emits; mixed steps price every packed query
+        position)."""
         cost = self.cost.step_cost(kind, batch=batch, n_steps=n_steps,
                                    tokens=work_tokens or tokens,
-                                   context_tokens=context_tokens, sq_tokens=sq_tokens)
+                                   context_tokens=context_tokens, sq_tokens=sq_tokens,
+                                   pair_tokens=pair_tokens)
         now = self._now()
         win = None
         with self._lock:

@@ -112,6 +112,34 @@ class EngineConfig:
     spec_min_tokens_per_round: float = 1.3
     spec_probe_rounds: int = 8
     spec_probe_every: int = 128
+    # Ragged mixed-step serving (ISSUE 12): ONE jitted program computes
+    # prefill-chunk rows and decode rows of the same engine step in a
+    # single ragged launch (ops/paged_attention ragged kernel), so the
+    # scheduler can interleave a long prompt's chunked prefill with
+    # active decode streams — no prefill head-of-line blocking — and
+    # paged engines gain a long-prompt path (chunked ragged prefill up
+    # to the context window). Paged, non-speculative, non-MoE dense
+    # engines only; ignored elsewhere. mixed_step_tokens is the packed
+    # query budget per step (the ONE compiled shape); 0 = auto (largest
+    # prefill bucket + max_slots, floored at max_slots + 8).
+    mixed_step: bool = False
+    mixed_step_tokens: int = 0
+
+
+class PromptTooLongError(ValueError):
+    """A prompt above the engine's admittable limit (ISSUE 12 satellite):
+    carries the structured fields the serving edge's ``prompt_too_long``
+    400 body reports, so a prompt that slips past the edge check (direct
+    scheduler users, drifting limits) still fails with attribution
+    instead of a bare ValueError. Subclasses ValueError for existing
+    callers that catch the old shape."""
+
+    def __init__(self, prompt_tokens: int, max_prompt_tokens: int) -> None:
+        super().__init__(
+            f"prompt of {prompt_tokens} tokens exceeds the largest admittable "
+            f"prompt ({max_prompt_tokens} tokens) for this engine configuration")
+        self.prompt_tokens = prompt_tokens
+        self.max_prompt_tokens = max_prompt_tokens
 
 
 @dataclass
@@ -143,6 +171,34 @@ class _DecodeChunkHandle:
 
     toks_lp: jax.Array
     n_steps: int
+
+
+@dataclass
+class MixedRow:
+    """One row of a ragged mixed step (ISSUE 12): ``token_ids`` are the
+    new tokens this step writes+attends for ``slot`` — a decode row's
+    single pending token, or a prefill chunk — starting at cache
+    position ``start``. ``kind`` is accounting/metrics attribution only;
+    the engine computes both identically (that's the point)."""
+
+    slot: int
+    token_ids: list
+    start: int
+    kind: str = "decode"  # "decode" | "prefill"
+    temp: float = 0.0
+    top_p: float = 1.0
+    seed: int | None = None
+
+
+@dataclass
+class MixedStepHandle:
+    """An in-flight mixed step: ``toks_lp`` is a (2, R) device future
+    (per-row sampled token atop its logprob); fetch with
+    mixed_step_fetch. Row index == slot id (the page table is
+    slot-aligned); rows without queries this step carry garbage."""
+
+    toks_lp: jax.Array
+    rows: list
 
 
 class Engine:
@@ -353,6 +409,42 @@ class Engine:
                 cache = jax.device_put(cache, named(self.mesh, cache_specs))
             self.cache = cache
 
+        # Ragged mixed-step serving (ISSUE 12): one compiled program per
+        # engine step for any prefill/decode mix. Paged dense llama-family
+        # only — spec rounds keep their own loop, MoE keeps the bucketed
+        # paged path, pp/multimodal carry state the ragged program doesn't.
+        self.mixed_ok = (
+            self.paged and config.mixed_step and config.spec_draft is None
+            and not self.is_moe and not self.pp and config.vision_model is None
+        )
+        biggest_bucket = max((b for b in config.prefill_buckets
+                              if b <= config.max_seq_len), default=config.max_seq_len)
+        self.mixed_budget = config.mixed_step_tokens or (biggest_bucket + config.max_slots)
+        # Progress requires room for one prefill token past a full decode
+        # batch; pad a little so chunks aren't degenerate.
+        self.mixed_budget = max(self.mixed_budget, config.max_slots + 8)
+
+        # The dispatch verdict this engine's layouts take (ISSUE 12
+        # satellite): surfaced as the engine.attention_path gauge and a
+        # /debug/status field so a silently-degraded gather deployment
+        # is visible without reading XLA dumps.
+        if self.paged:
+            from inference_gateway_tpu.ops.paged_attention import (
+                FORCE_PAGED_KERNEL,
+                paged_dispatch,
+            )
+
+            mesh_tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+            self.attention_path, self.attention_path_reason = paged_dispatch(
+                self.model_cfg.num_kv_heads, self.model_cfg.num_heads,
+                self.model_cfg.num_kv_heads * self.model_cfg.hd, tp=mesh_tp,
+                platform=jax.devices()[0].platform,
+                n_devices=int(self.mesh.devices.size) if self.mesh is not None else 1,
+                force=FORCE_PAGED_KERNEL)
+        else:
+            self.attention_path = "dense"
+            self.attention_path_reason = "contiguous slot cache (paged attention not in use)"
+
         # Optional draft model for speculative decoding (config.spec_draft
         # names a llama-family preset/checkpoint sharing the target's
         # vocab). The draft keeps its own DENSE slot cache — it is small,
@@ -440,10 +532,16 @@ class Engine:
 
     # ------------------------------------------------------------------
     def bucket_for(self, length: int) -> int:
+        """Smallest prefill bucket covering ``length``. In the ragged
+        world this table is dispatch-only legacy (mixed steps pack exact
+        lengths); over-length prompts raise the structured
+        PromptTooLongError so the serving edge's ``prompt_too_long`` 400
+        shape holds even when the edge check is bypassed (ISSUE 12
+        satellite — this used to be a bare ValueError)."""
         for b in self.config.prefill_buckets:
             if length <= b and b <= self.config.max_seq_len:
                 return b
-        raise ValueError(f"prompt of {length} tokens exceeds largest bucket")
+        raise PromptTooLongError(length, self.max_prompt_len())
 
     def _next_rng(self) -> jax.Array:
         self._step_counter += 1
@@ -627,6 +725,117 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    def _mixed_step_fn(self, params, cache, tokens, positions, write_idx, page_table,
+                       q_starts, q_lens, kv_lens, temps, top_ps, seeds, use_seed, rng):
+        """One ragged MIXED step (ISSUE 12): prefill-chunk rows and
+        decode rows in a single launch over the paged cache. This is the
+        one compiled program that replaces the per-bucket
+        _prefill_fn_paged / _prefill_chunk_fn_paged / _decode_fn_paged
+        family on the mixed path — packed width is the fixed
+        mixed_budget, so admission never recompiles and never pays
+        bucket padding."""
+        logits, cache = self._model.forward_ragged(
+            params, self.model_cfg, tokens, positions, cache, write_idx,
+            page_table, q_starts, q_lens, kv_lens, mesh=self.mesh)
+        keys = per_row_keys(rng, seeds, use_seed, kv_lens)
+        toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
+        logprobs = compute_logprobs(logits, toks)
+        return toks, logprobs, cache
+
+    def mixed_step_submit(self, rows: "list[MixedRow]") -> "MixedStepHandle":
+        """Dispatch one ragged mixed step WITHOUT waiting (ISSUE 12).
+
+        Rows are packed back to back into the fixed mixed_budget query
+        axis (Σ len(token_ids) must fit it); each row's pages are
+        grown/evicted for its new span, the flat write indices and
+        (q_start, q_len, kv_len) descriptors are assembled host-side,
+        and ONE jitted program computes every row and samples one token
+        per row. The chained decode carry is invalidated — mixed steps
+        advance cache positions outside the chain, so the next fused
+        chunk must resubmit from host state (chain=False)."""
+        S = self.config.max_slots
+        T = self.mixed_budget
+        total = sum(len(r.token_ids) for r in rows)
+        assert rows and total <= T, (total, T)
+        tokens = np.zeros((1, T), np.int32)
+        positions = np.zeros((1, T), np.int32)
+        q_starts = np.zeros((S,), np.int32)
+        q_lens = np.zeros((S,), np.int32)
+        kv_lens = np.zeros((S,), np.int32)
+        temps = np.zeros((S,), np.float32)
+        top_ps = np.ones((S,), np.float32)
+        seeds = np.zeros((S,), np.int32)
+        use_seed = np.zeros((S,), bool)
+        with self._lock:
+            write_idx = np.full((1, T), self._flat_size, np.int64)
+            off = 0
+            n_prefill = 0
+            for r in rows:
+                n = len(r.token_ids)
+                end = r.start + n
+                self._ensure_with_evict(r.slot, end)
+                tokens[0, off:off + n] = r.token_ids
+                positions[0, off:off + n] = r.start + np.arange(n, dtype=np.int32)
+                write_idx[0, off:off + n] = self.allocator.flat_write_indices(
+                    r.slot, r.start, n)
+                q_starts[r.slot] = off
+                q_lens[r.slot] = n
+                kv_lens[r.slot] = end
+                temps[r.slot] = r.temp
+                top_ps[r.slot] = r.top_p
+                if r.seed is not None:
+                    seeds[r.slot] = int(r.seed)
+                    use_seed[r.slot] = True
+                off += n
+                if r.kind == "prefill":
+                    n_prefill += n
+            toks, logprobs, self.cache = self._mixed_step_fn(
+                self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(write_idx), jnp.asarray(self.allocator.page_table()),
+                jnp.asarray(q_starts), jnp.asarray(q_lens), jnp.asarray(kv_lens),
+                jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(seeds),
+                jnp.asarray(use_seed), self._next_rng(),
+            )
+            # Positions moved outside the chained-carry bookkeeping.
+            self._dev_carry = None
+            n_decode_tokens = total - n_prefill
+            if n_decode_tokens:
+                # Pure-prefill chunk steps (the long-prompt ragged loop)
+                # are NOT decode steps — counting them deflated decode
+                # tokens-per-step on mixed engines (review finding).
+                self.metrics["decode_steps"] += 1
+                self.metrics["decode_tokens"] += n_decode_tokens
+            self.metrics["prefill_tokens"] += n_prefill
+            both = jnp.stack([toks.astype(jnp.float32), logprobs])
+        return MixedStepHandle(both, list(rows))
+
+    def mixed_step_fetch(self, handle: "MixedStepHandle"):
+        """Block until a mixed step's sampled tokens are on host.
+        Returns (tokens, logprobs) as numpy (max_slots,), row == slot."""
+        both = np.asarray(handle.toks_lp)
+        return both[0].astype(np.int32), both[1]
+
+    def _prefill_one_ragged(self, prompt: list[int], slot: int, temp: float, top_p: float,
+                            seed: int | None = None) -> PrefillResult:
+        """Chunked ragged prefill for one long prompt on the PAGED cache
+        (ISSUE 12): chunks of the mixed-step budget attend the slot's
+        pages causally — paged engines previously had NO long-prompt
+        path at all (max_prompt_len capped at the largest bucket)."""
+        chunk = self.mixed_budget
+        toks = logprobs = None
+        for start in range(0, len(prompt), chunk):
+            piece = prompt[start:start + chunk]
+            h = self.mixed_step_submit([MixedRow(
+                slot=slot, token_ids=list(piece), start=start, kind="prefill",
+                temp=temp, top_p=top_p, seed=seed)])
+            toks, logprobs = self.mixed_step_fetch(h)
+        with self._lock:
+            self.metrics["prefill_batches"] += 1
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(prompt, self.allocator.pages_of(slot))
+        return PrefillResult(slot, int(toks[slot]), float(logprobs[slot]))
+
     # ------------------------------------------------------------------
     IMAGE_PLACEHOLDER_ID = 0
 
@@ -713,7 +922,12 @@ class Engine:
             short_idx = [i for i, p in enumerate(prompts) if len(p) <= biggest]
             for i, p in enumerate(prompts):
                 if len(p) > biggest:
-                    one = self._prefill_one_ring if ring_ok else self._prefill_one_chunked
+                    if ring_ok:
+                        one = self._prefill_one_ring
+                    elif self.paged:
+                        one = self._prefill_one_ragged  # mixed_ok gated long_path
+                    else:
+                        one = self._prefill_one_chunked
                     results.append((i, one(p, slots[i], temps[i], top_ps[i],
                         seed=None if seeds is None else seeds[i])))
             if short_idx:
@@ -1399,7 +1613,9 @@ class Engine:
             and not self.is_moe
             and self.model_cfg.sliding_window is None
         )
-        long_path = ring_ok or (not self.paged and not self.is_moe)
+        # Mixed-step paged engines chunk long prompts through the ragged
+        # program (ISSUE 12) — paged mode is no longer bucket-bounded.
+        long_path = ring_ok or (not self.paged and not self.is_moe) or self.mixed_ok
         return biggest, ring_ok, long_path
 
     def max_prompt_len(self, multimodal: bool = False) -> int:
@@ -1451,4 +1667,10 @@ class Engine:
         )
         self.prefill([[1, 2, 3]], [0], [0.0], [1.0])
         self.release_slot(0)
+        if self.mixed_ok:
+            # Compile THE mixed program (one static shape) so the first
+            # interleaved admission doesn't meet a cold trace.
+            self.mixed_step_fetch(self.mixed_step_submit([MixedRow(
+                slot=0, token_ids=[1, 2, 3], start=0, kind="prefill")]))
+            self.release_slot(0)
         return time.perf_counter() - t0

@@ -466,7 +466,10 @@ class Scheduler:
                 # that would wedge every queued and active request
                 # (advisor round-1 medium).
                 try:
-                    self._admit()
+                    if getattr(self.engine, "mixed_ok", False):
+                        self._admit_mixed()
+                    else:
+                        self._admit()
                 except Exception as e:
                     # _admit's internal paths fail the affected requests
                     # themselves; reaching here means bookkeeping OUTSIDE
@@ -815,6 +818,232 @@ class Scheduler:
                               work_tokens=sum(prompt_lens),
                               sq_tokens=sum(t * t for t in prompt_lens))
 
+    # -- ragged mixed-step admission (ISSUE 12) ------------------------
+    def _build_mixed_rows(self, pending: list) -> tuple[list, int, int, int, int]:
+        """Descriptor assembly for ONE mixed engine step: a decode row
+        per active slot (its pending token advances one position), then
+        prefill-chunk rows for the admitting requests, filling whatever
+        packed budget remains. Pure host bookkeeping — no device reads
+        (graftlint jax-hot-path pins this: a sync here would serialize
+        the step against the previous one's results).
+
+        Returns (rows, n_decode, prefill_tokens, context_tokens,
+        pair_tokens) — the latter two feed the mixed StepCostModel kind:
+        context is Σ kv length over all rows (the KV read stream), pairs
+        is Σ per-query attended span (the exact attention FLOPs term).
+        """
+        from inference_gateway_tpu.serving.engine import MixedRow
+
+        budget = self.engine.mixed_budget
+        rows: list = []
+        used = 0
+        context = 0
+        pairs = 0
+        for slot, st in self._slots.items():
+            if st.pending_token == _TOKEN_PENDING:
+                continue  # unresolved prefill future (handles were drained; defensive)
+            req = st.req
+            rows.append(MixedRow(
+                slot=slot, token_ids=[st.pending_token], start=st.pos, kind="decode",
+                temp=req.temperature, top_p=req.top_p, seed=req.seed))
+            used += 1
+            context += st.pos + 1
+            pairs += st.pos + 1
+        n_decode = len(rows)
+        for item in pending:
+            req, slot = item["req"], item["slot"]
+            done = item["done"]
+            remaining = len(req.prompt_ids) - done
+            take = min(remaining, budget - used)
+            if take <= 0:
+                continue
+            rows.append(MixedRow(
+                slot=slot, token_ids=req.prompt_ids[done:done + take], start=done,
+                kind="prefill", temp=req.temperature, top_p=req.top_p, seed=req.seed))
+            used += take
+            context += done + take
+            # Query i of the chunk attends done + i + 1 keys.
+            pairs += take * done + take * (take + 1) // 2
+            item["done"] = done + take
+        return rows, n_decode, used - n_decode, context, pairs
+
+    def _fail_mixed_admission(self, pending: list, e: Exception) -> None:
+        """Unrecoverable mixed-step failure: fail the admitting requests
+        cleanly, then attribute the step failure to the active batch as
+        usual — but an error tagged to an ADMITTING slot was just failed
+        here and must not nuke the active batch too."""
+        admitting_slots = {it["slot"] for it in pending}
+        for item in pending:
+            self._fail_request(item["req"])
+            self._release_guarded(item["slot"], "error")
+        tag = getattr(e, "slot", None)
+        if tag is None or tag not in admitting_slots:
+            self._fail_after_decode_error(e)
+        else:
+            self.logger.warn("mixed admission failed", "slot", tag, "err", repr(e))
+
+    def _admit_mixed(self) -> None:
+        """Mixed-step admission (ISSUE 12 tentpole): the admitted
+        prompts prefill in ragged CHUNKS that share each engine step
+        with a decode row per active slot — a long prompt no longer
+        serializes ahead of interactive streams (no prefill head-of-line
+        blocking), and every step is ONE launch of the one compiled
+        mixed program (no bucket padding).
+
+        Runs synchronously on the scheduler thread: the chunk loop is
+        bounded by ceil(Σ prompt / free budget) steps, decode tokens
+        stream out at every step, and when the last chunk of a prompt
+        lands its sampled first token the request becomes a regular
+        active slot. The fused-chunk pipeline resumes afterwards
+        (chain=False — mixed steps invalidate the device carry).
+        Requests the ragged program can't serve (multimodal embedding
+        overrides) fall back to the bucketed admission path wholesale.
+        """
+        batch: list[GenRequest] = []
+        slots: list[int] = []
+        multimodal_head = False
+        with self._wake:
+            # The embeds check happens under the SAME lock as the pop —
+            # a multimodal request enqueued between a peek and the pop
+            # must never slip into the ragged path (forward_ragged
+            # carries no embedding overrides; serving it from token ids
+            # would be plausible wrong output).
+            while self._waiting and self._free and len(batch) < self.engine.config.max_prefill_batch:
+                if self._waiting[0].embeds is not None:
+                    multimodal_head = True
+                    break
+                req = self._waiting.popleft()
+                batch.append(req)
+                slots.append(self._free.pop())
+            self.queue_depth = len(self._waiting)
+        if not batch:
+            if multimodal_head:
+                return self._admit()  # bucketed path carries the embeds
+            return
+        admit_ns = time.time_ns()
+        for req in batch:
+            req.phase_ns.setdefault("admit", admit_ns)
+        limit = self.engine.max_prompt_len()
+        # Registered BEFORE any blocking engine work (the drain below can
+        # wedge on a dead device): abort_all must find the popped batch
+        # in _admitting or _slots — a missed one hangs the client (same
+        # contract as bucketed _admit).
+        self._admitting = batch
+        # Host state must be authoritative before positions move under
+        # the pipeline's feet — and the carry is about to be invalidated.
+        self._drain_all()
+        pending = [{"req": r, "slot": s, "done": 0} for r, s in zip(batch, slots)]
+        if self.engine.prefix_cache is not None:
+            # Prefix-cache fast path, same as bucketed admission: adopt
+            # the longest cached page-aligned prefix and chunk-prefill
+            # only the tail (match always leaves ≥1 token to compute).
+            with self.engine._lock:
+                for item in pending:
+                    shared, matched = self.engine.prefix_cache.match(
+                        item["req"].prompt_ids)
+                    if shared:
+                        self.engine.allocator.adopt_pages(item["slot"], shared)
+                        item["done"] = matched
+        try:
+            while pending:
+                kept = []
+                for item in pending:
+                    req = item["req"]
+                    if req.disconnected:
+                        self._release_guarded(item["slot"], "disconnected")
+                        self._fail_request(req)
+                    elif len(req.prompt_ids) > limit:
+                        self._release_guarded(item["slot"], "error")
+                        self._fail_request(req)
+                    else:
+                        kept.append(item)
+                pending = kept
+                if not pending:
+                    break
+                observing = self._observing
+                t0 = time.perf_counter() if observing else 0.0
+                states = dict(self._slots)  # identity snapshot at build time
+                rows, n_decode, n_prefill, context, pairs = self._build_mixed_rows(pending)
+                try:
+                    handle = self.engine.mixed_step_submit(rows)
+                    toks, logprobs = self.engine.mixed_step_fetch(handle)
+                except OutOfPagesError as e:
+                    if (self.preempt_max and getattr(e, "recoverable", True)
+                            and self._slots):
+                        # Same ISSUE 7 semantics as bucketed admission:
+                        # transient pressure REQUEUES the still-admitting
+                        # requests (head of queue, page-wait latch) — and
+                        # when the starved span belongs to an ACTIVE
+                        # decode row, the preemption path may deschedule
+                        # the youngest instead of failing anyone.
+                        self._requeue_admission(
+                            [it["req"] for it in pending],
+                            [it["slot"] for it in pending])
+                        tag = getattr(e, "slot", None)
+                        pending = []
+                        if tag is not None and tag in self._slots:
+                            self._fail_after_decode_error(e)
+                        return
+                    self._fail_mixed_admission(pending, e)
+                    pending = []
+                    return
+                except Exception as e:
+                    self._fail_mixed_admission(pending, e)
+                    pending = []
+                    return
+                self.last_step_time = self.clock.now()
+                self.steps_completed += 1
+                emitted = 0
+                # Decode rows advance exactly one token, same emission
+                # contract as one step of a fused chunk.
+                for row in rows[:n_decode]:
+                    st = self._slots.get(row.slot)
+                    if st is None or st is not states.get(row.slot):
+                        continue  # released mid-step (defensive identity check)
+                    st.pos += 1
+                    st.pending_token = int(toks[row.slot])
+                    st.pending_logprob = float(logprobs[row.slot])
+                    st.generated += 1
+                    emitted += 1
+                    finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
+                    if finished:
+                        del self._slots[row.slot]
+                        self._release_guarded(row.slot, reason)
+                    self._flush_emits(st.req)
+                # Prefill rows whose final chunk just landed become
+                # active slots with their sampled first token.
+                done_items = [it for it in pending
+                              if it["done"] >= len(it["req"].prompt_ids)]
+                pending = [it for it in pending
+                           if it["done"] < len(it["req"].prompt_ids)]
+                for item in done_items:
+                    req, slot = item["req"], item["slot"]
+                    self.engine.metrics["prefill_batches"] += 1
+                    st = _SlotState(
+                        req, pos=len(req.prompt_ids),
+                        pending_token=int(toks[slot]),
+                        pending_logprob=float(logprobs[slot]),
+                        generated=req.resume_generated + 1,
+                        seq=next(self._admit_seq))
+                    self._slots[slot] = st
+                    if self.engine.prefix_cache is not None:
+                        with self.engine._lock:
+                            self.engine.prefix_cache.insert(
+                                req.prompt_ids, self.engine.allocator.pages_of(slot))
+                    emitted += 1
+                    finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
+                    if finished:
+                        del self._slots[slot]
+                        self._release_guarded(slot, reason)
+                    self._flush_emits(req)
+                if observing:
+                    self._record_step(
+                        "mixed", t0, n_steps=1, batch=len(rows),
+                        tokens=emitted, work_tokens=n_decode + n_prefill,
+                        context_tokens=context, pair_tokens=pairs)
+        finally:
+            self._admitting = []
+
     def _submit_chunk(self, chain: bool) -> "_Inflight | None":
         """Dispatch one fused decode chunk without waiting for it.
 
@@ -1036,7 +1265,7 @@ class Scheduler:
 
     def _record_step(self, kind: str, t0: float, *, n_steps: int, batch: int,
                      tokens: int, work_tokens: int = 0, context_tokens: int = 0,
-                     sq_tokens: int = 0) -> None:
+                     sq_tokens: int = 0, pair_tokens: int = 0) -> None:
         """One decode-timeline record (ISSUE 4): duration covers fetch +
         host-side emission — the full per-step cost a request observes.
         kv_utilization/queue_depth reads are GIL-atomic, lock-free. With
@@ -1062,7 +1291,7 @@ class Scheduler:
                 cost = self.accounting.on_step(
                     kind, duration, batch=batch, n_steps=n_steps, tokens=tokens,
                     work_tokens=work_tokens, context_tokens=context_tokens,
-                    sq_tokens=sq_tokens)
+                    sq_tokens=sq_tokens, pair_tokens=pair_tokens)
             if self.timeline is not None:
                 self.timeline.record(
                     kind, duration, n_steps=n_steps, batch=batch,

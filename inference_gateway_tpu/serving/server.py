@@ -278,6 +278,11 @@ class SidecarServer:
             # is indistinguishable from a non-reporting replica, and
             # alerts key on 0 → 1 (code-review finding).
             self.otel.set_engine_degraded(self.model_name, 0)
+            # Dispatch verdict from boot too (ISSUE 12 satellite): a
+            # silently-degraded gather deployment must be a gauge read,
+            # not an XLA-dump archaeology session.
+            self.otel.set_attention_path(
+                self.model_name, getattr(self.engine, "attention_path", "unknown"))
         bound = await self.http.start(host, port)
         if self.metrics_push_url or (self.tracer.enabled and self.tracer.otlp_endpoint):
             self._push_task = asyncio.create_task(self._metrics_push_loop())
@@ -488,6 +493,8 @@ class SidecarServer:
         if self.otel is not None:
             self.otel.set_engine_degraded(self.model_name, 0)
             self.otel.record_engine_restart(self.model_name, reason)
+            self.otel.set_attention_path(
+                self.model_name, getattr(new_engine, "attention_path", "unknown"))
         self.logger.info("engine restart complete", "reason", reason,
                          "restarts", self.restarts)
         return info
@@ -803,6 +810,14 @@ class SidecarServer:
             "preemptions": self.scheduler.preemptions,
             "engine_restarts": self.restarts,
             "streams_migrated_out": self.migrated_out,
+            # The paged-attention dispatch verdict (ISSUE 12 satellite):
+            # which path this engine's layouts take and why — "gather"
+            # here means the ~10.6×-slower fallback is live.
+            "attention_path": {
+                "path": getattr(self.engine, "attention_path", "unknown"),
+                "reason": getattr(self.engine, "attention_path_reason", ""),
+                "mixed_step": getattr(self.engine, "mixed_ok", False),
+            },
         }
         if self.last_restart is not None:
             status["last_restart"] = self.last_restart
@@ -1422,6 +1437,15 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
     svcfg = ServingConfig.load(os.environ)
     scfg = ServerConfig.load(os.environ)
     logger = new_logger()
+    # Ragged mixed-step serving (ISSUE 12): on by default for the
+    # standalone sidecar wherever the engine supports it (paged,
+    # non-speculative — Engine.mixed_ok gates the rest). The scheduler
+    # then interleaves chunked prefill with decode in the same engine
+    # step, and paged engines admit prompts up to the context window.
+    if svcfg.mixed_step_enable and config.attention == "paged":
+        config.mixed_step = True
+        if svcfg.mixed_step_tokens:
+            config.mixed_step_tokens = svcfg.mixed_step_tokens
     engine = Engine(config)
     warm = engine.warmup()
     logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)

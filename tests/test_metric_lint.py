@@ -139,6 +139,24 @@ def test_fault_tolerance_instruments_registered_with_expected_shapes():
     assert degraded.label_names == ("gen_ai_request_model",)
 
 
+def test_attention_path_instrument_registered_with_expected_shape():
+    """ISSUE 12: the dispatch-verdict gauge — a silently-degraded gather
+    deployment must be an alertable series, and set_attention_path must
+    write an explicit 0 for every inactive path (absent ≠ healthy)."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    gauge = by_name["engine.attention_path"]
+    assert isinstance(gauge, Gauge)
+    assert gauge.label_names == ("gen_ai_request_model", "path")
+    otel.set_attention_path("m", "kernel")
+    vals = gauge.values()
+    assert vals[("m", "kernel")] == 1
+    for p in ("kernel_sharded", "kernel_replicated", "gather", "dense"):
+        assert vals[("m", p)] == 0
+    otel.remove_engine_gauges("m")
+    assert not gauge.values()
+
+
 def test_probe_instruments_registered_with_expected_shapes():
     """ISSUE 9: the active-probing surface must expose exactly the
     advertised names — the e2e acceptance and dashboards key on them."""

@@ -42,22 +42,26 @@ def test_committed_profiles_dispatch_to_kernel(name):
                     f"gather fallback ({reason}); add tp or an exemption")
 
 
-def test_gather_conditions_reported():
-    """The documented fallback conditions are the ones the function
-    enforces (misaligned folded axis, non-divisible heads, tp=1
-    multi-device, non-TPU platform)."""
+def test_gather_matrix_closed_every_tpu_layout_takes_a_kernel():
+    """ISSUE 12: the old fallback matrix (misaligned folded axis,
+    non-divisible heads, tp=1 multi-device) now dispatches to a kernel
+    path; gather remains ONLY for non-TPU platforms and the explicit
+    kill switch."""
     # tinyllama-like: Hkv*D = 256, aligned → kernel single-chip.
     assert paged_dispatch(4, 32, 256)[0] == "kernel"
-    # Misaligned folded axis (Hkv*D = 192).
-    assert paged_dispatch(3, 24, 192)[0] == "gather"
-    # Multi-device mesh with tp=1 always gathers.
-    assert paged_dispatch(8, 32, 1024, tp=1, n_devices=8)[0] == "gather"
-    # kv heads not divisible by tp.
-    assert paged_dispatch(6, 24, 768, tp=4, n_devices=4)[0] == "gather"
-    # per-shard folded axis off the lane grid: 8 heads * 80 dim / 8 = 80.
-    assert paged_dispatch(8, 32, 640, tp=8, n_devices=8)[0] == "gather"
-    # CPU platform never takes the kernel without the force flag.
-    assert paged_dispatch(8, 32, 1024, platform="cpu")[0] == "gather"
+    # Misaligned folded axis (Hkv*D = 192): lane-padded scratch.
+    path, reason = paged_dispatch(3, 24, 192)
+    assert path == "kernel" and "lane-padded" in reason
+    # Multi-device mesh with tp=1: replicated shard_map launch.
+    assert paged_dispatch(8, 32, 1024, tp=1, n_devices=8)[0] == "kernel_replicated"
+    # kv heads not divisible by tp: replicated too.
+    assert paged_dispatch(6, 24, 768, tp=4, n_devices=4)[0] == "kernel_replicated"
+    # per-shard folded axis off the lane grid: padded scratch per shard.
+    assert paged_dispatch(8, 32, 640, tp=8, n_devices=8)[0] == "kernel_sharded"
+    # CPU platform takes the ragged pure-JAX reference (the ONLY
+    # remaining organic gather layout).
+    path, reason = paged_dispatch(8, 32, 1024, platform="cpu")
+    assert path == "gather" and "ragged reference" in reason
     # Proper tp-sharded flagship layout rides the shard_mapped kernel.
     assert paged_dispatch(8, 32, 1024, tp=8, n_devices=8)[0] == "kernel_sharded"
 
@@ -66,9 +70,11 @@ def test_force_flag_precedence():
     assert paged_dispatch(4, 32, 192, force="1")[0] == "kernel"
     assert paged_dispatch(4, 32, 256, force="0")[0] == "gather"
     assert paged_dispatch(8, 32, 1024, tp=8, force="1")[0] == "kernel_sharded"
-    # Forced on but heads not shardable: falls back rather than crashing
-    # inside shard_map.
-    assert paged_dispatch(6, 24, 768, tp=4, force="1")[0] == "gather"
+    # Forced on with non-shardable heads: replicated launch, not a crash
+    # inside shard_map (and not the gather fallback anymore).
+    assert paged_dispatch(6, 24, 768, tp=4, force="1")[0] == "kernel_replicated"
+    # Force=1 wins over platform (interpret mode off-TPU — CPU tests).
+    assert paged_dispatch(4, 32, 256, platform="cpu", force="1")[0] == "kernel"
 
 
 def test_dispatch_matches_live_path_on_cpu():

@@ -394,6 +394,54 @@ def test_jax_hot_path_fetch_functions_are_designated_sync_points():
                 select="jax-hot-path") == []
 
 
+def test_jax_hot_path_covers_mixed_descriptor_assembly():
+    """ISSUE 12: the ragged descriptor-build path is submit-scope —
+    materializing a device value while assembling (start, length, kind)
+    rows serializes the mixed step against the previous step's results."""
+    bad = """
+    import numpy as np
+
+    class Scheduler:
+        def _build_mixed_rows(self, pending):
+            rows = []
+            for slot, st in self._slots.items():
+                tok = np.asarray(st.pending_dev)  # materializes = waits
+                rows.append((slot, int(tok)))
+            return rows
+    """
+    findings = lint(bad, path="inference_gateway_tpu/serving/scheduler.py",
+                    select="jax-hot-path")
+    assert len(findings) == 1 and "np.asarray" in findings[0].message
+
+    bad_engine = """
+    class Engine:
+        def mixed_step_submit(self, rows):
+            total = sum(len(r.token_ids) for r in rows)
+            scale = self.cache_norm.item()  # host sync in a submit fn
+            return total * scale
+    """
+    findings = lint(bad_engine, path="inference_gateway_tpu/serving/engine.py",
+                    select="jax-hot-path")
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+    good = """
+    import numpy as np
+
+    class Scheduler:
+        def _build_mixed_rows(self, pending):
+            rows = []
+            for slot, st in self._slots.items():
+                rows.append((slot, [st.pending_token], st.pos))
+            return rows
+
+    class Engine:
+        def mixed_step_fetch(self, handle):
+            return np.asarray(handle.toks_lp)  # designated sync point
+    """
+    assert lint(good, path="inference_gateway_tpu/serving/scheduler.py",
+                select="jax-hot-path") == []
+
+
 # ----------------------------------------------------------------------
 # telemetry-noop-drift
 # ----------------------------------------------------------------------
