@@ -35,7 +35,7 @@ SLOW_TESTS := test_checkpoint test_chunked_prefill test_distributed \
   test_profiles test_quant test_qwen2 test_race_discipline \
   test_ring_attention test_ring_serving test_sampling_features \
   test_scheduler_resilience test_sharding test_sidecar_server \
-  test_spec_ngram test_speculative test_vision
+  test_spec_ngram test_speculative test_structured_e2e test_vision
 
 test-fast: ## gateway/protocol tier only (~2 min) — no engine builds
 	python -m pytest tests/ -q $(foreach t,$(SLOW_TESTS),--ignore=tests/$(t).py)

@@ -758,6 +758,76 @@ async def bench_preemption_overhead(n: int = 60, max_tokens: int = 24) -> dict:
     }
 
 
+async def bench_structured_overhead(n: int = 40, max_tokens: int = 48) -> dict:
+    """Constrained vs unconstrained decode cost through the real sidecar
+    (ISSUE 13): per-token latency (TPOT proxy: stream wall time /
+    tokens) for plain streams vs response_format json_schema streams on
+    the SAME engine, steady state (the one-time masked-program recompile
+    and the cold schema compile are excluded by warmup). The mask gather
+    + packed-bit unpack + state advance ride inside the fused chunk —
+    the gate is <10% p99 TPOT delta (slow-marked in
+    tests/test_structured_e2e.py)."""
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=256,
+                                 dtype="float32", max_prefill_batch=2,
+                                 use_mesh=False))
+    sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                            accounting_enable=False)
+    port = await sidecar.start("127.0.0.1", 0)
+    client = HTTPClient()
+    schema = {"type": "object",
+              "properties": {"name": {"type": "string", "maxLength": 24},
+                             "score": {"type": "integer"},
+                             "tags": {"type": "array",
+                                      "items": {"enum": ["a", "b", "c"]},
+                                      "maxItems": 4}},
+              "required": ["name", "score", "tags"]}
+
+    def body(constrained: bool) -> bytes:
+        req = {"model": "test-tiny", "stream": True, "max_tokens": max_tokens,
+               "temperature": 0.8, "seed": 7,
+               "messages": [{"role": "user", "content": "structured probe"}]}
+        if constrained:
+            req["response_format"] = {
+                "type": "json_schema",
+                "json_schema": {"name": "probe", "schema": schema}}
+        return json.dumps(req).encode()
+
+    async def one(payload: bytes) -> float:
+        """Wall time per streamed content frame (TPOT proxy)."""
+        frames = 0
+        t0 = time.perf_counter()
+        resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                 payload, stream=True)
+        async for block in resp.iter_raw():
+            frames += block.count(b"data: ")
+        return (time.perf_counter() - t0) / max(frames, 1)
+
+    # Warmup both variants: compiles the masked step programs + the
+    # schema artifact so steady state is what's measured.
+    for _ in range(4):
+        await one(body(False))
+        await one(body(True))
+    off = sorted([await one(body(False)) for _ in range(n)])
+    on = sorted([await one(body(True)) for _ in range(n)])
+    await sidecar.shutdown()
+
+    def p(lats: list[float], q: float) -> float:
+        return round(lats[min(len(lats) - 1, int(len(lats) * q))] * 1000, 4)
+
+    delta = round(p(on, 0.99) - p(off, 0.99), 4)
+    return {
+        "bench": "structured_overhead",
+        "tpot_p50_off_ms": p(off, 0.50), "tpot_p50_on_ms": p(on, 0.50),
+        "tpot_p99_off_ms": p(off, 0.99), "tpot_p99_on_ms": p(on, 0.99),
+        "tpot_p99_delta_ms": delta,
+        "tpot_p99_delta_pct": round(delta / p(off, 0.99) * 100, 2) if p(off, 0.99) else None,
+        "ops": n,
+    }
+
+
 async def bench_affinity_routing(requests: int = 12, max_tokens: int = 8,
                                  chaos_tokens: int = 48) -> dict:
     """Fleet prefix-affinity routing (ISSUE 11): TTFT and prefix-cache
@@ -952,6 +1022,7 @@ async def main() -> None:
         await bench_compute_efficiency(),
         await bench_accounting_overhead(),
         await bench_preemption_overhead(),
+        await bench_structured_overhead(),
         await bench_affinity_routing(),
     ]
     for r in results:

@@ -42,9 +42,21 @@ CHECKER = "jax-hot-path"
 SUBMIT_SCOPES = {
     "serving/engine.py": {
         "decode_chunk_submit", "_scatter_admission", "mixed_step_submit",
+        # Structured-outputs admission hooks (ISSUE 13) ride the same
+        # dispatch path: registering a grammar span must scatter tables
+        # asynchronously, never materialize a device value.
+        "structured_register",
     },
     "serving/scheduler.py": {
         "_submit_chunk", "run", "_process_handles", "_build_mixed_rows",
+    },
+    # The mask scatter/upload path (ISSUE 13): grammar spans and
+    # logit-bias rows are loaded into the device tables between steps —
+    # a host sync here serializes the chunk pipeline against the load,
+    # and mask ADVANCEMENT must never host-sync mid-chunk at all (it
+    # lives inside the jitted scan, covered by the jit scope).
+    "structured/runtime.py": {
+        "acquire", "register_slot", "release_slot", "_ensure_live",
     },
 }
 

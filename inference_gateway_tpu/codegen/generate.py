@@ -234,6 +234,28 @@ _OVERLOAD_DRAIN_DOC = [
 ]
 
 
+# Emitted under the Structured section of Configurations.md: the
+# grammar-constrained decoding subsystem (ISSUE 13) in one paragraph.
+_STRUCTURED_DOC = [
+    "### Structured outputs",
+    "",
+    "`response_format` `json_object`/`json_schema` requests against the TPU",
+    "sidecar compile the schema into a byte-level grammar and then into a",
+    "token-mask automaton over the actual tokenizer vocabulary. The",
+    "automaton's transition and packed-mask tables live in device memory, so",
+    "constrained rows ride the same fused multi-step decode chunks, mixed",
+    "steps, and speculative rounds as unconstrained traffic — each step",
+    "applies the mask as an additive −inf bias before top-k/top-p and",
+    "advances the state on device (no host sync mid-chunk). Compiled",
+    "artifacts are cached by schema hash; uncompilable schemas fast-fail a",
+    "structured 400 `code:unsupported_schema`. `logit_bias` rides the same",
+    "additive-bias buffer. Supported schema subset, failure modes, and",
+    "composition with speculation/continuation:",
+    "[docs/structured-decoding.md](docs/structured-decoding.md).",
+    "",
+]
+
+
 def generate_configurations_md(spec: dict) -> str:
     out = [
         "# Configurations",
@@ -257,6 +279,8 @@ def generate_configurations_md(spec: dict) -> str:
             out.extend(_SERVING_DATA_PLANE_DOC)
             out.extend(_SERVING_RAGGED_DOC)
             out.extend(_SERVING_FAULT_TOLERANCE_DOC)
+        elif section == "structured":
+            out.extend(_STRUCTURED_DOC)
         elif section == "routing":
             out.extend(_ROUTING_FLEET_DOC)
         elif section == "resilience":
@@ -478,6 +502,10 @@ def check_config_defaults(spec: dict) -> list[str]:
         "SERVING_ADMIN_ENABLED": cfg.serving.admin_enabled,
         "SERVING_MIXED_STEP_ENABLE": cfg.serving.mixed_step_enable,
         "SERVING_MIXED_STEP_TOKENS": cfg.serving.mixed_step_tokens,
+        "STRUCTURED_ENABLE": cfg.structured.enable,
+        "STRUCTURED_CACHE_SIZE": cfg.structured.cache_size,
+        "STRUCTURED_MAX_SCHEMA_BYTES": cfg.structured.max_schema_bytes,
+        "STRUCTURED_MAX_STATES": cfg.structured.max_states,
         # Read at import by ops/paged_attention (FORCE_PAGED_KERNEL),
         # not through a Config dataclass — listed so the dispatch force
         # flag appears in Configurations.md/.env.example without this

@@ -421,6 +421,32 @@ class ServingConfig:
 
 
 @dataclass
+class StructuredConfig:
+    """STRUCTURED_* — grammar-constrained decoding (ISSUE 13): the TPU
+    sidecar's structured-outputs subsystem (response_format json_object /
+    json_schema lowered onto device-resident token-mask automaton tables,
+    plus the logit_bias additive-bias buffer). ``max_states`` is the
+    shared device-table budget in automaton states — transition-table
+    memory is max_states x vocab x 4 bytes, so size it consciously for
+    100k-token vocabularies; the tables only materialize on the first
+    constrained request."""
+
+    enable: bool = True
+    cache_size: int = 64
+    max_schema_bytes: int = 65536
+    max_states: int = 4096
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "STRUCTURED_") -> "StructuredConfig":
+        return cls(
+            enable=_get_bool(env, prefix + "ENABLE", True),
+            cache_size=_get_int(env, prefix + "CACHE_SIZE", 64),
+            max_schema_bytes=_get_int(env, prefix + "MAX_SCHEMA_BYTES", 65536),
+            max_states=_get_int(env, prefix + "MAX_STATES", 4096),
+        )
+
+
+@dataclass
 class RoutingConfig:
     """ROUTING_* (config.go:98-101), plus the fleet-router surface
     (ISSUE 11): prefix-affinity consistent-hash routing over pool
@@ -467,6 +493,7 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    structured: StructuredConfig = field(default_factory=StructuredConfig)
     providers: dict[str, ProviderConfig] = field(default_factory=dict)
 
     @classmethod
@@ -491,6 +518,7 @@ class Config:
             resilience=ResilienceConfig.load(env),
             overload=OverloadConfig.load(env),
             serving=ServingConfig.load(env),
+            structured=StructuredConfig.load(env),
         )
         if not env.get("RESILIENCE_REQUEST_BUDGET"):
             # Follow the operator's upstream timeout unless the budget is

@@ -13,6 +13,28 @@ import jax.numpy as jnp
 
 GREEDY_EPS = 1e-4
 
+# Additive bias for grammar-masked vocabulary entries (ISSUE 13): large
+# enough that softmax assigns masked tokens exactly zero probability in
+# fp32, finite so a defensively all-masked row (a dead automaton state
+# decoded past a finish inside a fused chunk — the host discards those
+# tokens) degrades to argmax of the raw logits instead of NaN.
+MASK_NEG = -1e30
+
+
+def packed_mask_bias(bits: jnp.ndarray, vocab_size: int) -> jnp.ndarray:
+    """Expand packed V-bit allowed-token rows into an additive bias.
+
+    bits (..., W) uint32 — bit v lives at word v // 32, position v % 32
+    (structured/automaton.pack_mask). Returns (..., V) float32: 0 where
+    the token is allowed, MASK_NEG where the grammar forbids it. Applied
+    to logits BEFORE top-k/top-p so constrained rows keep exact nucleus
+    semantics over the allowed set.
+    """
+    v = jnp.arange(vocab_size)
+    words = jnp.take(bits, v // 32, axis=-1)
+    allowed = (words >> (v % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return jnp.where(allowed.astype(bool), 0.0, MASK_NEG)
+
 
 def per_row_keys(
     rng: jax.Array,
@@ -92,7 +114,12 @@ def sample_tokens_pregumbel(
     top_k: int,
 ) -> jnp.ndarray:
     """sample_tokens' top-k fast path with the RNG hoisted out: only
-    top_k + nucleus filter + argmax remain in the decode loop."""
+    top_k + nucleus filter + argmax remain in the decode loop.
+
+    Grammar masks and logit_bias (ISSUE 13) are additive-bias terms the
+    engine folds into ``logits`` BEFORE this call (packed_mask_bias) —
+    one application path, shared by greedy argmax, the filter, and the
+    logprob computation."""
     logits = logits.astype(jnp.float32)
     greedy_tok = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, GREEDY_EPS)[:, None]
@@ -110,7 +137,12 @@ def sample_tokens(
     top_k: int = 0,  # static; 0 = disabled
     row_keys: jnp.ndarray | None = None,  # (B, 2) per-row keys override rng
 ) -> jnp.ndarray:
-    """Sample one token per row; temperature <= GREEDY_EPS means argmax."""
+    """Sample one token per row; temperature <= GREEDY_EPS means argmax.
+
+    Grammar-constrained rows (ISSUE 13) arrive with packed_mask_bias
+    (and any logit_bias row) already ADDED to ``logits`` — the additive
+    −inf bias lands before the greedy argmax and the top-k/top-p filter,
+    so constrained and unconstrained rows coexist in one batch."""
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
     greedy_tok = jnp.argmax(logits, axis=-1)

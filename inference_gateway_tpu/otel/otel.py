@@ -35,6 +35,11 @@ EVENTLOOP_LAG_BOUNDARIES = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1
 # of decode steps lands in the tens-of-ms band.
 ENGINE_STEP_BOUNDARIES = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                           0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# Schema→token-mask compile times (ISSUE 13): a cache hit is ~0; cold
+# compiles run milliseconds for small schemas up to ~1s for deep
+# generic-JSON grammars over large vocabularies.
+SCHEMA_COMPILE_BOUNDARIES = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                             0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 # Compute-efficiency gauges (ISSUE 6) refresh only while the engine
 # steps; a TTL lets an idle engine's window values age out of the
 # exposition instead of freezing at the last busy reading. Must exceed
@@ -331,6 +336,30 @@ class OpenTelemetry:
             ("gen_ai_provider_name", "gen_ai_request_model", "signal"),
             ttl=EFFICIENCY_GAUGE_TTL,
         )
+        # Structured outputs (ISSUE 13): constrained-request outcomes,
+        # schema→token-mask compile cost, and mask-cache effectiveness
+        # (shared schemas should hit like prompt prefixes hit the
+        # PrefixCache — a cold-compile-per-request deployment is a
+        # misconfiguration this counter makes visible).
+        self.constrained_requests_counter = r.counter(
+            "engine.constrained_requests",
+            "Grammar-constrained (response_format) requests served, by "
+            "finish outcome (stop = grammar/EOS completed the document; "
+            "length/error/disconnected = truncated or failed)",
+            ("gen_ai_request_model", "outcome"), unit="{request}",
+        )
+        self.schema_compile_duration = r.histogram(
+            "engine.schema_compile.duration",
+            "JSON Schema -> token-mask automaton compile time (cold "
+            "compiles only; cache hits record on the mask-cache counter)",
+            ("gen_ai_request_model",), SCHEMA_COMPILE_BOUNDARIES, unit="s",
+        )
+        self.mask_cache_counter = r.counter(
+            "engine.mask_cache.lookups",
+            "Compiled-grammar cache lookups by result (hit/miss) — shared "
+            "schemas repeat across requests like prompt prefixes",
+            ("gen_ai_request_model", "result"), unit="{lookup}",
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -560,6 +589,22 @@ class OpenTelemetry:
         self.deployment_load_gauge.set(value, {
             "gen_ai_provider_name": provider, "gen_ai_request_model": model,
             "signal": signal})
+
+    # -- structured outputs (ISSUE 13) -----------------------------------
+    def record_constrained_request(self, model: str, outcome: str) -> None:
+        self.constrained_requests_counter.add(1, {
+            "gen_ai_request_model": model, "outcome": outcome})
+
+    def record_schema_compile(self, model: str, seconds: float,
+                              cache_hit: bool) -> None:
+        """One response_format compile: cache hits count on the lookup
+        counter only (a hit's ~0s would drown the compile histogram)."""
+        self.mask_cache_counter.add(1, {
+            "gen_ai_request_model": model,
+            "result": "hit" if cache_hit else "miss"})
+        if not cache_hit:
+            self.schema_compile_duration.record(
+                seconds, {"gen_ai_request_model": model})
 
     def remove_efficiency_gauges(self, model: str) -> None:
         """Engine teardown: the accounting gauges describe a gone engine
@@ -836,4 +881,10 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def set_deployment_load(self, *a, **k) -> None:
+        pass
+
+    def record_constrained_request(self, *a, **k) -> None:
+        pass
+
+    def record_schema_compile(self, *a, **k) -> None:
         pass

@@ -31,6 +31,7 @@ from inference_gateway_tpu.ops.sampling import (
     chunk_row_keys,
     effective_top_k,
     compute_logprobs,
+    packed_mask_bias,
     per_row_keys,
     sample_tokens,
     sample_tokens_pregumbel,
@@ -124,6 +125,17 @@ class EngineConfig:
     # prefill bucket + max_slots, floored at max_slots + 8).
     mixed_step: bool = False
     mixed_step_tokens: int = 0
+    # Structured outputs (ISSUE 13): grammar-constrained decoding via
+    # device-resident token-mask automaton tables. structured_states is
+    # the shared table budget in automaton states — device memory is
+    # budget x vocab x 4 bytes for the transition table (size it
+    # consciously for 100k-vocab models); the tables only materialize on
+    # the first constrained (or logit_bias) request, and until then the
+    # engine's compiled programs are bit-identical to structured=False.
+    structured: bool = True
+    structured_states: int = 4096
+    structured_cache: int = 64
+    structured_max_schema_bytes: int = 65536
 
 
 class PromptTooLongError(ValueError):
@@ -188,6 +200,9 @@ class MixedRow:
     temp: float = 0.0
     top_p: float = 1.0
     seed: int | None = None
+    # Grammar-constrained rows (ISSUE 13): the slot's GLOBAL automaton
+    # state in the device mask tables; 0 = the free (unconstrained) row.
+    mask_state: int = 0
 
 
 @dataclass
@@ -498,11 +513,32 @@ class Engine:
         self._step_counter = 0
         self._lock = threading.Lock()
         # Device-resident chained decode state (decode_chunk_submit):
-        # (pending token, position) carry from the last chunk, plus the
-        # uploaded sampling params. Any prefill invalidates the carry —
-        # newly admitted slots' tokens exist only on the host.
+        # (pending token, position, grammar mask state) carry from the
+        # last chunk, plus the uploaded sampling params. Any prefill
+        # invalidates the carry — newly admitted slots' tokens exist
+        # only on the host.
         self._dev_carry = None
         self._dev_sampling = None
+        # Structured outputs (ISSUE 13): grammar mask tables + logit-bias
+        # rows. Construction is lazy-cheap; device buffers materialize on
+        # the first constrained/biased admission (StructuredRuntime.live
+        # flips sticky-True and every step program recompiles ONCE with
+        # the mask gather fused in).
+        self.structured = None
+        if config.structured and config.structured_states > 1:
+            from inference_gateway_tpu.structured.runtime import StructuredRuntime
+
+            self.structured = StructuredRuntime(
+                self.tokenizer, self.model_cfg.vocab_size, config.max_slots,
+                states_budget=config.structured_states,
+                cache_size=config.structured_cache,
+                max_schema_bytes=config.structured_max_schema_bytes)
+        # Placeholder mask args for unmasked programs (ignored at trace
+        # time when masked=False, but part of the jit signature).
+        self._no_mask_tables = (
+            jnp.zeros((1, 1), jnp.int32), jnp.zeros((1, 1), jnp.uint32),
+            jnp.zeros((1, 1), jnp.float32))
+        self._zero_mstates = np.zeros((config.max_slots,), np.int32)
         # Serving metrics surfaced via the sidecar's /metrics endpoint.
         self.metrics = {
             "prefill_tokens": 0,
@@ -547,9 +583,53 @@ class Engine:
         self._step_counter += 1
         return jax.random.fold_in(self._rng, self._step_counter)
 
+    # -- structured outputs (ISSUE 13) ---------------------------------
+    def _mask_args(self):
+        """(masked, next_table, bits_table, bias_table) for jitted step
+        calls. masked is trace-static: False until the first constrained
+        or logit_bias admission flips the runtime live (then sticky-True
+        — one recompile per step program, ever)."""
+        rt = self.structured
+        if rt is not None and rt.live:
+            return True, rt.next_dev, rt.bits_dev, rt.bias_dev
+        return (False,) + self._no_mask_tables
+
+    def structured_register(self, slot: int, grammar, logit_bias) -> None:
+        """Admission hook: make the request's grammar span device-resident
+        (refcounted, shared by schema hash) and scatter its logit-bias
+        row. No-op for unconstrained requests."""
+        if self.structured is None or (grammar is None and not logit_bias):
+            return
+        with self._lock:
+            self.structured.register_slot(slot, grammar, logit_bias)
+
+    def _mask_bias(self, mbits, mstates, extra=None):
+        """Additive grammar bias for one step's logits: unpack the packed
+        allowed rows for each row's automaton state; ``extra`` appends
+        the per-slot logit_bias rows."""
+        bias = packed_mask_bias(mbits[mstates], self.model_cfg.vocab_size)
+        return bias if extra is None else bias + extra
+
+    def _verify_mask_bias(self, mstates, draft_tokens, mnext, mbits, mbias):
+        """Per-position grammar bias for a speculative verify forward
+        (ISSUE 13): position 0 is masked by the slot's current automaton
+        state, position j by the state after consuming proposals d_1..d_j
+        — a scan of K transition gathers, so ACCEPTED tokens can never
+        break the grammar (a disallowed proposal has target probability
+        exactly 0 under its masked strip and is rejected + resampled
+        from the masked residual). Returns (S, K+1, V)."""
+        K = draft_tokens.shape[1]
+        states = [mstates]
+        for j in range(K):
+            states.append(mnext[states[-1], draft_tokens[:, j]])
+        stacked = jnp.stack(states, axis=1)  # (S, K+1)
+        bias = packed_mask_bias(mbits[stacked], self.model_cfg.vocab_size)
+        return bias + mbias[:-1][:, None, :]
+
     # ------------------------------------------------------------------
-    @partial(jax.jit, static_argnames=("self", "ring"), donate_argnums=(2,))
-    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng, ring=False):
+    @partial(jax.jit, static_argnames=("self", "ring", "masked"), donate_argnums=(2,))
+    def _prefill_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng,
+                    mstates=None, mnext=None, mbits=None, mbias=None, ring=False, masked=False):
         if self.pp:
             logits, cache = llama.forward_pp(
                 params, self.model_cfg, tokens, positions, lengths, cache,
@@ -560,10 +640,13 @@ class Engine:
                 params, self.model_cfg, tokens, positions, lengths, cache,
                 mode="prefill", last_only=True, slot_ids=slot_ids, **ring_kw,
             )
+        if masked:
+            logits = logits + self._mask_bias(mbits, mstates, mbias[slot_ids])
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
-        return toks, logprobs, cache
+        nstates = mnext[mstates, toks] if masked else jnp.zeros_like(toks)
+        return toks, logprobs, nstates, cache
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _decode_fn(self, params, cache, tokens, positions, lengths, temps, top_ps, rng):
@@ -580,9 +663,11 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    @partial(jax.jit, static_argnames=("self", "masked"), donate_argnums=(2,))
     def _prefill_chunk_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
-                                page_table, temps, top_ps, seeds, use_seed, rng):
+                                page_table, temps, top_ps, seeds, use_seed, rng,
+                                mstates=None, mnext=None, mbits=None, mbias=None,
+                                slot_ids=None, masked=False):
         """Paged chunked prefill: fresh tail tokens attend the slot's
         gathered pages (cached prefix + tail) causally — the
         prefix-cache fast path."""
@@ -590,13 +675,17 @@ class Engine:
             params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
             page_table, mode="prefill_chunk", last_only=True,
         )
+        if masked:
+            logits = logits + self._mask_bias(mbits, mstates, mbias[slot_ids])
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
-        return toks, logprobs, cache
+        nstates = mnext[mstates, toks] if masked else jnp.zeros_like(toks)
+        return toks, logprobs, nstates, cache
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
-    def _prefill_chunk_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng):
+    @partial(jax.jit, static_argnames=("self", "masked"), donate_argnums=(2,))
+    def _prefill_chunk_fn(self, params, cache, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng,
+                          mstates=None, mnext=None, mbits=None, mbias=None, masked=False):
         """One chunk of a long prompt: write at positions, attend the
         whole cache row causally (self._model.forward mode=prefill_chunk)."""
         if self.pp:
@@ -608,38 +697,53 @@ class Engine:
                 params, self.model_cfg, tokens, positions, lengths, cache,
                 mode="prefill_chunk", last_only=True, slot_ids=slot_ids,
             )
+        if masked:
+            logits = logits + self._mask_bias(mbits, mstates, mbias[slot_ids])
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
-        return toks, logprobs, cache
+        nstates = mnext[mstates, toks] if masked else jnp.zeros_like(toks)
+        return toks, logprobs, nstates, cache
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
-    def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng):
+    @partial(jax.jit, static_argnames=("self", "masked"), donate_argnums=(2,))
+    def _prefill_fn_mm(self, params, cache, embeds, tokens, positions, lengths, slot_ids, temps, top_ps, seeds, use_seed, rng,
+                       mstates=None, mnext=None, mbits=None, mbias=None, masked=False):
         """Multimodal prefill: precomputed (image-spliced) embeddings
         replace the token-embedding lookup."""
         logits, cache = self._model.forward(
             params, self.model_cfg, tokens, positions, lengths, cache,
             mode="prefill", last_only=True, slot_ids=slot_ids, embeds=embeds,
         )
+        if masked:
+            logits = logits + self._mask_bias(mbits, mstates, mbias[slot_ids])
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
-        return toks, logprobs, cache
+        nstates = mnext[mstates, toks] if masked else jnp.zeros_like(toks)
+        return toks, logprobs, nstates, cache
 
-    @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
-    def _decode_chunk_fn(self, params, cache, tokens, positions, temps, top_ps, seeds, use_seed, rng, n_steps):
+    @partial(jax.jit, static_argnames=("self", "n_steps", "masked"), donate_argnums=(2,))
+    def _decode_chunk_fn(self, params, cache, tokens, positions, temps, top_ps, seeds, use_seed, rng,
+                         mstates=None, mnext=None, mbits=None, mbias=None,
+                         n_steps=8, masked=False):
         """n_steps fused decode steps (lax.scan); sampling feeds back
         on-device so the host syncs once per chunk. RNG (key derivation
         + gumbel draws) is precomputed for the whole chunk OUTSIDE the
         scan — one batched dispatch instead of n_steps small ones, which
         cost ~0.56 ms/step on v5e (round-3 device profile); the streams
-        are bit-identical (see ops/sampling.chunk_gumbels)."""
+        are bit-identical (see ops/sampling.chunk_gumbels).
+
+        Grammar-constrained rows (masked=True) ride the SAME scan: each
+        step gathers the slot's packed mask row by automaton state,
+        applies it (plus the slot's logit_bias row) as an additive bias
+        before top-k/top-p, and advances the state with one more gather
+        — mask advancement never host-syncs mid-chunk (ISSUE 13)."""
         keys = chunk_row_keys(rng, seeds, use_seed, positions, n_steps)
         k_eff = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
         gumbels = chunk_gumbels(keys, k_eff)
 
         def step(carry, xs):
-            cache, tok, pos = carry
+            cache, tok, pos, ms = carry
             i, gum = xs
             if self.pp:
                 logits, cache = llama.forward_pp(
@@ -650,43 +754,54 @@ class Engine:
                     params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache, mode="decode",
                 )
                 logits = logits[:, 0]
+            if masked:
+                logits = logits + self._mask_bias(mbits, ms, mbias[:-1])
             nxt = sample_tokens_pregumbel(logits, temps, top_ps, gum, k_eff)
             nxt = nxt.astype(jnp.int32)
             logprobs = compute_logprobs(logits, nxt)
+            if masked:
+                ms = mnext[ms, nxt]
             # Clamp so attention length never exceeds the cache row even
             # when a request rides the scan past max_seq_len (the
             # scheduler discards those trailing tokens).
             nxt_pos = jnp.minimum(pos + 1, self.config.max_seq_len - 1)
-            return (cache, nxt, nxt_pos), (nxt, logprobs)
+            return (cache, nxt, nxt_pos, ms), (nxt, logprobs)
 
-        (cache, tok_f, pos_f), (toks, logprobs) = jax.lax.scan(
-            step, (cache, tokens, positions), (jnp.arange(n_steps), gumbels)
+        (cache, tok_f, pos_f, ms_f), (toks, logprobs) = jax.lax.scan(
+            step, (cache, tokens, positions, mstates), (jnp.arange(n_steps), gumbels)
         )
-        # tok_f/pos_f: the final sampled token + its position per slot —
-        # returned so the NEXT chunk can chain off device-resident state
-        # with no host round-trip (decode_chunk_submit).
-        return toks, logprobs, tok_f, pos_f, cache  # (n, S) x2, (S,) x2
+        # tok_f/pos_f/ms_f: the final sampled token, its position, and
+        # the grammar state per slot — returned so the NEXT chunk can
+        # chain off device-resident state with no host round-trip
+        # (decode_chunk_submit).
+        return toks, logprobs, tok_f, pos_f, ms_f, cache  # (n, S) x2, (S,) x3
 
-    @partial(jax.jit, static_argnames=("self", "n_steps"), donate_argnums=(2,))
+    @partial(jax.jit, static_argnames=("self", "n_steps", "masked"), donate_argnums=(2,))
     def _decode_chunk_fn_paged(self, params, cache, tokens, positions, write_idx,
-                               page_table, temps, top_ps, seeds, use_seed, rng, n_steps):
+                               page_table, temps, top_ps, seeds, use_seed, rng,
+                               mstates=None, mnext=None, mbits=None, mbias=None,
+                               n_steps=8, masked=False):
         """Paged variant: write_idx is (S, n_steps) precomputed flat cache
-        positions (OOB = drop). Chunk RNG precomputed outside the scan
-        (see _decode_chunk_fn)."""
+        positions (OOB = drop). Chunk RNG precomputed outside the scan;
+        grammar mask state rides the carry (see _decode_chunk_fn)."""
         keys = chunk_row_keys(rng, seeds, use_seed, positions, n_steps)
         k_eff = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
         gumbels = chunk_gumbels(keys, k_eff)
 
         def step(carry, inputs):
-            cache, tok, pos = carry
+            cache, tok, pos, ms = carry
             i, w_idx, gum = inputs
             logits, cache = self._model.forward_paged(
                 params, self.model_cfg, tok[:, None], pos[:, None], pos + 1, cache,
                 w_idx[:, None], page_table, mode="decode", last_only=True, mesh=self.mesh,
             )
+            if masked:
+                logits = logits + self._mask_bias(mbits, ms, mbias[:-1])
             nxt = sample_tokens_pregumbel(logits, temps, top_ps, gum, k_eff)
             nxt = nxt.astype(jnp.int32)
             logprobs = compute_logprobs(logits, nxt)
+            if masked:
+                ms = mnext[ms, nxt]
             # Clamp the carried position so the attention length stays
             # ≤ max_seq_len: past it, n_pages = cdiv(len, page_size)
             # would exceed max_pages_per_slot and the kernel would read
@@ -694,25 +809,30 @@ class Engine:
             # (advisor round-1 high finding). OOB write_idx already
             # drops the writes; this bounds the reads too.
             nxt_pos = jnp.minimum(pos + 1, self.config.max_seq_len - 1)
-            return (cache, nxt, nxt_pos), (nxt, logprobs)
+            return (cache, nxt, nxt_pos, ms), (nxt, logprobs)
 
-        (cache, tok_f, pos_f), (toks, logprobs) = jax.lax.scan(
-            step, (cache, tokens, positions), (jnp.arange(n_steps), write_idx.T, gumbels)
+        (cache, tok_f, pos_f, ms_f), (toks, logprobs) = jax.lax.scan(
+            step, (cache, tokens, positions, mstates), (jnp.arange(n_steps), write_idx.T, gumbels)
         )
-        return toks, logprobs, tok_f, pos_f, cache
+        return toks, logprobs, tok_f, pos_f, ms_f, cache
 
-    @partial(jax.jit, static_argnames=("self", "ring"), donate_argnums=(2,))
+    @partial(jax.jit, static_argnames=("self", "ring", "masked"), donate_argnums=(2,))
     def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
-                          page_table, temps, top_ps, seeds, use_seed, rng, ring=False):
+                          page_table, temps, top_ps, seeds, use_seed, rng,
+                          mstates=None, mnext=None, mbits=None, mbias=None,
+                          slot_ids=None, ring=False, masked=False):
         ring_kw = {"ring_mesh": self.mesh} if ring else {}
         logits, cache = self._model.forward_paged(
             params, self.model_cfg, tokens, positions, lengths, cache, write_idx,
             page_table, mode="prefill", last_only=True, **ring_kw,
         )
+        if masked:
+            logits = logits + self._mask_bias(mbits, mstates, mbias[slot_ids])
         keys = per_row_keys(rng, seeds, use_seed, lengths)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
-        return toks, logprobs, cache
+        nstates = mnext[mstates, toks] if masked else jnp.zeros_like(toks)
+        return toks, logprobs, nstates, cache
 
     @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
     def _decode_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
@@ -725,9 +845,10 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    @partial(jax.jit, static_argnames=("self", "masked"), donate_argnums=(2,))
     def _mixed_step_fn(self, params, cache, tokens, positions, write_idx, page_table,
-                       q_starts, q_lens, kv_lens, temps, top_ps, seeds, use_seed, rng):
+                       q_starts, q_lens, kv_lens, temps, top_ps, seeds, use_seed, rng,
+                       mstates=None, mnext=None, mbits=None, mbias=None, masked=False):
         """One ragged MIXED step (ISSUE 12): prefill-chunk rows and
         decode rows in a single launch over the paged cache. This is the
         one compiled program that replaces the per-bucket
@@ -738,6 +859,11 @@ class Engine:
         logits, cache = self._model.forward_ragged(
             params, self.model_cfg, tokens, positions, cache, write_idx,
             page_table, q_starts, q_lens, kv_lens, mesh=self.mesh)
+        if masked:
+            # Mixed rows are slot-aligned: mask by each slot's automaton
+            # state, bias by its logit_bias row (constrained prefill-tail
+            # rows sample their FIRST token here — same mask semantics).
+            logits = logits + self._mask_bias(mbits, mstates, mbias[:-1])
         keys = per_row_keys(rng, seeds, use_seed, kv_lens)
         toks = sample_tokens(logits, rng, temps, top_ps, top_k=self.config.top_k, row_keys=keys)
         logprobs = compute_logprobs(logits, toks)
@@ -767,6 +893,7 @@ class Engine:
         top_ps = np.ones((S,), np.float32)
         seeds = np.zeros((S,), np.int32)
         use_seed = np.zeros((S,), bool)
+        mstates = np.zeros((S,), np.int32)
         with self._lock:
             write_idx = np.full((1, T), self._flat_size, np.int64)
             off = 0
@@ -787,15 +914,19 @@ class Engine:
                 if r.seed is not None:
                     seeds[r.slot] = int(r.seed)
                     use_seed[r.slot] = True
+                mstates[r.slot] = r.mask_state
                 off += n
                 if r.kind == "prefill":
                     n_prefill += n
+            masked, mnext, mbits, mbias = self._mask_args()
             toks, logprobs, self.cache = self._mixed_step_fn(
                 self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(write_idx), jnp.asarray(self.allocator.page_table()),
                 jnp.asarray(q_starts), jnp.asarray(q_lens), jnp.asarray(kv_lens),
                 jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(seeds),
                 jnp.asarray(use_seed), self._next_rng(),
+                mstates=jnp.asarray(mstates), mnext=mnext, mbits=mbits,
+                mbias=mbias, masked=masked,
             )
             # Positions moved outside the chained-carry bookkeeping.
             self._dev_carry = None
@@ -817,18 +948,19 @@ class Engine:
         return both[0].astype(np.int32), both[1]
 
     def _prefill_one_ragged(self, prompt: list[int], slot: int, temp: float, top_p: float,
-                            seed: int | None = None) -> PrefillResult:
+                            seed: int | None = None, grammar=None) -> PrefillResult:
         """Chunked ragged prefill for one long prompt on the PAGED cache
         (ISSUE 12): chunks of the mixed-step budget attend the slot's
         pages causally — paged engines previously had NO long-prompt
         path at all (max_prompt_len capped at the largest bucket)."""
         chunk = self.mixed_budget
+        mask_state = grammar.global_state if grammar is not None else 0
         toks = logprobs = None
         for start in range(0, len(prompt), chunk):
             piece = prompt[start:start + chunk]
             h = self.mixed_step_submit([MixedRow(
                 slot=slot, token_ids=list(piece), start=start, kind="prefill",
-                temp=temp, top_p=top_p, seed=seed)])
+                temp=temp, top_p=top_p, seed=seed, mask_state=mask_state)])
             toks, logprobs = self.mixed_step_fetch(h)
         with self._lock:
             self.metrics["prefill_batches"] += 1
@@ -862,10 +994,12 @@ class Engine:
 
     def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float],
                 top_ps: list[float], embeds: list | None = None,
-                seeds: list | None = None) -> list[PrefillResult]:
+                seeds: list | None = None, grammars: list | None = None,
+                biases: list | None = None) -> list[PrefillResult]:
         """Synchronous prefill: submit + fetch."""
         return self.prefill_fetch(self.prefill_submit(
-            prompts, slots, temps, top_ps, embeds=embeds, seeds=seeds))
+            prompts, slots, temps, top_ps, embeds=embeds, seeds=seeds,
+            grammars=grammars, biases=biases))
 
     def prefill_fetch(self, handle: PrefillHandle) -> list[PrefillResult]:
         """Block until a submitted prefill's first tokens are on host."""
@@ -874,29 +1008,44 @@ class Engine:
         return [PrefillResult(slot, int(toks[i]), float(logprobs[i]))
                 for i, slot in enumerate(handle.slots)]
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(1, 2, 3, 4, 5, 6))
-    def _admit_scatter_fn(self, tok, pos, temps, top_ps, seeds, use_seed,
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+    def _admit_scatter_fn(self, tok, pos, temps, top_ps, seeds, use_seed, mstate,
                           slot_arr, new_toks, new_lens, new_temps, new_tps,
-                          new_seeds, new_use):
+                          new_seeds, new_use, new_mstates):
         """Fold a prefill batch's results into the chained decode state
         on-device (OOB padding rows drop) — admission stops being a
         pipeline barrier: the next chunk chains off state that already
-        contains the admitted slots' first tokens and positions."""
+        contains the admitted slots' first tokens, positions, and
+        grammar mask states."""
         upd = lambda a, v: a.at[slot_arr].set(v.astype(a.dtype), mode="drop")
         return (upd(tok, new_toks), upd(pos, new_lens), upd(temps, new_temps),
-                upd(top_ps, new_tps), upd(seeds, new_seeds), upd(use_seed, new_use))
+                upd(top_ps, new_tps), upd(seeds, new_seeds), upd(use_seed, new_use),
+                upd(mstate, new_mstates))
 
     def prefill_submit(self, prompts: list[list[int]], slots: list[int], temps: list[float],
                        top_ps: list[float], embeds: list | None = None,
-                       seeds: list | None = None) -> PrefillHandle:
+                       seeds: list | None = None, grammars: list | None = None,
+                       biases: list | None = None) -> PrefillHandle:
         """Prefill a batch of prompts into their slots WITHOUT waiting.
 
         Pads to (max_prefill_batch, bucket). ``embeds`` optionally
         carries per-row (T_i, H) multimodal embedding overrides (from
-        prepare_multimodal). Long-prompt paths (ring / chunked) resolve
-        synchronously inside and return a materialized handle.
+        prepare_multimodal); ``grammars``/``biases`` per-row structured
+        sessions and logit_bias maps (ISSUE 13) — registered here so the
+        batch's first tokens are already grammar-masked. Long-prompt
+        paths (ring / chunked) resolve synchronously inside and return a
+        materialized handle.
         """
         assert prompts and len(prompts) == len(slots)
+        # Structured admission first: span acquire + bias scatter set the
+        # runtime live (and each session's span base) BEFORE any mask
+        # state is read or any step program traced.
+        if self.structured is not None and (grammars or biases):
+            for i, slot in enumerate(slots):
+                self.structured_register(
+                    slot, grammars[i] if grammars else None,
+                    biases[i] if biases else None)
+        sessions = grammars or [None] * len(prompts)
         # Prompts beyond the largest bucket take a long-context path:
         # ring attention over the sp axis when the mesh has one (ONE
         # sequence-sharded pass, O(T/sp) memory per device — dense AND
@@ -929,19 +1078,26 @@ class Engine:
                     else:
                         one = self._prefill_one_chunked
                     results.append((i, one(p, slots[i], temps[i], top_ps[i],
-                        seed=None if seeds is None else seeds[i])))
+                        seed=None if seeds is None else seeds[i],
+                        grammar=sessions[i])))
             if short_idx:
                 sub = self.prefill(
                     [prompts[i] for i in short_idx], [slots[i] for i in short_idx],
                     [temps[i] for i in short_idx], [top_ps[i] for i in short_idx],
                     embeds=[(embeds or [None] * len(prompts))[i] for i in short_idx] if embeds else None,
                     seeds=[(seeds or [None] * len(prompts))[i] for i in short_idx] if seeds else None,
+                    grammars=[sessions[i] for i in short_idx] if grammars else None,
+                    biases=[(biases or [None] * len(prompts))[i] for i in short_idx] if biases else None,
                 )
                 results.extend(zip(short_idx, sub))
             ordered = [r for _, r in sorted(results)]
             # Long paths run synchronously and bypass the standard
             # dispatch, so fold their results into any chained decode
             # state here (host values — they're already materialized).
+            post_states = np.asarray(
+                [0 if sessions[i] is None
+                 else sessions[i].peek_global_after(r.first_token)
+                 for i, r in sorted(results)], np.int32)
             with self._lock:
                 self._scatter_admission(
                     np.asarray([r.slot for r in ordered], np.int32),
@@ -952,6 +1108,7 @@ class Engine:
                                 for s in (seeds or [None] * len(prompts))], np.int32),
                     np.asarray([seeds is not None and s is not None
                                 for s in (seeds or [None] * len(prompts))]),
+                    mstates=post_states,
                 )
             return PrefillHandle(
                 np.asarray([r.first_token for r in ordered], np.int32),
@@ -968,6 +1125,7 @@ class Engine:
         p_arr = np.ones((Bp,), np.float32)
         seed_arr = np.zeros((Bp,), np.int32)
         use_seed = np.zeros((Bp,), bool)
+        ms_arr = np.zeros((Bp,), np.int32)
         for i, (prompt, slot) in enumerate(zip(prompts, slots)):
             tokens[i, : len(prompt)] = prompt
             lengths[i] = len(prompt)
@@ -977,7 +1135,12 @@ class Engine:
             if seeds is not None and seeds[i] is not None:
                 seed_arr[i] = int(seeds[i])
                 use_seed[i] = True
+            if sessions[i] is not None:
+                ms_arr[i] = sessions[i].global_state
         positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (Bp, bucket))
+        masked, mnext, mbits, mbias = self._mask_args()
+        mask_kw = dict(mstates=jnp.asarray(ms_arr), mnext=mnext, mbits=mbits,
+                       mbias=mbias, masked=masked)
 
         has_mm = embeds is not None and any(e is not None for e in embeds)
         with self._lock:
@@ -988,10 +1151,11 @@ class Engine:
                     if e is not None:
                         e = jnp.asarray(e, full.dtype)
                         full = jax.lax.dynamic_update_slice(full, e[None], (i, 0, 0))
-                toks, logprobs, self.cache = self._prefill_fn_mm(
+                toks, logprobs, nstates, self.cache = self._prefill_fn_mm(
                     self.params, self.cache, full, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
                     jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
+                    **mask_kw,
                 )
             elif self.paged:
                 # Prefix-cache match: adopt shared pages, prefill tails only.
@@ -1021,31 +1185,33 @@ class Engine:
                         write_idx[i, : len(tail)] = self.allocator.flat_write_indices(
                             slot, offsets[i], len(tail))
                         row_table[i] = full_table[slot]
-                    toks, logprobs, self.cache = self._prefill_chunk_fn_paged(
+                    toks, logprobs, nstates, self.cache = self._prefill_chunk_fn_paged(
                         self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                         jnp.asarray(lengths), jnp.asarray(write_idx),
                         jnp.asarray(row_table), jnp.asarray(t_arr),
                         jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed),
-                        self._next_rng(),
+                        self._next_rng(), slot_ids=jnp.asarray(slot_arr), **mask_kw,
                     )
                 else:
                     write_idx = np.full((Bp, bucket), self._flat_size, np.int64)  # OOB = drop
                     for i, (prompt, slot) in enumerate(zip(prompts, slots)):
                         write_idx[i, : len(prompt)] = self.allocator.flat_write_indices(slot, 0, len(prompt))
-                    toks, logprobs, self.cache = self._prefill_fn_paged(
+                    toks, logprobs, nstates, self.cache = self._prefill_fn_paged(
                         self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                         jnp.asarray(lengths), jnp.asarray(write_idx),
                         jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
                         jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
+                        slot_ids=jnp.asarray(slot_arr), **mask_kw,
                     )
                 if self.prefix_cache is not None:
                     for prompt, slot in zip(prompts, slots):
                         self.prefix_cache.insert(prompt, self.allocator.pages_of(slot))
             else:
-                toks, logprobs, self.cache = self._prefill_fn(
+                toks, logprobs, nstates, self.cache = self._prefill_fn(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray(slot_arr), jnp.asarray(t_arr),
                     jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed), self._next_rng(),
+                    **mask_kw,
                 )
             self.metrics["prefill_tokens"] += int(lengths.sum())
             self.metrics["prefill_batches"] += 1
@@ -1062,28 +1228,32 @@ class Engine:
                     jnp.asarray(d_positions), jnp.asarray(lengths), jnp.asarray(slot_arr),
                 )
             # Fold results into chained decode state on-device (futures
-            # stay futures — no sync): admission is not a barrier.
+            # stay futures — no sync): admission is not a barrier. The
+            # grammar states after the first sampled tokens ride along.
             scattered = self._scatter_admission(
-                slot_arr, toks, lengths, t_arr, p_arr, seed_arr, use_seed)
+                slot_arr, toks, lengths, t_arr, p_arr, seed_arr, use_seed,
+                mstates=nstates)
         return PrefillHandle(toks[: len(slots)], logprobs[: len(slots)],
                              list(slots), scattered=scattered)
 
     def _scatter_admission(self, slot_arr, toks, lengths, t_arr, p_arr,
-                           seed_arr, use_seed) -> bool:
-        """Scatter a prefill batch's (token, pos, sampling) rows into the
-        device-resident chained state, if it exists. Caller holds _lock
-        or is on the scheduler thread."""
+                           seed_arr, use_seed, mstates=None) -> bool:
+        """Scatter a prefill batch's (token, pos, sampling, mask-state)
+        rows into the device-resident chained state, if it exists.
+        Caller holds _lock or is on the scheduler thread."""
         if self._dev_carry is None:
             return False
-        tok_d, pos_d = self._dev_carry
+        tok_d, pos_d, ms_d = self._dev_carry
         te_d, tp_d, se_d, us_d = self._dev_sampling
+        if mstates is None:
+            mstates = np.zeros((len(slot_arr),), np.int32)
         new = self._admit_scatter_fn(
-            tok_d, pos_d, te_d, tp_d, se_d, us_d,
+            tok_d, pos_d, te_d, tp_d, se_d, us_d, ms_d,
             jnp.asarray(slot_arr), jnp.asarray(toks), jnp.asarray(lengths),
             jnp.asarray(t_arr), jnp.asarray(p_arr), jnp.asarray(seed_arr),
-            jnp.asarray(use_seed))
-        self._dev_carry = (new[0], new[1])
-        self._dev_sampling = tuple(new[2:])
+            jnp.asarray(use_seed), jnp.asarray(mstates))
+        self._dev_carry = (new[0], new[1], new[6])
+        self._dev_sampling = tuple(new[2:6])
         return True
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray, lengths: np.ndarray, temps: np.ndarray, top_ps: np.ndarray):
@@ -1123,10 +1293,12 @@ class Engine:
         return np.asarray(toks), np.asarray(logprobs)
 
     def _prefill_one_chunked(self, prompt: list[int], slot: int, temp: float, top_p: float,
-                             seed: int | None = None) -> PrefillResult:
+                             seed: int | None = None, grammar=None) -> PrefillResult:
         """Chunked prefill for one long prompt (chunk = largest bucket)."""
         chunk = max(b for b in self.config.prefill_buckets if b <= self.config.max_seq_len)
         total = len(prompt)
+        mask_state = grammar.global_state if grammar is not None else 0
+        masked, mnext, mbits, mbias = self._mask_args()
         toks = logprobs = None
         with self._lock:
             for start in range(0, total, chunk):
@@ -1135,12 +1307,14 @@ class Engine:
                 tokens[0, : len(piece)] = piece
                 positions = (start + np.arange(chunk, dtype=np.int32))[None, :]
                 lengths = np.asarray([start + len(piece)], np.int32)
-                toks, logprobs, self.cache = self._prefill_chunk_fn(
+                toks, logprobs, _nstates, self.cache = self._prefill_chunk_fn(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray([slot], np.int32),
                     jnp.asarray([temp], np.float32), jnp.asarray([top_p], np.float32),
                     jnp.asarray([seed if seed is not None else 0], np.int32),
                     jnp.asarray([seed is not None]), self._next_rng(),
+                    mstates=jnp.asarray([mask_state], np.int32), mnext=mnext,
+                    mbits=mbits, mbias=mbias, masked=masked,
                 )
                 # Bumped per chunk, not once at the end: the hang
                 # watchdog reads these as a progress signal, and a long
@@ -1150,7 +1324,7 @@ class Engine:
         return PrefillResult(slot, int(np.asarray(toks)[0]), float(np.asarray(logprobs)[0]))
 
     def _prefill_one_ring(self, prompt: list[int], slot: int, temp: float, top_p: float,
-                          seed: int | None = None) -> PrefillResult:
+                          seed: int | None = None, grammar=None) -> PrefillResult:
         """Ring-attention prefill for one long prompt: the sequence is
         padded to a multiple of the sp axis, sharded across it, and
         attended in ONE pass with KV blocks rotating the ring
@@ -1172,26 +1346,31 @@ class Engine:
         p_arr = np.asarray([top_p], np.float32)
         seed_arr = np.asarray([seed if seed is not None else 0], np.int32)
         use_seed = np.asarray([seed is not None])
+        mask_state = grammar.global_state if grammar is not None else 0
+        masked, mnext, mbits, mbias = self._mask_args()
+        mask_kw = dict(mstates=jnp.asarray([mask_state], np.int32), mnext=mnext,
+                       mbits=mbits, mbias=mbias, masked=masked)
         with self._lock:
             if self.paged:
                 self._ensure_with_evict(slot, T)
                 write_idx = np.full((1, Tp), self._flat_size, np.int64)
                 write_idx[0, :T] = self.allocator.flat_write_indices(slot, 0, T)
-                toks, logprobs, self.cache = self._prefill_fn_paged(
+                toks, logprobs, _nstates, self.cache = self._prefill_fn_paged(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray(write_idx),
                     jnp.asarray(self.allocator.page_table()), jnp.asarray(t_arr),
                     jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed),
-                    self._next_rng(), ring=True,
+                    self._next_rng(), slot_ids=jnp.asarray([slot], np.int32),
+                    ring=True, **mask_kw,
                 )
                 if self.prefix_cache is not None:
                     self.prefix_cache.insert(prompt, self.allocator.pages_of(slot))
             else:
-                toks, logprobs, self.cache = self._prefill_fn(
+                toks, logprobs, _nstates, self.cache = self._prefill_fn(
                     self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(lengths), jnp.asarray([slot], np.int32), jnp.asarray(t_arr),
                     jnp.asarray(p_arr), jnp.asarray(seed_arr), jnp.asarray(use_seed),
-                    self._next_rng(), ring=True,
+                    self._next_rng(), ring=True, **mask_kw,
                 )
             self.metrics["prefill_tokens"] += T
             self.metrics["prefill_batches"] += 1
@@ -1218,7 +1397,8 @@ class Engine:
     def decode_chunk_submit(self, tokens: np.ndarray, positions: np.ndarray,
                             active: np.ndarray, temps: np.ndarray, top_ps: np.ndarray,
                             n_steps: int | None = None, seeds: np.ndarray | None = None,
-                            use_seed: np.ndarray | None = None, chain: bool = False):
+                            use_seed: np.ndarray | None = None, chain: bool = False,
+                            mstates: np.ndarray | None = None):
         """Dispatch ``n_steps`` fused decode steps WITHOUT waiting.
 
         JAX dispatch is asynchronous — the returned handle's arrays are
@@ -1244,6 +1424,7 @@ class Engine:
             seeds = np.zeros((S,), np.int32)
         if use_seed is None:
             use_seed = np.zeros((S,), bool)
+        masked, mnext, mbits, mbias = self._mask_args()
         with self._lock:
             if chain:
                 if self._dev_carry is None:
@@ -1251,10 +1432,12 @@ class Engine:
                         "decode_chunk_submit(chain=True) with no device carry: "
                         "a prefill or failure invalidated chained decode state; "
                         "resubmit with chain=False")
-                tok_in, pos_in = self._dev_carry
+                tok_in, pos_in, ms_in = self._dev_carry
                 temps_d, tps_d, seeds_d, used_d = self._dev_sampling
             else:
                 tok_in, pos_in = jnp.asarray(tokens), jnp.asarray(positions)
+                ms_in = jnp.asarray(mstates if mstates is not None
+                                    else self._zero_mstates)
                 temps_d, tps_d = jnp.asarray(temps), jnp.asarray(top_ps)
                 seeds_d, used_d = jnp.asarray(seeds), jnp.asarray(use_seed)
                 self._dev_sampling = (temps_d, tps_d, seeds_d, used_d)
@@ -1268,17 +1451,21 @@ class Engine:
                         if valid:
                             self._ensure_with_evict(slot, cap)
                             write_idx[slot, :valid] = self.allocator.flat_write_indices(slot, pos, valid)
-                toks, logprobs, tok_f, pos_f, self.cache = self._decode_chunk_fn_paged(
+                toks, logprobs, tok_f, pos_f, ms_f, self.cache = self._decode_chunk_fn_paged(
                     self.params, self.cache, tok_in, pos_in,
                     jnp.asarray(write_idx), jnp.asarray(self.allocator.page_table()),
-                    temps_d, tps_d, seeds_d, used_d, self._next_rng(), n_steps=n,
+                    temps_d, tps_d, seeds_d, used_d, self._next_rng(),
+                    mstates=ms_in, mnext=mnext, mbits=mbits, mbias=mbias,
+                    n_steps=n, masked=masked,
                 )
             else:
-                toks, logprobs, tok_f, pos_f, self.cache = self._decode_chunk_fn(
+                toks, logprobs, tok_f, pos_f, ms_f, self.cache = self._decode_chunk_fn(
                     self.params, self.cache, tok_in, pos_in,
-                    temps_d, tps_d, seeds_d, used_d, self._next_rng(), n_steps=n,
+                    temps_d, tps_d, seeds_d, used_d, self._next_rng(),
+                    mstates=ms_in, mnext=mnext, mbits=mbits, mbias=mbias,
+                    n_steps=n, masked=masked,
                 )
-            self._dev_carry = (tok_f, pos_f)
+            self._dev_carry = (tok_f, pos_f, ms_f)
             n_active = int(active.sum())
             self.metrics["decode_tokens"] += n_active * n
             self.metrics["decode_steps"] += n
@@ -1295,10 +1482,11 @@ class Engine:
         )
         return dcache
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(3, 4))
+    @partial(jax.jit, static_argnames=("self", "masked"), donate_argnums=(3, 4))
     def _spec_round_fn(self, params, dparams, cache, dcache, catchup, catchup_len,
                        catchup_pos, temps, top_ps, write_idx, page_table,
-                       uniforms, draft_gumbels, extra_gumbel):
+                       uniforms, draft_gumbels, extra_gumbel,
+                       mstates=None, mnext=None, mbits=None, mbias=None, masked=False):
         """One speculative round for ALL slots (static shapes).
 
         catchup (S, 2): the emitted tokens the draft hasn't ingested
@@ -1326,25 +1514,37 @@ class Engine:
             dparams, dcfg, catchup, cu_positions, D + catchup_len, dcache,
             mode="prefill_chunk", last_only=True, slot_ids=slot_ids,
         )
+        if masked:
+            # Draft proposals are grammar-masked too (ISSUE 13): the
+            # draft samples from the same allowed set the target will
+            # verify against, so acceptance doesn't collapse on
+            # constrained rows. The mask state advances along the
+            # proposal inside the scan carry.
+            dlogits = dlogits + self._mask_bias(mbits, mstates, mbias[:-1])
 
         # --- K draft proposals (scan over draft decode steps) ----------
         q0_probs, q0_idx = strip_dist(dlogits, temps, top_ps, k)
         d1 = strip_sample(q0_probs, q0_idx, draft_gumbels[:, 0], greedy)
+        ds1 = mnext[mstates, d1] if masked else jnp.zeros_like(d1)
 
         def dstep(carry, xs):
-            dcache, tok, pos = carry
+            dcache, tok, pos, dstate = carry
             i, gum = xs
             lg, dcache = llama.forward(
                 dparams, dcfg, tok[:, None], pos[:, None], pos + 1, dcache,
                 mode="decode", slot_ids=slot_ids,
             )
-            qp, qi = strip_dist(lg[:, 0], temps, top_ps, k)
+            lg = lg[:, 0]
+            if masked:
+                lg = lg + self._mask_bias(mbits, dstate, mbias[:-1])
+            qp, qi = strip_dist(lg, temps, top_ps, k)
             nxt = strip_sample(qp, qi, gum, greedy)
-            return (dcache, nxt, jnp.minimum(pos + 1, max_len - 1)), (nxt, qp, qi)
+            nstate = mnext[dstate, nxt] if masked else dstate
+            return (dcache, nxt, jnp.minimum(pos + 1, max_len - 1), nstate), (nxt, qp, qi)
 
         if K > 1:
-            (dcache, _, _), (d_rest, q_rest_p, q_rest_i) = jax.lax.scan(
-                dstep, (dcache, d1, jnp.minimum(P + 1, max_len - 1)),
+            (dcache, _, _, _), (d_rest, q_rest_p, q_rest_i) = jax.lax.scan(
+                dstep, (dcache, d1, jnp.minimum(P + 1, max_len - 1), ds1),
                 (jnp.arange(1, K), draft_gumbels[:, 1:].swapaxes(0, 1)),
             )
             draft_tokens = jnp.concatenate([d1[:, None], d_rest.swapaxes(0, 1)], axis=1)
@@ -1371,6 +1571,9 @@ class Engine:
                 params, self.model_cfg, ver_tokens, ver_positions, ver_lengths,
                 cache, mode="prefill_chunk", last_only=False, slot_ids=slot_ids,
             )
+        if masked:
+            logits = logits + self._verify_mask_bias(
+                mstates, draft_tokens, mnext, mbits, mbias)
         p_probs, p_idx = strip_dist(
             logits, jnp.broadcast_to(temps[:, None], (S, K + 1)),
             jnp.broadcast_to(top_ps[:, None], (S, K + 1)), k)
@@ -1387,7 +1590,8 @@ class Engine:
                    catchup_pos: np.ndarray, active: np.ndarray,
                    temps: np.ndarray, top_ps: np.ndarray,
                    seeds: np.ndarray | None = None,
-                   use_seed: np.ndarray | None = None):
+                   use_seed: np.ndarray | None = None,
+                   mstates: np.ndarray | None = None):
         """One speculative round for all slots: draft K, verify once,
         emit 1..K+1 tokens per live slot. Returns (out_tokens (S, K+1),
         logprobs (S, K+1), counts (S,)) as numpy."""
@@ -1427,12 +1631,16 @@ class Engine:
             uniforms = jax.vmap(lambda kk: jax.random.uniform(jax.random.fold_in(kk, 0), (K,)))(keys)
             draft_gumbels = jax.vmap(lambda kk: jax.random.gumbel(jax.random.fold_in(kk, 1), (K, k)))(keys)
             extra_gumbel = jax.vmap(lambda kk: jax.random.gumbel(jax.random.fold_in(kk, 2), (k,)))(keys)
+            masked, mnext, mbits, mbias = self._mask_args()
             out, logprobs, counts, self.cache, self.draft_cache = self._spec_round_fn(
                 self.params, self.draft_params, self.cache, self.draft_cache,
                 jnp.asarray(catchup.astype(np.int32)), jnp.asarray(catchup_len.astype(np.int32)),
                 jnp.asarray(catchup_pos.astype(np.int32)), jnp.asarray(temps),
                 jnp.asarray(top_ps), jnp.asarray(write_idx), page_table,
                 uniforms, draft_gumbels, extra_gumbel,
+                mstates=jnp.asarray(mstates if mstates is not None
+                                    else self._zero_mstates),
+                mnext=mnext, mbits=mbits, mbias=mbias, masked=masked,
             )
             self._dev_carry = None  # spec rounds don't chain with decode chunks
             n_active = int(active.sum())
@@ -1446,10 +1654,12 @@ class Engine:
         self.metrics["decode_tokens"] += int(counts_np[active].sum()) if n_active else 0
         return out_np, logp_np, counts_np
 
-    @partial(jax.jit, static_argnames=("self",), donate_argnums=(2,))
+    @partial(jax.jit, static_argnames=("self", "masked"), donate_argnums=(2,))
     def _spec_verify_ngram_fn(self, params, cache, pending, positions, draft_tokens,
                               temps, top_ps, write_idx, page_table, uniforms,
-                              extra_gumbel):
+                              extra_gumbel,
+                              mstates=None, mnext=None, mbits=None, mbias=None,
+                              masked=False):
         """One prompt-lookup round: verify K host-proposed tokens in ONE
         target forward. The draft "distribution" is a point mass on each
         proposal, expressed as a one-hot strip so spec_accept's ratio
@@ -1481,6 +1691,13 @@ class Engine:
                 params, self.model_cfg, ver_tokens, ver_positions, ver_lengths,
                 cache, mode="prefill_chunk", last_only=False, slot_ids=slot_ids,
             )
+        if masked:
+            # Grammar masks per verify position (ISSUE 13): the scheduler
+            # repairs host-side proposals against the automaton, and this
+            # mask guarantees the ACCEPTED prefix is grammar-valid even
+            # when a repair was impossible.
+            logits = logits + self._verify_mask_bias(
+                mstates, draft_tokens, mnext, mbits, mbias)
         p_probs, p_idx = strip_dist(
             logits, jnp.broadcast_to(temps[:, None], (S, K + 1)),
             jnp.broadcast_to(top_ps[:, None], (S, K + 1)), k)
@@ -1503,7 +1720,8 @@ class Engine:
                          draft_tokens: np.ndarray, active: np.ndarray,
                          temps: np.ndarray, top_ps: np.ndarray,
                          seeds: np.ndarray | None = None,
-                         use_seed: np.ndarray | None = None):
+                         use_seed: np.ndarray | None = None,
+                         mstates: np.ndarray | None = None):
         """One prompt-lookup speculative round for all slots.
 
         pending (S,): each slot's pending token at position positions[s];
@@ -1543,12 +1761,16 @@ class Engine:
             )
             uniforms = jax.vmap(lambda kk: jax.random.uniform(jax.random.fold_in(kk, 0), (K,)))(keys)
             extra_gumbel = jax.vmap(lambda kk: jax.random.gumbel(jax.random.fold_in(kk, 2), (k,)))(keys)
+            masked, mnext, mbits, mbias = self._mask_args()
             out, logprobs, counts, self.cache = self._spec_verify_ngram_fn(
                 self.params, self.cache, jnp.asarray(pending.astype(np.int32)),
                 jnp.asarray(positions.astype(np.int32)),
                 jnp.asarray(draft_tokens.astype(np.int32)), jnp.asarray(temps),
                 jnp.asarray(top_ps), jnp.asarray(write_idx), page_table,
                 uniforms, extra_gumbel,
+                mstates=jnp.asarray(mstates if mstates is not None
+                                    else self._zero_mstates),
+                mnext=mnext, mbits=mbits, mbias=mbias, masked=masked,
             )
             self._dev_carry = None  # spec rounds don't chain with decode chunks
             n_active = int(active.sum())
@@ -1592,10 +1814,14 @@ class Engine:
         save_checkpoint(path, self.params, self.model_cfg)
 
     def release_slot(self, slot: int) -> None:
-        """Return a finished slot's KV pages to the pool."""
-        if self.allocator is not None:
+        """Return a finished slot's KV pages to the pool, drop its
+        grammar-span reference, and zero its logit-bias row."""
+        if self.allocator is not None or self.structured is not None:
             with self._lock:
-                self.allocator.release(slot)
+                if self.allocator is not None:
+                    self.allocator.release(slot)
+                if self.structured is not None:
+                    self.structured.release_slot(slot)
 
     def context_window(self) -> int:
         return min(self.config.max_seq_len, self.model_cfg.max_position_embeddings)

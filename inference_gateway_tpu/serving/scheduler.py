@@ -86,6 +86,15 @@ class GenRequest:
     # across preemptions so max_tokens spans the whole stream.
     preempt_count: int = 0
     resume_generated: int = 0
+    # Structured outputs (ISSUE 13): a structured.GrammarSession when the
+    # request carries response_format json_object/json_schema — the host
+    # mirror of the device-side mask automaton (fed one emitted token at
+    # a time in _emit, so preemption resume, continuation splices, and
+    # speculative proposal repair always know the exact state); plus the
+    # request's OpenAI logit_bias map, applied via the same additive-bias
+    # device buffer the masks ride.
+    grammar: object = None
+    logit_bias: dict | None = None
 
 
 @dataclass
@@ -736,6 +745,8 @@ class Scheduler:
             req.phase_ns.setdefault("admit", admit_ns)
         embeds = [r.embeds for r in batch]
         seeds = [r.seed for r in batch]
+        grammars = [r.grammar for r in batch]
+        biases = [r.logit_bias for r in batch]
         self._admitting = batch  # visible to abort_all if prefill wedges
         try:
             handle = self.engine.prefill_submit(
@@ -743,6 +754,8 @@ class Scheduler:
                 [r.temperature for r in batch], [r.top_p for r in batch],
                 embeds=embeds if any(e is not None for e in embeds) else None,
                 seeds=seeds if any(s is not None for s in seeds) else None,
+                grammars=grammars if any(g is not None for g in grammars) else None,
+                biases=biases if any(b for b in biases) else None,
             )
         except Exception as e:
             self._admitting = []
@@ -845,7 +858,8 @@ class Scheduler:
             req = st.req
             rows.append(MixedRow(
                 slot=slot, token_ids=[st.pending_token], start=st.pos, kind="decode",
-                temp=req.temperature, top_p=req.top_p, seed=req.seed))
+                temp=req.temperature, top_p=req.top_p, seed=req.seed,
+                mask_state=req.grammar.global_state if req.grammar is not None else 0))
             used += 1
             context += st.pos + 1
             pairs += st.pos + 1
@@ -859,7 +873,8 @@ class Scheduler:
                 continue
             rows.append(MixedRow(
                 slot=slot, token_ids=req.prompt_ids[done:done + take], start=done,
-                kind="prefill", temp=req.temperature, top_p=req.top_p, seed=req.seed))
+                kind="prefill", temp=req.temperature, top_p=req.top_p, seed=req.seed,
+                mask_state=req.grammar.global_state if req.grammar is not None else 0))
             used += take
             context += done + take
             # Query i of the chunk attends done + i + 1 keys.
@@ -929,6 +944,32 @@ class Scheduler:
         # in _admitting or _slots — a missed one hangs the client (same
         # contract as bucketed _admit).
         self._admitting = batch
+        # Structured admission (ISSUE 13): spans + bias rows must be
+        # device-resident (and session bases set) before the first mixed
+        # step reads any global mask state. A failed registration
+        # (StructuredCapacityError: table budget full of live spans)
+        # fails ONLY that request — the bare-raise alternative would
+        # leak every popped slot and hang the whole batch (run()'s
+        # admission handler only logs; review finding).
+        kept: list[GenRequest] = []
+        kept_slots: list[int] = []
+        for req, slot in zip(batch, slots):
+            if req.grammar is not None or req.logit_bias:
+                try:
+                    self.engine.structured_register(slot, req.grammar, req.logit_bias)
+                except Exception as e:
+                    self.logger.warn("structured admission failed",
+                                     "request", req.request_id, "err", repr(e))
+                    self._fail_request(req)
+                    self._release_guarded(slot, "error")
+                    continue
+            kept.append(req)
+            kept_slots.append(slot)
+        batch, slots = kept, kept_slots
+        self._admitting = batch
+        if not batch:
+            self._admitting = []
+            return
         # Host state must be authoritative before positions move under
         # the pipeline's feet — and the carry is about to be invalidated.
         self._drain_all()
@@ -1077,6 +1118,7 @@ class Scheduler:
         top_ps = np.ones((S,), np.float32)
         seeds = np.zeros((S,), np.int32)
         use_seed = np.zeros((S,), bool)
+        mstates = np.zeros((S,), np.int32)
         max_pos = self.engine.config.max_seq_len - 1
         for slot, st in self._slots.items():
             # Only chunks carrying THIS request (state identity, not slot
@@ -1092,11 +1134,16 @@ class Scheduler:
             if st.req.seed is not None:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
+            if st.req.grammar is not None:
+                # Host mirror is authoritative here: chain=False submits
+                # only happen after a drain, when every emitted token has
+                # been fed (chained submits take the device carry).
+                mstates[slot] = st.req.grammar.global_state
         n = self.engine.config.decode_chunk
         try:
             handle = self.engine.decode_chunk_submit(
                 tokens, positions, active, temps, top_ps, n_steps=n,
-                seeds=seeds, use_seed=use_seed, chain=chain)
+                seeds=seeds, use_seed=use_seed, chain=chain, mstates=mstates)
         except Exception as e:
             self._fail_after_decode_error(e)
             return None
@@ -1120,6 +1167,7 @@ class Scheduler:
         top_ps = np.ones((S,), np.float32)
         seeds = np.zeros((S,), np.int32)
         use_seed = np.zeros((S,), bool)
+        mstates = np.zeros((S,), np.int32)
         for slot, st in self._slots.items():
             cu = st.catchup
             catchup[slot, : len(cu)] = cu
@@ -1131,6 +1179,8 @@ class Scheduler:
             if st.req.seed is not None:
                 seeds[slot] = int(st.req.seed)
                 use_seed[slot] = True
+            if st.req.grammar is not None:
+                mstates[slot] = st.req.grammar.global_state
 
         observing = self._observing
         t0 = time.perf_counter() if observing else 0.0
@@ -1138,7 +1188,7 @@ class Scheduler:
         before_emitted = self.spec_emitted
         out, logprobs, counts = self.engine.spec_round(
             catchup, catchup_len, catchup_pos, active, temps, top_ps,
-            seeds=seeds, use_seed=use_seed)
+            seeds=seeds, use_seed=use_seed, mstates=mstates)
         self.last_step_time = self.clock.now()
         self.steps_completed += 1
         self.spec_rounds += 1
@@ -1200,10 +1250,19 @@ class Scheduler:
         top_ps = np.ones((S,), np.float32)
         seeds = np.zeros((S,), np.int32)
         use_seed = np.zeros((S,), bool)
+        mstates = np.zeros((S,), np.int32)
         for slot, st in self._slots.items():
             pending[slot] = st.pending_token
             positions[slot] = st.pos
-            draft[slot] = ngram_propose(st.history, K)
+            proposal = ngram_propose(st.history, K)
+            if st.req.grammar is not None:
+                # Repair prompt-lookup proposals against the automaton
+                # (ISSUE 13): a grammar-impossible proposal would be
+                # rejected by the masked verify anyway; repairing keeps
+                # the acceptance rate up on constrained streams.
+                proposal = st.req.grammar.filter_proposal(proposal)
+                mstates[slot] = st.req.grammar.global_state
+            draft[slot] = proposal
             active[slot] = True
             temps[slot] = st.req.temperature
             top_ps[slot] = st.req.top_p
@@ -1217,7 +1276,7 @@ class Scheduler:
         before_emitted = self.spec_emitted
         out, logprobs, counts = self.engine.spec_round_ngram(
             pending, positions, draft, active, temps, top_ps,
-            seeds=seeds, use_seed=use_seed)
+            seeds=seeds, use_seed=use_seed, mstates=mstates)
         self.last_step_time = self.clock.now()
         self.steps_completed += 1
         self.spec_rounds += 1
@@ -1405,6 +1464,15 @@ class Scheduler:
             req.phase_ns["first_token"] = time.time_ns()  # prefill ends
         eos = self.engine.tokenizer.eos_token_id
         is_stop = token == eos or token in req.stop_token_ids
+        # Grammar host mirror (ISSUE 13): every emitted token advances
+        # the session. "end" means the grammar already finished (or the
+        # token is impossible under it — a fused chunk decoding past the
+        # completion point): terminate HERE with the stop contract, so
+        # the token carries no content and the emitted text is exactly
+        # the grammar-complete document.
+        if req.grammar is not None:
+            if req.grammar.feed(token) == "end":
+                is_stop = True
         hit_max = st.generated >= req.max_tokens
         out_of_room = st.pos + 1 >= self.engine.config.max_seq_len
         finished = is_stop or hit_max or out_of_room

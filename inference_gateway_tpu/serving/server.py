@@ -87,6 +87,24 @@ def _migrate_signal(item: object) -> str | None:
     return None
 
 
+class _BadRequest(Exception):
+    """A structured 400 raised during request preparation (ISSUE 13):
+    unsupported response_format schemas and invalid logit_bias fast-fail
+    BEFORE any slot or KV page is allocated — the ``prompt_too_long``
+    pattern."""
+
+    def __init__(self, message: str, code: str, param: str,
+                 extra: dict[str, Any] | None = None) -> None:
+        super().__init__(message)
+        self.payload = {"error": {
+            "message": message,
+            "type": "invalid_request_error",
+            "param": param,
+            "code": code,
+            **(extra or {}),
+        }}
+
+
 class SidecarServer:
     def __init__(self, engine: Engine, scheduler: Scheduler | None = None,
                  served_model_name: str | None = None, logger: Logger | None = None,
@@ -725,6 +743,8 @@ class SidecarServer:
             m["kv_pages_free"] = self.engine.allocator.free_page_count()
         if self.engine.prefix_cache is not None:
             m["prefix_cache"] = self.engine.prefix_cache.stats()
+        if self.engine.structured is not None:
+            m["structured"] = self.engine.structured.stats()
         if self.accounting is not None:
             # The mfu snapshot every scrape carries (ISSUE 6): flattened
             # numerics so the Prometheus text path exports them too.
@@ -750,6 +770,10 @@ class SidecarServer:
         if isinstance(prefix_stats, dict):
             for k, v in prefix_stats.items():
                 flat[f"prefix_cache_{k}"] = v
+        structured_stats = flat.pop("structured", None)
+        if isinstance(structured_stats, dict):
+            for k, v in structured_stats.items():
+                flat[f"structured_{k}"] = v
         lines = []
         for key, val in sorted(flat.items()):
             if not isinstance(val, (int, float)):
@@ -819,6 +843,10 @@ class SidecarServer:
                 "mixed_step": getattr(self.engine, "mixed_ok", False),
             },
         }
+        if self.engine.structured is not None:
+            # Structured-outputs snapshot (ISSUE 13): mask-cache hit
+            # rates, device-table occupancy, live constrained slots.
+            status["structured"] = self.engine.structured.stats()
         if self.last_restart is not None:
             status["last_restart"] = self.last_restart
         if self.engine_watchdog is not None:
@@ -921,6 +949,7 @@ class SidecarServer:
         stop = body.get("stop")
         stop_strings: list[str] = [stop] if isinstance(stop, str) else list(stop or [])
         seed = body.get("seed")
+        grammar = self._prepare_grammar(body, resume_ids)
         req = GenRequest(
             prompt_ids=prompt_ids + resume_ids,
             max_tokens=int(max_tokens),
@@ -929,6 +958,8 @@ class SidecarServer:
             embeds=embeds,
             seed=int(seed) if seed is not None else None,
             resume_generated=len(resume_ids),
+            grammar=grammar,
+            logit_bias=self._prepare_logit_bias(body),
         )
         meta = {
             "id": cont_id or "chatcmpl-" + uuid.uuid4().hex[:24],
@@ -944,6 +975,76 @@ class SidecarServer:
         }
         return req, meta
 
+    def _prepare_grammar(self, body: dict[str, Any], resume_ids: list[int]):
+        """Compile ``response_format`` into a per-request GrammarSession
+        (ISSUE 13), fast-forwarded through any continuation resume ids so
+        a spliced constrained stream is byte-identical to an unkilled
+        one. Raises _BadRequest (400 ``unsupported_schema``) for formats
+        the compiler cannot lower — BEFORE any slot/page allocation."""
+        from inference_gateway_tpu.structured.compiler import UnsupportedSchemaError
+
+        response_format = body.get("response_format")
+        if response_format is None or (
+                isinstance(response_format, dict)
+                and response_format.get("type") in (None, "text")):
+            return None
+        runtime = self.engine.structured
+        if runtime is None:
+            raise _BadRequest(
+                "structured outputs are disabled on this engine "
+                "(STRUCTURED_ENABLE)", code="unsupported_schema",
+                param="response_format")
+        try:
+            session = runtime.session_for(response_format)
+        except UnsupportedSchemaError as e:
+            raise _BadRequest(str(e), code="unsupported_schema",
+                              param="response_format",
+                              extra={"reason": e.reason}) from e
+        compile_s, cache_hit = runtime.last_compile
+        if self.otel is not None:
+            self.otel.record_schema_compile(self.model_name, compile_s, cache_hit)
+        if session is not None and resume_ids:
+            if not session.fast_forward(resume_ids):
+                raise _BadRequest(
+                    "continuation resume tokens are not a valid prefix of "
+                    "the requested response_format grammar",
+                    code="invalid_continuation", param="continuation")
+        return session
+
+    def _prepare_logit_bias(self, body: dict[str, Any]) -> dict[int, float] | None:
+        """Parse/validate OpenAI ``logit_bias`` (ISSUE 13 satellite):
+        token ids must exist in the model vocabulary (400 otherwise),
+        biases clamp to the OpenAI [-100, 100] range."""
+        raw = body.get("logit_bias")
+        if not raw:
+            return None
+        if not isinstance(raw, dict):
+            raise _BadRequest("logit_bias must be an object",
+                              code="invalid_logit_bias", param="logit_bias")
+        if self.engine.structured is None:
+            raise _BadRequest(
+                "logit_bias requires the structured-outputs subsystem "
+                "(STRUCTURED_ENABLE)", code="invalid_logit_bias",
+                param="logit_bias")
+        vocab = self.engine.model_cfg.vocab_size
+        out: dict[int, float] = {}
+        for key, value in raw.items():
+            try:
+                token_id = int(key)
+                bias = float(value)
+            except (TypeError, ValueError):
+                raise _BadRequest(
+                    f"logit_bias entry {key!r} is not a token-id/number pair",
+                    code="invalid_logit_bias", param="logit_bias") from None
+            if not 0 <= token_id < vocab:
+                raise _BadRequest(
+                    f"logit_bias token id {token_id} is outside the model "
+                    f"vocabulary (0..{vocab - 1})",
+                    code="invalid_logit_bias", param="logit_bias",
+                    extra={"vocab_size": vocab})
+            out[token_id] = max(-100.0, min(100.0, bias))
+        return out
+
     async def chat_completions(self, req: Request) -> Response:
         try:
             body = req.json()
@@ -952,7 +1053,17 @@ class SidecarServer:
         if not body.get("messages"):
             return Response.json({"error": "messages is required"}, status=400)
 
-        gen, meta = self._prepare(body)
+        try:
+            # Request preparation runs OFF the event loop: chat-template
+            # tokenization is CPU work, and a cold response_format
+            # compile (schema -> byte DFA -> full-vocab token automaton;
+            # up to ~1s on large vocabularies) would otherwise stall
+            # every concurrent stream and /health for its whole duration
+            # (review finding). The compiler cache is thread-safe.
+            gen, meta = await asyncio.get_running_loop().run_in_executor(
+                None, self._prepare, body)
+        except _BadRequest as bad:
+            return Response.json(bad.payload, status=400)
         if len(gen.prompt_ids) >= self.engine.context_window():
             return Response.json({"error": "prompt exceeds context window"}, status=400)
         # Oversized-prompt fast-fail (ISSUE 7 satellite): in modes with
@@ -1168,6 +1279,13 @@ class SidecarServer:
 
         if submit is not None and admit is not None:
             self.record_queue_wait(max(admit - submit, 0) / 1e9)
+        if gen.grammar is not None and self.otel is not None:
+            # Constrained-request outcome accounting (ISSUE 13): "stop"
+            # here means the grammar (or EOS) completed the document;
+            # "length"/"error"/"disconnected" flag truncated or failed
+            # constrained streams.
+            self.otel.record_constrained_request(
+                self.model_name, finish_reason or "unknown")
         if (self.otel is not None and first is not None and finish is not None
                 and completion_tokens > 1 and finish > first):
             self.otel.record_output_token_rate(
@@ -1431,12 +1549,24 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
     TELEMETRY_SLOW_REQUEST_* (forensics thresholds)."""
     import os
 
-    from inference_gateway_tpu.config import ServerConfig, ServingConfig, TelemetryConfig
+    from inference_gateway_tpu.config import (
+        ServerConfig,
+        ServingConfig,
+        StructuredConfig,
+        TelemetryConfig,
+    )
 
     tcfg = TelemetryConfig.load(os.environ)
     svcfg = ServingConfig.load(os.environ)
     scfg = ServerConfig.load(os.environ)
+    stcfg = StructuredConfig.load(os.environ)
     logger = new_logger()
+    # Structured outputs (ISSUE 13): the STRUCTURED_* env surface maps
+    # onto the engine's mask-table knobs before the engine is built.
+    config.structured = stcfg.enable
+    config.structured_states = stcfg.max_states
+    config.structured_cache = stcfg.cache_size
+    config.structured_max_schema_bytes = stcfg.max_schema_bytes
     # Ragged mixed-step serving (ISSUE 12): on by default for the
     # standalone sidecar wherever the engine supports it (paged,
     # non-speculative — Engine.mixed_ok gates the rest). The scheduler

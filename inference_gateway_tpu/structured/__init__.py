@@ -1,0 +1,40 @@
+"""Structured outputs: grammar-constrained decoding (ISSUE 13).
+
+The subsystem that makes ``response_format`` real for the TPU path:
+
+- ``grammar``    — byte-level NFA/DFA machinery (Thompson construction,
+  subset construction over byte equivalence classes).
+- ``compiler``   — JSON Schema (and the raw ``json_object`` mode) lowered
+  onto the byte DFA, plus the schema-hash compile cache.
+- ``automaton``  — the char-level DFA composed with the actual tokenizer
+  vocabulary into a token-mask automaton: dense per-state transition
+  rows and packed V-bit allowed-token masks.
+- ``runtime``    — the device half: transition/mask tables resident in
+  accelerator memory (so mask advancement never host-syncs mid-chunk),
+  span allocation shared across requests by schema hash, and the
+  per-slot additive logit-bias buffer ``logit_bias`` rides.
+
+Split so that everything except ``runtime`` is pure numpy/stdlib (and
+mypy --strict clean) — the grammar compiler must be testable and
+reusable without JAX in the process.
+"""
+
+from inference_gateway_tpu.structured.automaton import TokenAutomaton, pack_mask
+from inference_gateway_tpu.structured.compiler import (
+    CompiledGrammar,
+    GrammarCompiler,
+    GrammarSession,
+    UnsupportedSchemaError,
+)
+from inference_gateway_tpu.structured.grammar import ByteDFA, ByteNFA
+
+__all__ = [
+    "ByteDFA",
+    "ByteNFA",
+    "CompiledGrammar",
+    "GrammarCompiler",
+    "GrammarSession",
+    "TokenAutomaton",
+    "UnsupportedSchemaError",
+    "pack_mask",
+]

@@ -1,0 +1,141 @@
+"""Token-mask automaton: the byte DFA composed with the tokenizer vocab.
+
+For every (DFA state, token id) pair the token's byte expansion is
+walked through the character DFA (vectorized over states, batched over
+tokens grouped by byte length — no per-pair Python loop), yielding
+
+- ``next_state``  (n_states, V) int32 — the state after emitting the
+  token (``n_states`` = dead: token not allowed in that state), and
+- ``mask_bits``   (n_states, W) uint32 — packed V-bit allowed rows
+  (bit v of word v//32), the exact layout the device unpacks into an
+  additive −inf bias before top-k/top-p (ops/sampling.packed_mask_bias).
+
+EOS is allowed exactly at accepting states (when the model vocabulary
+can express it); zero-byte tokens (specials, unknowns) are never
+allowed — a token that consumes no input would let the automaton spin
+without progress.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from inference_gateway_tpu.structured.grammar import ByteDFA
+
+
+def pack_mask(allowed: np.ndarray) -> np.ndarray:
+    """Pack a bool (n, V) allowed matrix into (n, ceil(V/32)) uint32
+    rows — bit v lives at word v // 32, bit position v % 32."""
+    n, vocab = allowed.shape
+    n_words = (vocab + 31) // 32
+    padded = np.zeros((n, n_words * 32), np.uint32)
+    padded[:, :vocab] = allowed.astype(np.uint32)
+    weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))[None, None, :]
+    return (padded.reshape(n, n_words, 32) * weights).sum(axis=2, dtype=np.uint64).astype(np.uint32)
+
+
+class TokenAutomaton:
+    """Precompiled transition tables over the actual tokenizer vocab."""
+
+    def __init__(self, next_state: np.ndarray, mask_bits: np.ndarray,
+                 allowed: np.ndarray, accepts: np.ndarray, start: int,
+                 vocab_size: int, eos_id: int) -> None:
+        self.next_state = next_state  # (n, V) int32; value n = dead
+        self.mask_bits = mask_bits  # (n, W) uint32
+        self._allowed = allowed  # (n, V) bool (host-side queries)
+        self.accepts = accepts  # (n,) bool
+        self.start = start
+        self.vocab_size = vocab_size
+        self.eos_id = eos_id
+        # First allowed token per state (host-side proposal repair for
+        # speculative drafting); -1 when a state allows nothing.
+        any_allowed = allowed.any(axis=1)
+        first = allowed.argmax(axis=1).astype(np.int32)
+        self.first_allowed = np.where(any_allowed, first, -1).astype(np.int32)
+
+    @property
+    def n_states(self) -> int:
+        return int(self.next_state.shape[0])
+
+    def allows(self, state: int, token: int) -> bool:
+        return 0 <= token < self.vocab_size and bool(self._allowed[state, token])
+
+    def advance(self, state: int, token: int) -> int:
+        """Next state after ``token``; ``n_states`` means dead."""
+        if not self.allows(state, token):
+            return self.n_states
+        return int(self.next_state[state, token])
+
+    def complete(self, state: int) -> bool:
+        """Accepting state whose only continuation (if any) is EOS —
+        the grammar has nothing further to say; the host finishes the
+        stream here when the vocabulary cannot express EOS."""
+        if state >= self.n_states or not bool(self.accepts[state]):
+            return False
+        allowed = self._allowed[state]
+        if 0 <= self.eos_id < self.vocab_size:
+            non_eos = allowed.sum() - int(allowed[self.eos_id])
+            return int(non_eos) == 0
+        return not bool(allowed.any())
+
+    @classmethod
+    def build(cls, dfa: ByteDFA, token_bytes: list[bytes], vocab_size: int,
+              eos_id: int) -> "TokenAutomaton":
+        n = dfa.n_states
+        # Pad table with a dead row so the vectorized walk can gather
+        # through dead states without branching.
+        table = np.vstack([dfa.table, np.full((1, 256), n, np.int32)])
+        vocab = min(vocab_size, len(token_bytes))
+        next_state = np.full((n, vocab_size), n, np.int32)
+
+        by_len: dict[int, list[int]] = {}
+        for tid in range(vocab):
+            data = token_bytes[tid]
+            if data:
+                by_len.setdefault(len(data), []).append(tid)
+        states = np.arange(n, dtype=np.int32)
+        for length, tids in by_len.items():
+            arr = np.frombuffer(b"".join(token_bytes[t] for t in tids),
+                                np.uint8).reshape(len(tids), length)
+            cur = np.broadcast_to(states[:, None], (n, len(tids))).copy()
+            for j in range(length):
+                cur = table[cur, arr[None, :, j]]
+            next_state[:, tids] = cur
+
+        allowed = next_state < n
+        # EOS: allowed exactly at accepting states; emitting it keeps the
+        # state (the stream is over — the row only matters to fused
+        # chunks that decode past a finish, whose tokens the scheduler
+        # discards).
+        if 0 <= eos_id < vocab_size:
+            allowed[:, eos_id] = dfa.accepts
+            next_state[dfa.accepts, eos_id] = states[dfa.accepts]
+        # Dead transitions must still land IN-RANGE on device (the row is
+        # unreachable through sampling — every dead token is masked — but
+        # a fused chunk's defensive all-masked fallback may sample one).
+        safe_next = np.where(allowed, next_state, 0).astype(np.int32)
+        return cls(next_state=safe_next, mask_bits=pack_mask(allowed),
+                   allowed=allowed, accepts=dfa.accepts.copy(), start=dfa.start,
+                   vocab_size=vocab_size, eos_id=eos_id)
+
+
+def token_byte_table(tokenizer: object, vocab_size: int) -> list[bytes]:
+    """Byte expansion per token id for the mask composition.
+
+    ByteTokenizer ids ARE bytes (decode() of a lone continuation byte
+    would lose information); other tokenizers go through their own
+    ``decode`` — specials and ids that render nothing become b"" and
+    are never allowed by the automaton."""
+    from inference_gateway_tpu.serving.tokenizer import ByteTokenizer
+
+    if isinstance(tokenizer, ByteTokenizer):
+        return [bytes((i,)) if i < 256 else b"" for i in range(vocab_size)]
+    out: list[bytes] = []
+    decode = getattr(tokenizer, "decode", None)
+    for tid in range(vocab_size):
+        try:
+            text = decode([tid]) if decode is not None else ""
+        except Exception:
+            text = ""
+        out.append(text.encode("utf-8"))
+    return out

@@ -1,0 +1,550 @@
+"""JSON Schema → byte grammar → token-mask automaton, with caching.
+
+The supported schema subset (docs/structured-decoding.md) covers the
+shapes production structured-output traffic actually sends: ``object``
+with ``properties``/``required`` (optional keys may be omitted; key
+order follows ``properties``), ``array`` with ``items`` and small
+``minItems``/``maxItems``, ``string`` (full JSON escape grammar,
+``enum``/``const``, bounded ``minLength``/``maxLength``), ``number`` /
+``integer`` (digit counts bounded so greedy decoding always
+terminates), ``boolean``, ``null``, ``enum``/``const`` of any JSON
+literal, and ``oneOf``/``anyOf`` alternation. ``$ref``, ``pattern``,
+``patternProperties``, multi-schema ``allOf``, and unbounded
+``maxItems`` beyond the repetition cap raise
+:class:`UnsupportedSchemaError` — the serving edge fast-fails those
+with a structured 400 ``code:unsupported_schema`` before any slot or
+page is allocated. Numeric range keywords (minimum/maximum/…) are
+accepted but NOT grammar-enforced.
+
+Compiled artifacts are cached by schema hash (shared schemas repeat
+across requests, exactly like prompt prefixes in the PrefixCache), with
+hit/miss counters and compile-time accounting the sidecar exports.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+from inference_gateway_tpu.structured.automaton import TokenAutomaton
+from inference_gateway_tpu.structured.grammar import (
+    ByteNFA,
+    GrammarTooComplexError,
+    determinize,
+)
+
+# Repetition policy: small EXPLICIT bounds (maxLength/maxItems up to the
+# caps below) compile to counted repetition — the grammar then both
+# enforces the bound and guarantees greedy decoding terminates (argmax
+# can never orbit inside a star forever). Unbounded constructs compile
+# to true Kleene loops (2 states instead of N copies); number digit runs
+# stay counted so numeric literals always terminate.
+MAX_COUNTED_LENGTH = 128
+MAX_COUNTED_ITEMS = 64
+MAX_NUMBER_DIGITS = 15
+MAX_FRACTION_DIGITS = 15
+MAX_EXPONENT_DIGITS = 3
+JSON_OBJECT_DEPTH = 3
+
+_WS = frozenset(b" \t\n\r")
+_DIGIT = frozenset(b"0123456789")
+_DIGIT19 = frozenset(b"123456789")
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+# Inside a JSON string: anything but '"', '\\', and control bytes.
+_STRING_CHAR = frozenset(range(0x20, 0x100)) - frozenset(b'"\\')
+_ESCAPE_SIMPLE = frozenset(b'"\\/bfnrt')
+
+# An emitter takes (nfa, start) and returns the fragment's end state.
+Emitter = Callable[[ByteNFA, int], int]
+
+
+class UnsupportedSchemaError(ValueError):
+    """A response_format the compiler cannot lower — the serving edge
+    maps this onto a structured 400 ``code:unsupported_schema``."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(f"unsupported response_format: {reason}")
+        self.reason = reason
+
+
+def _opt_ws(nfa: ByteNFA, start: int) -> int:
+    """At most ONE whitespace byte: enough for natural JSON emission,
+    bounded so greedy decoding cannot orbit in a whitespace star."""
+    end = nfa.new_state()
+    nfa.add_eps(start, end)
+    nfa.add_edge(start, _WS, end)
+    return end
+
+
+def _alt(emitters: list[Emitter]) -> Emitter:
+    def emit(nfa: ByteNFA, start: int) -> int:
+        end = nfa.new_state()
+        for e in emitters:
+            branch_end = e(nfa, start)
+            nfa.add_eps(branch_end, end)
+        return end
+
+    return emit
+
+
+def _seq(emitters: list[Emitter]) -> Emitter:
+    def emit(nfa: ByteNFA, start: int) -> int:
+        cur = start
+        for e in emitters:
+            cur = e(nfa, cur)
+        return cur
+
+    return emit
+
+
+def _lit(data: bytes) -> Emitter:
+    return lambda nfa, start: nfa.lit(start, data)
+
+
+def _cls(byte_class: frozenset[int]) -> Emitter:
+    return lambda nfa, start: nfa.cls(start, byte_class)
+
+
+def _repeat(emitter: Emitter, lo: int, hi: int) -> Emitter:
+    """Counted repetition: ``lo`` required copies then ``hi - lo``
+    optional ones (fragment emitted per copy — linear, never shared)."""
+
+    def emit(nfa: ByteNFA, start: int) -> int:
+        cur = start
+        for _ in range(lo):
+            cur = emitter(nfa, cur)
+        end = nfa.new_state()
+        nfa.add_eps(cur, end)
+        for _ in range(hi - lo):
+            cur = emitter(nfa, cur)
+            nfa.add_eps(cur, end)
+        return end
+
+    return emit
+
+
+def _star(emitter: Emitter) -> Emitter:
+    """Kleene star: one fragment copy with a loop-back epsilon — two
+    states total, for unbounded constructs where a counted expansion
+    would explode the NFA."""
+
+    def emit(nfa: ByteNFA, start: int) -> int:
+        loop = nfa.new_state()
+        nfa.add_eps(start, loop)
+        nfa.add_eps(emitter(nfa, loop), loop)
+        end = nfa.new_state()
+        nfa.add_eps(loop, end)
+        return end
+
+    return emit
+
+
+def _bounded(emitter: Emitter, lo: int, hi: int | None, cap: int) -> Emitter:
+    """Counted repetition when ``hi`` is explicit and under ``cap``;
+    otherwise ``lo`` required copies followed by an unbounded star (the
+    bound, if any, is then NOT grammar-enforced — documented)."""
+    if hi is not None and hi <= cap:
+        return _repeat(emitter, lo, hi)
+    return _seq([_repeat(emitter, lo, lo), _star(emitter)])
+
+
+def _json_string_body(max_len: int | None = None, min_len: int = 0) -> Emitter:
+    char = _alt([
+        _cls(_STRING_CHAR),
+        _seq([_lit(b"\\"), _alt([
+            _cls(_ESCAPE_SIMPLE),
+            _seq([_lit(b"u"), _cls(_HEX), _cls(_HEX), _cls(_HEX), _cls(_HEX)]),
+        ])]),
+    ])
+    return _seq([_lit(b'"'), _bounded(char, min_len, max_len, MAX_COUNTED_LENGTH),
+                 _lit(b'"')])
+
+
+def _number(integer_only: bool, bounded: bool = True) -> Emitter:
+    """JSON number grammar. ``bounded`` (schema-typed numbers) caps the
+    digit runs so greedy decoding must terminate; the generic any-JSON
+    grammar uses unbounded digit loops instead — counted digit states
+    multiplied across every nesting context would blow the DFA budget."""
+    digits = (lambda lo, hi: _repeat(_cls(_DIGIT), lo, hi)) if bounded \
+        else (lambda lo, hi: _seq([_repeat(_cls(_DIGIT), lo, lo), _star(_cls(_DIGIT))]))
+    int_part = _alt([
+        _lit(b"0"),
+        _seq([_cls(_DIGIT19), digits(0, MAX_NUMBER_DIGITS - 1)]),
+    ])
+    parts: list[Emitter] = [_repeat(_lit(b"-"), 0, 1), int_part]
+    if not integer_only:
+        frac = _seq([_lit(b"."), digits(1, MAX_FRACTION_DIGITS)])
+        exp = _seq([_cls(frozenset(b"eE")), _repeat(_cls(frozenset(b"+-")), 0, 1),
+                    _repeat(_cls(_DIGIT), 1, MAX_EXPONENT_DIGITS)])
+        parts.append(_repeat(frac, 0, 1))
+        parts.append(_repeat(exp, 0, 1))
+    return _seq(parts)
+
+
+def _literal(value: Any) -> Emitter:
+    return _lit(json.dumps(value, separators=(",", ":"), ensure_ascii=True).encode())
+
+
+def _object_emitter(props: "OrderedDict[str, Emitter]", required: set[str]) -> Emitter:
+    """``{ "k": v, ... }`` with required keys mandatory and optional keys
+    skippable, in ``properties`` order. Built directly on boundary
+    states (one per (key index, emitted-anything-yet) pair) so optional
+    keys stay linear — an IR expansion would double per optional key."""
+    keys = list(props)
+
+    def emit(nfa: ByteNFA, start: int) -> int:
+        after_open = _opt_ws(nfa, nfa.lit(start, b"{"))
+        close = nfa.new_state()  # just before '}'
+        # boundary[(i, started)] — about to consider key i.
+        boundary: dict[tuple[int, bool], int] = {(0, False): after_open}
+        for i, key in enumerate(keys):
+            for started in (False, True):
+                if (i, started) not in boundary:
+                    continue
+                b = boundary[(i, started)]
+                cur = b
+                if started:
+                    cur = _opt_ws(nfa, nfa.lit(cur, b","))
+                cur = nfa.lit(cur, json.dumps(key, ensure_ascii=True).encode())
+                cur = _opt_ws(nfa, nfa.lit(_opt_ws(nfa, cur), b":"))
+                cur = _opt_ws(nfa, props[key](nfa, cur))
+                nxt = boundary.setdefault((i + 1, True), nfa.new_state())
+                nfa.add_eps(cur, nxt)
+                if key not in required:
+                    skip = boundary.setdefault((i + 1, started), nfa.new_state())
+                    nfa.add_eps(b, skip)
+        for started in (False, True):
+            b = boundary.get((len(keys), started))
+            if b is not None:
+                nfa.add_eps(b, close)
+        return nfa.lit(close, b"}")
+
+    return emit
+
+
+def _generic_object(value: Emitter) -> Emitter:
+    pair = _seq([_json_string_body(), _lit(b":"),
+                 lambda nfa, s: _opt_ws(nfa, s), value,
+                 lambda nfa, s: _opt_ws(nfa, s)])
+    items = _seq([pair, _star(_seq([_lit(b","), lambda nfa, s: _opt_ws(nfa, s), pair]))])
+    return _seq([_lit(b"{"), lambda nfa, s: _opt_ws(nfa, s),
+                 _repeat(items, 0, 1), _lit(b"}")])
+
+
+def _array_emitter(item: Emitter, min_items: int, max_items: int | None) -> Emitter:
+    if max_items == 0:
+        # Only the empty array: the general construction below always
+        # admits one item (its first element sits inside an optional
+        # group whose bound covers only the separators; review finding).
+        return _seq([_lit(b"["), lambda nfa, s: _opt_ws(nfa, s), _lit(b"]")])
+    spaced = _seq([item, lambda nfa, s: _opt_ws(nfa, s)])
+    rest = _seq([_lit(b","), lambda nfa, s: _opt_ws(nfa, s), spaced])
+    if min_items <= 0:
+        body = _repeat(_seq([spaced, _bounded(
+            rest, 0, None if max_items is None else max_items - 1,
+            MAX_COUNTED_ITEMS)]), 0, 1)
+    else:
+        body = _seq([spaced, _bounded(
+            rest, min_items - 1, None if max_items is None else max_items - 1,
+            MAX_COUNTED_ITEMS)])
+    return _seq([_lit(b"["), lambda nfa, s: _opt_ws(nfa, s), body, _lit(b"]")])
+
+
+def _any_value(depth: int) -> Emitter:
+    scalars: list[Emitter] = [
+        _json_string_body(),
+        _number(integer_only=False, bounded=False),
+        _lit(b"true"), _lit(b"false"), _lit(b"null"),
+    ]
+    if depth <= 0:
+        return _alt(scalars)
+    inner = _any_value(depth - 1)
+    return _alt(scalars + [_generic_object(inner),
+                           _array_emitter(inner, 0, None)])
+
+
+def schema_emitter(schema: Any, depth: int = JSON_OBJECT_DEPTH) -> Emitter:
+    """Lower one (sub)schema to an emitter; raises UnsupportedSchemaError."""
+    if schema is True or schema is None or schema == {}:
+        return _any_value(depth)
+    if not isinstance(schema, dict):
+        raise UnsupportedSchemaError(f"schema must be an object, got {type(schema).__name__}")
+    for key in ("$ref", "patternProperties", "pattern", "not", "if"):
+        if key in schema:
+            raise UnsupportedSchemaError(f"'{key}' is not supported")
+    if "allOf" in schema:
+        branches = schema["allOf"]
+        if isinstance(branches, list) and len(branches) == 1:
+            return schema_emitter(branches[0], depth)
+        raise UnsupportedSchemaError("'allOf' with multiple branches is not supported")
+    if "const" in schema:
+        return _literal(schema["const"])
+    if "enum" in schema:
+        values = schema["enum"]
+        if not isinstance(values, list) or not values:
+            raise UnsupportedSchemaError("'enum' must be a non-empty array")
+        return _alt([_literal(v) for v in values])
+    for key in ("oneOf", "anyOf"):
+        if key in schema:
+            branches = schema[key]
+            if not isinstance(branches, list) or not branches:
+                raise UnsupportedSchemaError(f"'{key}' must be a non-empty array")
+            return _alt([schema_emitter(b, depth) for b in branches])
+
+    stype = schema.get("type")
+    if isinstance(stype, list):
+        return _alt([schema_emitter(dict(schema, type=t), depth) for t in stype])
+    if stype == "string":
+        max_len = schema.get("maxLength")
+        min_len = schema.get("minLength", 0)
+        if max_len is not None and not isinstance(max_len, int):
+            raise UnsupportedSchemaError("maxLength must be an integer")
+        if not isinstance(min_len, int) or min_len < 0 \
+                or (max_len is not None and min_len > max_len):
+            raise UnsupportedSchemaError("invalid minLength/maxLength")
+        if min_len > MAX_COUNTED_LENGTH:
+            raise UnsupportedSchemaError(
+                f"minLength above the counted-repetition cap ({MAX_COUNTED_LENGTH})")
+        return _json_string_body(max_len, min_len)
+    if stype in ("number", "integer"):
+        return _number(integer_only=stype == "integer")
+    if stype == "boolean":
+        return _alt([_lit(b"true"), _lit(b"false")])
+    if stype == "null":
+        return _lit(b"null")
+    if stype == "array":
+        max_items = schema.get("maxItems")
+        min_items = schema.get("minItems", 0)
+        if max_items is not None and not isinstance(max_items, int):
+            raise UnsupportedSchemaError("maxItems must be an integer")
+        if not isinstance(min_items, int) or min_items < 0 \
+                or (max_items is not None and min_items > max_items):
+            raise UnsupportedSchemaError("invalid minItems/maxItems")
+        if min_items > MAX_COUNTED_ITEMS:
+            raise UnsupportedSchemaError(
+                f"minItems above the counted-repetition cap ({MAX_COUNTED_ITEMS})")
+        item = schema_emitter(schema.get("items"), depth - 1) \
+            if "items" in schema else _any_value(depth - 1)
+        return _array_emitter(item, min_items, max_items)
+    if stype == "object" or "properties" in schema:
+        props_in = schema.get("properties") or {}
+        if not isinstance(props_in, dict):
+            raise UnsupportedSchemaError("'properties' must be an object")
+        required_in = schema.get("required") or []
+        if not isinstance(required_in, list):
+            raise UnsupportedSchemaError("'required' must be an array")
+        if not props_in:
+            return _generic_object(_any_value(depth - 1))
+        props: OrderedDict[str, Emitter] = OrderedDict()
+        for key, sub in props_in.items():
+            props[key] = schema_emitter(sub, depth - 1)
+        unknown_required = [k for k in required_in if k not in props_in]
+        if unknown_required:
+            raise UnsupportedSchemaError(
+                f"required keys missing from properties: {unknown_required}")
+        return _object_emitter(props, set(required_in))
+    if stype is None:
+        return _any_value(depth)
+    raise UnsupportedSchemaError(f"type {stype!r} is not supported")
+
+
+class CompiledGrammar:
+    """A schema lowered all the way to token tables, cache-resident."""
+
+    def __init__(self, automaton: TokenAutomaton, schema_hash: str, mode: str) -> None:
+        self.automaton = automaton
+        self.schema_hash = schema_hash
+        self.mode = mode  # "json_schema" | "json_object"
+
+
+class GrammarSession:
+    """Per-request automaton state, mirrored on the host.
+
+    The device tables are authoritative during fused chunks; the host
+    mirror advances one table lookup per emitted token (Scheduler._emit)
+    so resume paths — preemption re-prefill, continuation splices, live
+    migration, speculative proposal filtering — always know the exact
+    state without any device readback."""
+
+    def __init__(self, compiled: CompiledGrammar) -> None:
+        self.compiled = compiled
+        self.state = compiled.automaton.start
+        self.consumed = 0
+        self.dead = False
+        # Device-table span base, set by the runtime at admission;
+        # global device state = base + local state.
+        self.base = 0
+
+    @property
+    def global_state(self) -> int:
+        return self.base + (self.state if not self.dead else 0)
+
+    def complete(self) -> bool:
+        return not self.dead and self.compiled.automaton.complete(self.state)
+
+    def feed(self, token: int) -> str:
+        """Advance by one emitted token.
+
+        Returns "ok" (stream continues), "complete" (this token was
+        valid and the grammar now has nothing further to say), or "end"
+        (the grammar was already finished — or the token is impossible
+        under it — so the stream must stop HERE and this token carries
+        no content; fused chunks decode a few of these past a finish)."""
+        auto = self.compiled.automaton
+        if self.dead or self.complete():
+            return "end"
+        if token == auto.eos_id:
+            self.dead = True
+            return "end" if not auto.accepts[self.state] else "complete"
+        if not auto.allows(self.state, token):
+            self.dead = True
+            return "end"
+        self.state = auto.advance(self.state, token)
+        self.consumed += 1
+        return "complete" if self.complete() else "ok"
+
+    def peek_global_after(self, token: int) -> int:
+        """Global device state after ``token``, WITHOUT mutating the
+        session — the synchronous long-prompt prefill paths scatter this
+        into the chained decode carry before the scheduler's emission
+        path feeds the token."""
+        auto = self.compiled.automaton
+        if self.dead or not auto.allows(self.state, token):
+            return self.base
+        return self.base + auto.advance(self.state, token)
+
+    def fast_forward(self, tokens: list[int]) -> bool:
+        """Recompute state from generated-so-far token ids — the
+        continuation-splice / preemption-resume path. False when the
+        prefix is not a live path of the grammar."""
+        for token in tokens:
+            verdict = self.feed(token)
+            if verdict == "end" or self.dead:
+                return False
+        return True
+
+    def filter_proposal(self, tokens: list[int]) -> list[int]:
+        """Repair a speculative draft proposal so every token is
+        grammar-allowed (masked verify would reject the tail anyway;
+        repairing keeps acceptance up). Length is preserved."""
+        auto = self.compiled.automaton
+        state = self.state
+        dead = self.dead
+        out: list[int] = []
+        for token in tokens:
+            if dead or auto.complete(state):
+                out.append(tokens[-1] if not out else out[-1])
+                continue
+            if not auto.allows(state, token):
+                repaired = int(auto.first_allowed[state])
+                token = repaired if repaired >= 0 else token
+            if auto.allows(state, token):
+                state = auto.advance(state, token)
+            else:
+                dead = True
+            out.append(token)
+        return out
+
+
+class GrammarCompiler:
+    """Schema-hash-cached compiler over one tokenizer/vocab pairing."""
+
+    def __init__(self, token_bytes: list[bytes], vocab_size: int, eos_id: int,
+                 max_states: int, cache_size: int = 64,
+                 max_schema_bytes: int = 65536) -> None:
+        self._token_bytes = token_bytes
+        self._vocab_size = vocab_size
+        self._eos_id = eos_id
+        self.max_states = max_states
+        self.cache_size = cache_size
+        self.max_schema_bytes = max_schema_bytes
+        self._cache: OrderedDict[str, CompiledGrammar] = OrderedDict()
+        # Cold compiles run on executor threads (the serving edge keeps
+        # them off the event loop); the lock serializes cache mutation
+        # and makes a stampede of identical schemas compile once.
+        self._lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.compile_seconds_total = 0.0
+        self.last_compile_seconds = 0.0
+
+    def compile_response_format(self, response_format: Any) -> CompiledGrammar | None:
+        """None for ``text``/absent; a compiled grammar for
+        ``json_object``/``json_schema``; UnsupportedSchemaError otherwise.
+        Thread-safe (serving-edge executor offload)."""
+        if response_format is None:
+            return None
+        if not isinstance(response_format, dict):
+            raise UnsupportedSchemaError("response_format must be an object")
+        rtype = response_format.get("type")
+        if rtype in (None, "text"):
+            return None
+        if rtype == "json_object":
+            return self._compile("json_object", None)
+        if rtype == "json_schema":
+            wrapper = response_format.get("json_schema")
+            if not isinstance(wrapper, dict):
+                raise UnsupportedSchemaError("json_schema must be an object")
+            schema = wrapper.get("schema")
+            encoded = json.dumps(schema, sort_keys=True, separators=(",", ":"))
+            if len(encoded) > self.max_schema_bytes:
+                raise UnsupportedSchemaError(
+                    f"schema of {len(encoded)} bytes exceeds the "
+                    f"{self.max_schema_bytes}-byte limit")
+            return self._compile("json_schema", schema)
+        raise UnsupportedSchemaError(f"response_format type {rtype!r}")
+
+    def _compile(self, mode: str, schema: Any) -> CompiledGrammar:
+        encoded = json.dumps({"mode": mode, "schema": schema},
+                             sort_keys=True, separators=(",", ":"))
+        schema_hash = hashlib.sha256(encoded.encode()).hexdigest()[:32]
+        with self._lock:
+            cached = self._cache.get(schema_hash)
+            if cached is not None:
+                self._cache.move_to_end(schema_hash)
+                self.cache_hits += 1
+                self.last_compile_seconds = 0.0
+                return cached
+            self.cache_misses += 1
+        t0 = time.perf_counter()
+        # json_object adapts its nesting depth to the state budget: a
+        # shallower any-JSON grammar is still sound (the masks simply
+        # never let the model OPEN a deeper level), and depth-bounded
+        # finite JSON is intrinsically ~4x states per level.
+        depths = list(range(JSON_OBJECT_DEPTH, 0, -1)) if mode == "json_object" else [0]
+        dfa = None
+        for attempt, depth in enumerate(depths):
+            emitter = _any_value(depth) if mode == "json_object" \
+                else schema_emitter(schema)
+            nfa = ByteNFA()
+            start = nfa.new_state()
+            end = emitter(nfa, start)
+            try:
+                dfa = determinize(nfa, start, end, self.max_states)
+                break
+            except GrammarTooComplexError as e:
+                if attempt == len(depths) - 1:
+                    raise UnsupportedSchemaError(str(e)) from e
+        assert dfa is not None
+        automaton = TokenAutomaton.build(dfa, self._token_bytes,
+                                         self._vocab_size, self._eos_id)
+        compiled = CompiledGrammar(automaton, schema_hash, mode)
+        with self._lock:
+            self._cache[schema_hash] = compiled
+            while len(self._cache) > self.cache_size:
+                self._cache.popitem(last=False)
+            self.last_compile_seconds = time.perf_counter() - t0
+            self.compile_seconds_total += self.last_compile_seconds
+        return compiled
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "cache_entries": len(self._cache),
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "compile_seconds_total": round(self.compile_seconds_total, 6),
+        }

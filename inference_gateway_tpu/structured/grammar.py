@@ -1,0 +1,178 @@
+"""Byte-level automaton machinery for grammar-constrained decoding.
+
+The structured-output compiler lowers a JSON Schema into a byte-level
+NFA (built directly with this module's graph builder — fragments are
+emitted per use site, never shared, so construction stays linear and
+Thompson-correct), then into a DFA by subset construction. The DFA is
+the character-level half of the token-mask automaton; ``automaton.py``
+composes it with the tokenizer vocabulary.
+
+Alphabet = bytes 0..255 (UTF-8): a grammar over bytes composes with any
+tokenizer whose pieces have a byte expansion, and "string escapes
+spanning token merges" need no special cases — a token is just a byte
+sequence walked through the DFA.
+
+Subset construction runs over byte *equivalence classes* (bytes that no
+edge distinguishes collapse into one symbol), so JSON-sized grammars
+(~10-20 distinct classes) explore states cheaply; the final table is
+expanded back to a dense ``(n_states, 256)`` int32 array for the
+vectorized token walk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class GrammarTooComplexError(ValueError):
+    """DFA state count exceeded the configured budget."""
+
+    def __init__(self, limit: int) -> None:
+        super().__init__(f"grammar exceeds the {limit}-state DFA budget")
+        self.limit = limit
+
+
+@dataclass
+class ByteNFA:
+    """An NFA over the byte alphabet, built imperatively.
+
+    ``edges[s]`` holds ``(byte_class, target)`` pairs (byte_class is a
+    frozenset of ints 0..255); ``eps[s]`` holds epsilon targets.
+    """
+
+    eps: list[list[int]] = field(default_factory=list)
+    edges: list[list[tuple[frozenset[int], int]]] = field(default_factory=list)
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    def add_eps(self, a: int, b: int) -> None:
+        self.eps[a].append(b)
+
+    def add_edge(self, a: int, byte_class: frozenset[int], b: int) -> None:
+        if byte_class:
+            self.edges[a].append((byte_class, b))
+
+    # -- fragment helpers (each call EMITS fresh states; no sharing) ----
+    def lit(self, start: int, data: bytes) -> int:
+        """Chain a byte literal from ``start``; returns the end state."""
+        cur = start
+        for byte in data:
+            nxt = self.new_state()
+            self.add_edge(cur, frozenset((byte,)), nxt)
+            cur = nxt
+        return cur
+
+    def cls(self, start: int, byte_class: frozenset[int]) -> int:
+        nxt = self.new_state()
+        self.add_edge(start, byte_class, nxt)
+        return nxt
+
+
+def byte_classes(nfa: ByteNFA) -> tuple[np.ndarray, int]:
+    """Partition 0..255 into equivalence classes no edge distinguishes.
+
+    Returns (class_of (256,) int32, n_classes)."""
+    distinct: list[frozenset[int]] = []
+    seen: set[frozenset[int]] = set()
+    for state_edges in nfa.edges:
+        for byte_class, _t in state_edges:
+            if byte_class not in seen:
+                seen.add(byte_class)
+                distinct.append(byte_class)
+    signature: dict[tuple[bool, ...], int] = {}
+    class_of = np.zeros(256, np.int32)
+    for byte in range(256):
+        sig = tuple(byte in c for c in distinct)
+        if sig not in signature:
+            signature[sig] = len(signature)
+        class_of[byte] = signature[sig]
+    return class_of, len(signature)
+
+
+@dataclass
+class ByteDFA:
+    """A deterministic byte automaton: dense transition table + accepts.
+
+    ``table[s, b]`` is the next state for byte ``b`` or ``n_states``
+    (the implicit dead sink — kept OUT of the state array so masks and
+    transition rows never spend a row on it)."""
+
+    table: np.ndarray  # (n_states, 256) int32; value n_states = dead
+    accepts: np.ndarray  # (n_states,) bool
+    start: int
+
+    @property
+    def n_states(self) -> int:
+        return int(self.table.shape[0])
+
+
+def _eps_closure(nfa: ByteNFA, states: frozenset[int]) -> frozenset[int]:
+    out = set(states)
+    stack = list(states)
+    while stack:
+        s = stack.pop()
+        for t in nfa.eps[s]:
+            if t not in out:
+                out.add(t)
+                stack.append(t)
+    return frozenset(out)
+
+
+def determinize(nfa: ByteNFA, start: int, accept: int, max_states: int) -> ByteDFA:
+    """Subset construction over byte equivalence classes."""
+    class_of, n_classes = byte_classes(nfa)
+    # One representative byte per class for the move computation.
+    rep: list[int] = [0] * n_classes
+    for byte in range(255, -1, -1):
+        rep[int(class_of[byte])] = byte
+
+    start_set = _eps_closure(nfa, frozenset((start,)))
+    index: dict[frozenset[int], int] = {start_set: 0}
+    order: list[frozenset[int]] = [start_set]
+    rows: list[np.ndarray] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = np.full(n_classes, -1, np.int64)
+        for ci in range(n_classes):
+            byte = rep[ci]
+            moved: set[int] = set()
+            for s in cur:
+                for byte_class, t in nfa.edges[s]:
+                    if byte in byte_class:
+                        moved.add(t)
+            if not moved:
+                continue
+            closed = _eps_closure(nfa, frozenset(moved))
+            if closed not in index:
+                if len(order) >= max_states:
+                    raise GrammarTooComplexError(max_states)
+                index[closed] = len(order)
+                order.append(closed)
+            row[ci] = index[closed]
+        rows.append(row)
+
+    n = len(order)
+    class_table = np.stack(rows).astype(np.int64)  # (n, n_classes), -1 dead
+    class_table[class_table < 0] = n
+    table = class_table[:, class_of].astype(np.int32)  # expand to (n, 256)
+    accepts = np.asarray([accept in subset for subset in order], bool)
+    return ByteDFA(table=table, accepts=accepts, start=0)
+
+
+def prefix_accepts(dfa: ByteDFA, data: bytes) -> bool:
+    """Whether ``data`` is a live prefix of the DFA's language — the
+    test helper for outputs truncated by max_tokens."""
+    cur = dfa.start
+    n = dfa.n_states
+    for byte in data:
+        cur = int(dfa.table[cur, byte])
+        if cur >= n:
+            return False
+    return True

@@ -251,6 +251,45 @@ def test_fleet_routing_instruments_registered_with_expected_shapes():
     assert load.ttl > 0  # stale reports age out of the exposition
 
 
+def test_structured_instruments_registered_with_expected_shapes():
+    """ISSUE 13: the structured-outputs surface must expose exactly the
+    advertised names — the acceptance criteria and dashboards key on
+    them."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    constrained = by_name["engine.constrained_requests"]
+    assert isinstance(constrained, Counter)
+    assert constrained.label_names == ("gen_ai_request_model", "outcome")
+    assert constrained.unit == "{request}"
+    compile_h = by_name["engine.schema_compile.duration"]
+    assert isinstance(compile_h, Histogram)
+    assert compile_h.label_names == ("gen_ai_request_model",)
+    assert compile_h.unit == "s"
+    lookups = by_name["engine.mask_cache.lookups"]
+    assert isinstance(lookups, Counter)
+    assert lookups.label_names == ("gen_ai_request_model", "result")
+    assert lookups.unit == "{lookup}"
+    # A cache hit counts on the lookup counter only; a miss records the
+    # compile time too.
+    otel.record_schema_compile("m", 0.02, cache_hit=True)
+    otel.record_schema_compile("m", 0.02, cache_hit=False)
+    assert compile_h.total_count() == 1
+    assert lookups.values()[("m", "hit")] == 1
+    assert lookups.values()[("m", "miss")] == 1
+    otel.record_constrained_request("m", "stop")
+    assert constrained.values()[("m", "stop")] == 1
+
+
+def test_noop_structured_recorders_record_nothing():
+    """NoopTelemetry drift guard for the ISSUE 13 recorders."""
+    noop = NoopTelemetry()
+    noop.record_constrained_request("m", "stop")
+    noop.record_schema_compile("m", 0.5, cache_hit=False)
+    assert noop.constrained_requests_counter.values() == {}
+    assert noop.mask_cache_counter.values() == {}
+    assert noop.schema_compile_duration.total_count() == 0
+
+
 def test_noop_fleet_recorders_record_nothing():
     """NoopTelemetry drift guard for the ISSUE 11 recorders."""
     noop = NoopTelemetry()

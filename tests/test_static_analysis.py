@@ -442,6 +442,54 @@ def test_jax_hot_path_covers_mixed_descriptor_assembly():
                 select="jax-hot-path") == []
 
 
+def test_jax_hot_path_covers_structured_mask_upload_path():
+    """ISSUE 13: the grammar mask scatter/upload path is submit-scope —
+    materializing a device table while loading a span (or registering a
+    slot's bias row) serializes the chunk pipeline against the load.
+    Mask ADVANCEMENT lives inside the jitted decode scan, covered by the
+    jit scope."""
+    bad = """
+    import numpy as np
+
+    class StructuredRuntime:
+        def acquire(self, session):
+            rows = session.compiled.automaton.next_state
+            current = np.asarray(self.next_dev)  # materializes = waits
+            current[: rows.shape[0]] = rows
+            return current
+    """
+    findings = lint(bad, path="inference_gateway_tpu/structured/runtime.py",
+                    select="jax-hot-path")
+    assert len(findings) == 1 and "np.asarray" in findings[0].message
+
+    bad_register = """
+    class StructuredRuntime:
+        def register_slot(self, slot, session, logit_bias):
+            checksum = self.bias_dev.sum().item()  # host sync
+            return checksum
+    """
+    findings = lint(bad_register, path="inference_gateway_tpu/structured/runtime.py",
+                    select="jax-hot-path")
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+    good = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    class StructuredRuntime:
+        def acquire(self, session):
+            rows = session.compiled.automaton.next_state + self._base
+            self.next_dev = _scatter_rows(self.next_dev, jnp.asarray(rows),
+                                          jnp.int32(self._base))
+            return self._base
+
+        def stats(self):
+            return {"spans": len(self._spans)}
+    """
+    assert lint(good, path="inference_gateway_tpu/structured/runtime.py",
+                select="jax-hot-path") == []
+
+
 # ----------------------------------------------------------------------
 # telemetry-noop-drift
 # ----------------------------------------------------------------------

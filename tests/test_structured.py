@@ -1,0 +1,383 @@
+"""Structured outputs (ISSUE 13): grammar/automaton unit matrix.
+
+Fast tier — no engines, no JAX programs: the byte-level grammar
+compiler, the token-mask automaton (including escapes spanning token
+merges on a synthetic multi-byte vocab), the schema-hash cache, the
+per-request session mirror, mask packing, schema validation of the
+request surface, and the providers-forwarding audit (response_format /
+logit_bias pass through the gateway to upstreams verbatim).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from inference_gateway_tpu.structured.automaton import TokenAutomaton, pack_mask, token_byte_table
+from inference_gateway_tpu.structured.compiler import (
+    GrammarCompiler,
+    GrammarSession,
+    UnsupportedSchemaError,
+)
+from inference_gateway_tpu.structured.grammar import prefix_accepts
+from inference_gateway_tpu.serving.tokenizer import ByteTokenizer
+
+VOCAB = 256
+
+
+def _compiler(max_states: int = 4095) -> GrammarCompiler:
+    tok = ByteTokenizer()
+    return GrammarCompiler(token_byte_table(tok, VOCAB), VOCAB,
+                           tok.eos_token_id, max_states=max_states)
+
+
+def _compile(schema) -> GrammarSession:
+    compiled = _compiler().compile_response_format(
+        {"type": "json_schema", "json_schema": {"name": "t", "schema": schema}})
+    assert compiled is not None
+    return GrammarSession(compiled)
+
+
+def _walk(session: GrammarSession, data: bytes) -> bool:
+    for byte in data:
+        if session.feed(byte) == "end":
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Schema matrix: nesting, enums, required keys, arrays, alternation
+# ---------------------------------------------------------------------------
+OBJ = {"type": "object",
+       "properties": {"kind": {"enum": ["alpha", "beta", 3, None]},
+                      "inner": {"type": "object",
+                                "properties": {"q": {"type": "boolean"},
+                                               "r": {"type": "number"}},
+                                "required": ["q"]},
+                      "opt": {"type": "string", "maxLength": 4},
+                      "tags": {"type": "array", "items": {"enum": ["x", "y"]},
+                               "minItems": 1, "maxItems": 3}},
+       "required": ["kind", "inner"]}
+
+
+@pytest.mark.parametrize("doc", [
+    b'{"kind":"alpha","inner":{"q":true}}',
+    b'{"kind": 3, "inner": {"q": false, "r": -1.5e3}, "opt": "hi"}',
+    b'{"kind":null,"inner":{"q":true},"tags":["x","y","x"]}',
+    b'{"kind":"beta","inner":{"q":true},"opt":"","tags":["y"]}',
+])
+def test_matrix_accepts_conforming_documents(doc):
+    s = _compile(OBJ)
+    assert _walk(s, doc), doc
+    assert s.complete()
+
+
+@pytest.mark.parametrize("doc", [
+    b'{"inner":{"q":true}}',          # missing required "kind" (wrong order)
+    b'{"kind":"gamma"',               # enum violation
+    b'{"kind":"alpha","inner":{}}',   # missing required nested "q"
+    b'{"kind":"alpha","inner":{"q":true},"tags":[]}',    # minItems
+    b'{"kind":"alpha","inner":{"q":true},"opt":"12345"', # maxLength
+    b'{"kind":"alpha","inner":{"q":1}}',                 # type violation
+    b'{"tags":["x"],"kind":"alpha"',  # out-of-properties order
+])
+def test_matrix_rejects_nonconforming_documents(doc):
+    s = _compile(OBJ)
+    ok = _walk(s, doc) and s.complete()
+    assert not ok, doc
+
+
+def test_string_escapes_and_unicode():
+    s = _compile({"type": "string", "maxLength": 32})
+    assert _walk(s, json.dumps("a\"b\\c\né").encode()) and s.complete()
+    s2 = _compile({"type": "string", "maxLength": 8})
+    assert _walk(s2, b'"\\u00E9ok"') and s2.complete()
+    s3 = _compile({"type": "string"})
+    assert not _walk(s3, b'"\\x"')  # invalid escape dies immediately
+
+
+def test_integer_vs_number():
+    assert _walk(_compile({"type": "integer"}), b"-120")
+    s = _compile({"type": "integer"})
+    _walk(s, b"12")
+    assert s.feed(ord(".")) == "end"  # fraction not allowed for integer
+    s2 = _compile({"type": "number"})
+    assert _walk(s2, b"-0.25e+2")
+    # Accepting (a valid number) but not COMPLETE: more exponent digits
+    # could follow, so only EOS/termination decides the document end.
+    assert bool(s2.compiled.automaton.accepts[s2.state])
+
+
+def test_max_items_zero_admits_only_empty_array():
+    """Review regression: the general array construction admits one item
+    regardless of bounds (the first element sits in an optional group
+    whose count covers only the separators); maxItems=0 must compile to
+    the empty-array-only grammar."""
+    s = _compile({"type": "array", "items": {"type": "boolean"}, "maxItems": 0})
+    assert _walk(s, b"[ ]") and s.complete()
+    s2 = _compile({"type": "array", "items": {"type": "boolean"}, "maxItems": 0})
+    assert not (_walk(s2, b"[true]") and s2.complete())
+
+
+def test_oneof_and_const():
+    s = _compile({"oneOf": [{"type": "boolean"}, {"const": {"k": 1}}]})
+    assert _walk(s, b'{"k":1}') and s.complete()
+    s2 = _compile({"oneOf": [{"type": "boolean"}, {"const": {"k": 1}}]})
+    assert _walk(s2, b"false") and s2.complete()
+
+
+@pytest.mark.parametrize("schema,reason_fragment", [
+    ({"$ref": "#/defs/x"}, "$ref"),
+    ({"type": "string", "pattern": "a+"}, "pattern"),
+    ({"type": "object", "patternProperties": {"a": {}}}, "patternProperties"),
+    ({"allOf": [{"type": "string"}, {"type": "number"}]}, "allOf"),
+    ({"type": "object", "properties": {"a": {}}, "required": ["b"]}, "required"),
+    ({"type": "frobnicate"}, "frobnicate"),
+])
+def test_unsupported_schemas_raise(schema, reason_fragment):
+    with pytest.raises(UnsupportedSchemaError) as err:
+        _compile(schema)
+    assert reason_fragment in str(err.value)
+
+
+def test_state_budget_overflow_is_unsupported():
+    comp = _compiler(max_states=10)
+    with pytest.raises(UnsupportedSchemaError, match="state"):
+        comp.compile_response_format(
+            {"type": "json_schema",
+             "json_schema": {"name": "t", "schema": OBJ}})
+
+
+# ---------------------------------------------------------------------------
+# Token automaton: escapes spanning token merges (multi-byte vocab)
+# ---------------------------------------------------------------------------
+def test_escape_spanning_token_merges():
+    """A synthetic vocab where escape sequences split across token
+    boundaries in every way: the automaton must allow exactly the tokens
+    whose BYTE path lives, regardless of where the merge boundaries
+    fall."""
+    pieces = [b'"', b"\\", b"u", b"00", b"4", b"A", b'\\u0', b'041"', b"ab",
+              b'a"', b"\\n", b"zz\\", b'u"', b""]
+    compiled = GrammarCompiler(pieces, len(pieces), eos_id=-1, max_states=512) \
+        ._compile("json_schema", {"type": "string", "maxLength": 16})
+    auto = compiled.automaton
+    tid = {p: i for i, p in enumerate(pieces)}
+
+    s = auto.start
+    assert auto.allows(s, tid[b'"'])
+    s = auto.advance(s, tid[b'"'])
+    # Inside the string: a token holding HALF an escape ('zz\') is legal
+    # — its bytes end mid-escape, a live DFA path.
+    assert auto.allows(s, tid[b"zz\\"])
+    mid = auto.advance(s, tid[b"zz\\"])
+    # From mid-escape, only escape continuations live: 'u' yes, 'ab' no.
+    assert auto.allows(mid, tid[b"u"])
+    assert not auto.allows(mid, tid[b"ab"])
+    # Full split escape: '\' + 'u' + '00' + '4' + 'A'.
+    cur = s
+    for piece in (b"\\", b"u", b"00", b"4", b"A"):
+        assert auto.allows(cur, tid[piece]), piece
+        cur = auto.advance(cur, tid[piece])
+    # Merged prefix token '\u0' followed by '041"' closes the string.
+    cur = auto.advance(s, tid[b'\\u0'])
+    assert auto.allows(cur, tid[b'041"'])
+    closed = auto.advance(cur, tid[b'041"'])
+    assert auto.accepts[closed]
+    # Zero-byte tokens are never allowed (no progress = no mask bit).
+    assert not auto.allows(s, tid[b""])
+
+
+def test_token_walk_matches_scalar_reference():
+    """The vectorized (state x token) walk must equal a per-pair scalar
+    DFA simulation."""
+    tok = ByteTokenizer()
+    comp = _compiler()
+    compiled = comp.compile_response_format({"type": "json_object"})
+    auto = compiled.automaton
+    rng = random.Random(7)
+    table = comp._cache[compiled.schema_hash].automaton  # same object
+    assert table is auto
+    # Reference walk through the raw DFA for sampled (state, token) pairs.
+    from inference_gateway_tpu.structured.grammar import ByteNFA  # noqa: F401
+    for _ in range(200):
+        state = rng.randrange(auto.n_states)
+        token = rng.randrange(VOCAB)
+        allowed = auto.allows(state, token)
+        nxt = auto.advance(state, token)
+        if allowed:
+            assert 0 <= nxt < auto.n_states
+        else:
+            assert nxt == auto.n_states
+
+
+def test_pack_mask_layout():
+    allowed = np.zeros((2, 70), bool)
+    allowed[0, [0, 31, 32, 69]] = True
+    allowed[1, 33] = True
+    packed = pack_mask(allowed)
+    assert packed.shape == (2, 3)
+    assert packed[0, 0] == (1 | (1 << 31))
+    assert packed[0, 1] == 1
+    assert packed[0, 2] == (1 << 5)
+    assert packed[1, 1] == 2
+
+
+def test_packed_mask_bias_unpacks_exactly():
+    jnp = pytest.importorskip("jax.numpy")
+    from inference_gateway_tpu.ops.sampling import MASK_NEG, packed_mask_bias
+
+    rng = np.random.default_rng(3)
+    allowed = rng.random((4, 100)) < 0.3
+    allowed[:, 0] = True
+    bias = np.asarray(packed_mask_bias(jnp.asarray(pack_mask(allowed)), 100))
+    assert bias.shape == (4, 100)
+    assert (bias[allowed] == 0).all()
+    assert (bias[~allowed] == MASK_NEG).all()
+
+
+# ---------------------------------------------------------------------------
+# Session mirror, cache, proposal repair
+# ---------------------------------------------------------------------------
+def test_session_completion_and_overrun():
+    s = _compile({"type": "boolean"})
+    for byte in b"tru":
+        assert s.feed(byte) == "ok"
+    assert s.feed(ord("e")) == "complete"
+    assert s.complete()
+    assert s.feed(ord("x")) == "end"  # junk past completion carries nothing
+
+
+def test_session_fast_forward_and_peek():
+    s = _compile(OBJ)
+    prefix = list(b'{"kind":"alpha",')
+    assert s.fast_forward(prefix)
+    assert s.consumed == len(prefix)
+    peeked = s.peek_global_after(ord('"'))
+    before = s.state
+    assert s.feed(ord('"')) == "ok"
+    assert s.base + s.state == peeked
+    assert s.state != before
+    bad = _compile(OBJ)
+    assert not bad.fast_forward(list(b'{"nope"'))
+
+
+def test_session_filter_proposal_repairs_violations():
+    s = _compile({"type": "boolean"})
+    repaired = s.filter_proposal([ord("t"), ord("x"), ord("z")])
+    assert len(repaired) == 3
+    assert repaired[0] == ord("t")
+    # Walk the repaired proposal: it must be grammar-live end to end.
+    probe = _compile({"type": "boolean"})
+    for token in repaired:
+        assert probe.feed(token) != "end"
+
+
+def test_compile_cache_hits_and_lru():
+    comp = _compiler()
+    a = comp.compile_response_format(
+        {"type": "json_schema", "json_schema": {"name": "a", "schema": {"type": "boolean"}}})
+    b = comp.compile_response_format(
+        {"type": "json_schema", "json_schema": {"name": "b", "schema": {"type": "boolean"}}})
+    assert a is b  # keyed by schema hash, not wrapper name
+    assert comp.cache_hits == 1 and comp.cache_misses == 1
+    comp.cache_size = 1
+    comp.compile_response_format({"type": "json_object"})
+    assert len(comp._cache) == 1  # LRU evicted the boolean grammar
+    stats = comp.stats()
+    assert stats["cache_misses"] == 2 and stats["compile_seconds_total"] > 0
+
+
+def test_text_and_absent_formats_compile_to_none():
+    comp = _compiler()
+    assert comp.compile_response_format(None) is None
+    assert comp.compile_response_format({"type": "text"}) is None
+    with pytest.raises(UnsupportedSchemaError):
+        comp.compile_response_format({"type": "xml"})
+
+
+def test_json_object_prefix_validity():
+    comp = _compiler()
+    compiled = comp.compile_response_format({"type": "json_object"})
+    # Any cut of a valid document is a live prefix; garbage is not.
+    doc = b'{"a": [1, {"b": "c"}], "d": null}'
+    dfa_walk = GrammarSession(compiled)
+    for i, byte in enumerate(doc):
+        assert dfa_walk.feed(byte) != "end", doc[:i + 1]
+    assert dfa_walk.complete()
+    s2 = GrammarSession(compiled)
+    assert s2.feed(ord("p")) == "end"
+
+
+def test_prefix_accepts_helper():
+    from inference_gateway_tpu.structured.grammar import ByteNFA, determinize
+
+    nfa = ByteNFA()
+    start = nfa.new_state()
+    end = nfa.lit(start, b"abc")
+    dfa = determinize(nfa, start, end, 16)
+    assert prefix_accepts(dfa, b"ab")
+    assert prefix_accepts(dfa, b"abc")
+    assert not prefix_accepts(dfa, b"ax")
+
+
+# ---------------------------------------------------------------------------
+# Request-surface validation + providers forwarding audit
+# ---------------------------------------------------------------------------
+def test_chat_schema_validates_response_format_shapes():
+    from inference_gateway_tpu.api.validation import validate_chat_request
+
+    base = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+    ok = dict(base, response_format={"type": "json_schema",
+                                     "json_schema": {"name": "n", "schema": {}}})
+    assert validate_chat_request(ok) == []
+    assert validate_chat_request(dict(base, response_format={"type": "json_object"})) == []
+    assert validate_chat_request(dict(base, logit_bias={"65": 10})) == []
+    bad = dict(base, response_format={"type": "json_schema", "json_schema": {}})
+    assert any("name" in p for p in validate_chat_request(bad))
+    bad2 = dict(base, logit_bias={"65": "high"})
+    assert validate_chat_request(bad2)
+
+
+async def test_provider_forwards_response_format_verbatim():
+    """ISSUE 13 satellite: non-TPU providers receive response_format and
+    logit_bias untouched — the gateway's posture is verbatim forwarding
+    (Anthropic's OpenAI-compat chat endpoint enforces them natively; the
+    native /v1/messages passthrough is documented as a gap)."""
+    from inference_gateway_tpu.netio.server import Headers
+    from inference_gateway_tpu.providers.core import Provider
+    from inference_gateway_tpu.providers.registry import REGISTRY
+
+    captured = {}
+
+    class _Client:
+        async def post(self, url, body, headers=None, timeout=None,
+                       stream=False, traceparent=None):
+            captured["url"] = url
+            captured["body"] = json.loads(body)
+
+            class _Resp:
+                status = 200
+                headers = Headers()
+                body_bytes = b"{}"
+
+                def json(self):
+                    return {"choices": []}
+            return _Resp()
+
+    for pid in ("anthropic", "openai", "groq"):
+        provider = Provider(REGISTRY[pid].copy(), _Client())
+        req = {"model": "m", "messages": [{"role": "user", "content": "x"}],
+               "response_format": {"type": "json_schema",
+                                   "json_schema": {"name": "n",
+                                                   "schema": {"type": "object"}}},
+               "logit_bias": {"65": 10}}
+        await provider.chat_completions(dict(req))
+        assert captured["body"]["response_format"] == req["response_format"], pid
+        assert captured["body"]["logit_bias"] == req["logit_bias"], pid
+        # The streaming transform adds stream options, nothing else drops.
+        streaming = provider._prepare_streaming_request(dict(req))
+        assert streaming["response_format"] == req["response_format"], pid
+        assert streaming["logit_bias"] == req["logit_bias"], pid

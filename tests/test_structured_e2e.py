@@ -1,0 +1,422 @@
+"""Structured outputs (ISSUE 13): serving-surface acceptance.
+
+Engine tier — real CPU engines behind a real SidecarServer:
+
+- streamed `/v1/chat/completions` with response_format json_schema
+  yields SSE whose combined content parses AND validates against the
+  schema, with usage/metrics/finish semantics unchanged;
+- the same guarantee with speculative decoding (prompt-lookup AND
+  model-draft) — and the greedy constrained stream is byte-identical
+  across every serving mode;
+- a mid-stream continuation splice of a constrained stream resumes
+  byte-identically (the session fast-forwards the resume token ids);
+- logit_bias pins the biased token; out-of-vocab ids 400;
+- uncompilable schemas fast-fail 400 code:unsupported_schema;
+- seeded fuzz: random small schemas x random temperatures → every
+  completed output json.loads-parses and validates against its schema;
+- the slow-marked bench gate: constrained TPOT p99 within 10% of
+  unconstrained.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from inference_gateway_tpu.api.validation import validate
+from inference_gateway_tpu.netio.client import HTTPClient
+from inference_gateway_tpu.otel.otel import OpenTelemetry
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.server import SidecarServer
+
+SCHEMA = {"type": "object",
+          "properties": {"name": {"type": "string", "maxLength": 8},
+                         "age": {"type": "integer"},
+                         "tags": {"type": "array", "items": {"enum": ["a", "b"]},
+                                  "maxItems": 2}},
+          "required": ["name", "age"]}
+RESPONSE_FORMAT = {"type": "json_schema",
+                   "json_schema": {"name": "person", "schema": SCHEMA}}
+
+
+def _chat_body(max_tokens=160, stream=True, **extra) -> dict:
+    return {"model": "test-tiny", "stream": stream, "temperature": 0,
+            "max_tokens": max_tokens,
+            "stream_options": {"include_usage": True},
+            "messages": [{"role": "user", "content": "emit json"}], **extra}
+
+
+async def _post(port, body: dict, stream: bool):
+    client = HTTPClient()
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                             json.dumps(body).encode(), stream=stream)
+    if not stream:
+        return resp
+    out = b""
+    async for block in resp.iter_raw():
+        out += block
+    return resp.status, out
+
+
+def _parse_frames(body: bytes):
+    frames = []
+    for part in body.split(b"\n\n"):
+        part = part.strip()
+        if not part.startswith(b"data:"):
+            continue
+        payload = part[5:].strip()
+        frames.append((part + b"\n\n",
+                       None if payload == b"[DONE]" else json.loads(payload)))
+    return frames
+
+
+def _content_of(frames) -> str:
+    return "".join(
+        (ev["choices"][0].get("delta") or {}).get("content") or ""
+        for _raw, ev in frames
+        if ev and ev.get("choices"))
+
+
+@pytest.fixture(scope="module")
+def stack(aloop):
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=256,
+                                 dtype="float32", max_prefill_batch=2,
+                                 use_mesh=False, decode_chunk=4))
+    otel = OpenTelemetry()
+    sidecar = SidecarServer(engine, served_model_name="test-tiny", otel=otel,
+                            accounting_enable=False)
+    port = aloop.run(sidecar.start("127.0.0.1", 0))
+    yield sidecar, port, otel
+    aloop.run(sidecar.shutdown())
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: streamed json_schema → parses + validates, semantics intact
+# ---------------------------------------------------------------------------
+async def test_streamed_json_schema_parses_and_validates(stack):
+    sidecar, port, otel = stack
+    status, raw = await _post(port, _chat_body(response_format=RESPONSE_FORMAT),
+                              stream=True)
+    assert status == 200
+    frames = _parse_frames(raw)
+    assert frames[-1][1] is None  # [DONE] still terminates the stream
+    text = _content_of(frames)
+    doc = json.loads(text)
+    assert validate(doc, "S", schemas={"S": SCHEMA}) == []
+    finish = [ev["choices"][0]["finish_reason"] for _raw, ev in frames
+              if ev and ev.get("choices") and ev["choices"][0].get("finish_reason")]
+    assert finish == ["stop"]
+    usage = next(ev["usage"] for _raw, ev in frames if ev and ev.get("usage"))
+    assert usage["total_tokens"] == usage["prompt_tokens"] + usage["completion_tokens"]
+    assert usage["completion_tokens"] > 0
+    # Observability satellite: outcome counter + cache instruments moved.
+    assert otel.constrained_requests_counter.values().get(("test-tiny", "stop"), 0) >= 1
+    assert sum(otel.mask_cache_counter.values().values()) >= 1
+
+
+async def test_non_streaming_json_object_mode(stack):
+    sidecar, port, _otel = stack
+    resp = await _post(port, _chat_body(stream=False, max_tokens=200,
+                                        response_format={"type": "json_object"}),
+                       stream=False)
+    assert resp.status == 200
+    content = resp.json()["choices"][0]["message"]["content"]
+    # json_object constrains to valid JSON; greedy random weights may hit
+    # max_tokens mid-document, so assert prefix-validity via the session.
+    session = sidecar.engine.structured.session_for({"type": "json_object"})
+    for byte in content.encode("utf-8", errors="ignore"):
+        assert session.feed(byte) != "end"
+
+
+async def test_unconstrained_traffic_unchanged_after_masked_recompile(stack):
+    sidecar, port, _otel = stack
+    status, raw = await _post(port, _chat_body(max_tokens=8), stream=True)
+    assert status == 200
+    frames = _parse_frames(raw)
+    assert len(_content_of(frames)) > 0
+    assert frames[-1][1] is None
+
+
+async def test_unsupported_schema_fast_fails_400(stack):
+    _sidecar, port, _otel = stack
+    bad = {"type": "json_schema",
+           "json_schema": {"name": "x", "schema": {"$ref": "#/nope"}}}
+    resp = await _post(port, _chat_body(response_format=bad), stream=False)
+    assert resp.status == 400
+    err = resp.json()["error"]
+    assert err["code"] == "unsupported_schema"
+    assert err["param"] == "response_format"
+    # No slot/page was ever allocated.
+    assert _sidecar.scheduler.active_requests() == 0
+
+
+async def test_logit_bias_pins_token_and_rejects_out_of_vocab(stack):
+    sidecar, port, _otel = stack
+    # +100 on byte 'A' dominates every step of an unconstrained stream.
+    resp = await _post(port, _chat_body(stream=False, max_tokens=6,
+                                        logit_bias={"65": 100}),
+                       stream=False)
+    assert resp.status == 200
+    assert resp.json()["choices"][0]["message"]["content"] == "A" * 6
+    # Out-of-vocab id (vocab 256) → structured 400.
+    resp = await _post(port, _chat_body(stream=False, logit_bias={"9000": 5}),
+                       stream=False)
+    assert resp.status == 400
+    err = resp.json()["error"]
+    assert err["code"] == "invalid_logit_bias"
+    assert err["vocab_size"] == 256
+
+
+async def test_structured_surfaces_in_metrics_and_status(stack):
+    _sidecar, port, _otel = stack
+    client = HTTPClient()
+    status = (await client.get(f"http://127.0.0.1:{port}/debug/status")).json()
+    assert status["structured"]["live"] is True
+    assert status["structured"]["cache_misses"] >= 1
+    metrics = (await client.get(f"http://127.0.0.1:{port}/metrics")).json()
+    assert metrics["structured"]["states_budget"] == 4096
+    prom = await client.get(f"http://127.0.0.1:{port}/metrics?format=prometheus")
+    assert b"tpu_sidecar_structured_cache_hits" in prom.body
+
+
+# ---------------------------------------------------------------------------
+# Continuation splice: constrained stream resumes byte-identical
+# ---------------------------------------------------------------------------
+async def test_constrained_continuation_splice_byte_identical(stack):
+    sidecar, port, _otel = stack
+    body = _chat_body(response_format=RESPONSE_FORMAT)
+    _status, full = await _post(port, body, stream=True)
+    frames = _parse_frames(full)
+    content = [(raw, ev) for raw, ev in frames
+               if ev and ev.get("choices")
+               and (ev["choices"][0].get("delta") or {}).get("content")]
+    assert len(content) >= 4
+    cid = frames[0][1]["id"]
+    created = frames[0][1]["created"]
+
+    k = 3
+    prefix_text = _content_of(content[:k])
+    ids = sidecar.engine.tokenizer.encode(prefix_text, add_bos=False)
+    _status, continued = await _post(port, dict(body, continuation={
+        "token_ids": ids, "id": cid, "created": created}), stream=True)
+    content_positions = [i for i, (_raw, ev) in enumerate(frames)
+                         if ev and ev.get("choices")
+                         and (ev["choices"][0].get("delta") or {}).get("content")]
+    cut = content_positions[k - 1]
+    assert continued == frames[0][0] + b"".join(raw for raw, _ev in frames[cut + 1:])
+    # The spliced logical stream is the SAME valid document.
+    assert prefix_text + _content_of(_parse_frames(continued)) == _content_of(frames)
+
+
+async def test_constrained_continuation_with_invalid_prefix_400(stack):
+    _sidecar, port, _otel = stack
+    resp = await _post(port, _chat_body(
+        response_format=RESPONSE_FORMAT,
+        continuation={"token_ids": [ord("p")], "id": "x", "created": 5}),
+        stream=False)
+    assert resp.status == 400
+    assert resp.json()["error"]["code"] == "invalid_continuation"
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding: grammar holds, greedy streams byte-identical
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("spec_cfg", [
+    {"spec_draft": "ngram", "spec_k": 3},
+    {"spec_draft": "test-tiny", "spec_k": 2},
+])
+async def test_constrained_speculative_matches_plain(stack, spec_cfg, aloop):
+    _sidecar, port, _otel = stack
+    _status, plain_raw = await _post(
+        port, _chat_body(response_format=RESPONSE_FORMAT), stream=True)
+    plain_text = _content_of(_parse_frames(plain_raw))
+
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=2, max_seq_len=256,
+                                 dtype="float32", max_prefill_batch=2,
+                                 use_mesh=False, **spec_cfg))
+    spec_sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                                 accounting_enable=False)
+    spec_port = await spec_sidecar.start("127.0.0.1", 0)
+    try:
+        _status, raw = await _post(
+            spec_port, _chat_body(response_format=RESPONSE_FORMAT), stream=True)
+        text = _content_of(_parse_frames(raw))
+    finally:
+        await spec_sidecar.shutdown()
+    doc = json.loads(text)
+    assert validate(doc, "S", schemas={"S": SCHEMA}) == []
+    # Same weights (same seed/preset), greedy: acceptance may not change
+    # the stream — byte-identical across serving modes.
+    assert text == plain_text
+
+
+# ---------------------------------------------------------------------------
+# Gateway e2e: Fault.cut_stream mid-constrained-stream → spliced
+# byte-identical (the ISSUE 13 acceptance composition with PR 9)
+# ---------------------------------------------------------------------------
+# Enum-only values keep the greedy output pure ASCII, so the gateway's
+# TEXT-based continuation prefix re-encodes losslessly (binary-garbage
+# strings from random weights would not round-trip through the splice's
+# text accumulation; planned migrations use exact token ids instead).
+ASCII_SCHEMA = {"type": "object",
+                "properties": {"color": {"enum": ["red", "green", "blue"]},
+                               "size": {"enum": ["s", "m", "l"]},
+                               "ok": {"type": "boolean"}},
+                "required": ["color", "size", "ok"]}
+
+
+@pytest.fixture(scope="module")
+def gw_stack(aloop, tmp_path_factory):
+    from inference_gateway_tpu.main import build_gateway
+
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=256,
+                                 dtype="float32", max_prefill_batch=2,
+                                 use_mesh=False, decode_chunk=2))
+    sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                            accounting_enable=False)
+    sidecar_port = aloop.run(sidecar.start("127.0.0.1", 0))
+    pools_yaml = tmp_path_factory.mktemp("pools") / "pools.yaml"
+    pools_yaml.write_text(
+        "pools:\n"
+        "  - model: pool-tiny\n"
+        "    deployments:\n"
+        "      - {provider: tpu, model: test-tiny}\n"
+        "      - {provider: tpu, model: test-tiny}\n")
+    env = {
+        "TPU_API_URL": f"http://127.0.0.1:{sidecar_port}/v1",
+        "ROUTING_ENABLED": "true",
+        "ROUTING_CONFIG_PATH": str(pools_yaml),
+        "SERVER_PORT": "0",
+        "TELEMETRY_METRICS_PORT": "0",
+        "RESILIENCE_PROBE_ENABLED": "false",
+    }
+    gw = build_gateway(env=env)
+    gw_port = aloop.run(gw.start("127.0.0.1", 0))
+    yield gw, gw_port
+    aloop.run(gw.shutdown())
+    aloop.run(sidecar.shutdown())
+
+
+async def _gateway_stream(port, body: dict) -> bytes:
+    client = HTTPClient()
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                             json.dumps(body).encode(), stream=True)
+    assert resp.status == 200
+    out = b""
+    async for block in resp.iter_raw():
+        out += block
+    return out
+
+
+async def test_cut_stream_constrained_splice_byte_identical(gw_stack):
+    """Greedy constrained stream killed mid-flight (Fault.cut_stream on
+    the gateway↔sidecar relay) splices onto the pool's next candidate
+    byte-identically — the continuation's grammar session fast-forwards
+    the relayed prefix, so the spliced document equals the unkilled
+    one's bytes (modulo the per-run completion id/created stamp)."""
+    from inference_gateway_tpu.netio import sse
+    from inference_gateway_tpu.resilience.faults import Fault, FaultInjectingClient, FaultScript
+
+    gw, port = gw_stack
+    body = _chat_body(max_tokens=80, response_format={
+        "type": "json_schema",
+        "json_schema": {"name": "ascii", "schema": ASCII_SCHEMA}})
+    body["model"] = "pool-tiny"
+
+    unkilled = await _gateway_stream(port, body)
+    assert sse.DONE_FRAME in unkilled
+    text = _content_of(_parse_frames(unkilled))
+    assert validate(json.loads(text), "A", schemas={"A": ASCII_SCHEMA}) == []
+    assert text.encode("ascii")  # the lossless-splice precondition
+
+    script = (FaultScript()
+              .script("/proxy/tpu/", Fault.cut_stream(after_frames=4))
+              .default("/proxy/tpu/", Fault.passthrough()))
+    real_client = gw.router_impl.client
+    gw.router_impl.client = FaultInjectingClient(script, inner=real_client)
+    try:
+        killed = await _gateway_stream(port, body)
+    finally:
+        gw.router_impl.client = real_client
+
+    def normalize(raw: bytes) -> bytes:
+        frames = _parse_frames(raw)
+        ids = {ev["id"] for _r, ev in frames if ev and ev.get("id")}
+        created = {ev["created"] for _r, ev in frames if ev and "created" in ev}
+        assert len(ids) == 1 and len(created) == 1, (ids, created)
+        return (raw.replace(ids.pop().encode(), b"ID")
+                   .replace(b'"created":%d' % created.pop(), b'"created":0'))
+
+    assert normalize(killed) == normalize(unkilled)
+    kinds = [k for _t, k, _u in script.log]
+    assert kinds[0] == "cut" and "passthrough" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Seeded fuzz: random schemas x temperatures → parse + validate
+# ---------------------------------------------------------------------------
+def _random_schema(rng: random.Random) -> dict:
+    def leaf():
+        kind = rng.choice(["enum", "string", "integer", "boolean", "null"])
+        if kind == "enum":
+            values = rng.sample(["red", "green", "blue", 1, 2, True, None], k=rng.randint(2, 4))
+            return {"enum": values}
+        if kind == "string":
+            return {"type": "string", "maxLength": rng.randint(1, 6)}
+        return {"type": kind}
+
+    def value(depth):
+        roll = rng.random()
+        if depth <= 0 or roll < 0.5:
+            return leaf()
+        if roll < 0.75:
+            return {"type": "array", "items": value(depth - 1),
+                    "minItems": rng.randint(0, 1), "maxItems": rng.randint(1, 3)}
+        return obj(depth - 1)
+
+    def obj(depth):
+        keys = rng.sample(["alpha", "beta", "gamma", "delta"], k=rng.randint(1, 3))
+        props = {k: value(depth) for k in keys}
+        required = [k for k in keys if rng.random() < 0.7]
+        return {"type": "object", "properties": props, "required": required}
+
+    return obj(depth=2)
+
+
+async def test_fuzz_random_schemas_random_temperatures(stack):
+    sidecar, port, _otel = stack
+    rng = random.Random(20260804)
+    for case in range(8):
+        schema = _random_schema(rng)
+        temperature = rng.choice([0.0, 0.7, 1.2])
+        body = _chat_body(stream=False, max_tokens=220, response_format={
+            "type": "json_schema", "json_schema": {"name": f"fuzz{case}",
+                                                   "schema": schema}})
+        body["temperature"] = temperature
+        body["seed"] = case
+        resp = await _post(port, body, stream=False)
+        assert resp.status == 200, (case, schema, resp.body)
+        payload = resp.json()
+        assert payload["choices"][0]["finish_reason"] == "stop", (case, schema)
+        text = payload["choices"][0]["message"]["content"]
+        doc = json.loads(text)
+        errors = validate(doc, "F", schemas={"F": schema})
+        assert errors == [], (case, schema, text, errors)
+
+
+# ---------------------------------------------------------------------------
+# Bench gate (slow): constrained TPOT p99 within 10% of unconstrained
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+async def test_bench_structured_overhead_under_gate():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "benchmarks"))
+    import gateway_bench
+
+    result = await gateway_bench.bench_structured_overhead(n=40)
+    assert result["tpot_p99_delta_pct"] is not None
+    assert result["tpot_p99_delta_pct"] < 10.0, result
