@@ -639,6 +639,33 @@ def newest_measured_artifact() -> tuple[dict, str] | None:
     return None
 
 
+def decode_steady_state_numbers() -> dict:
+    """Desynchronized-decode steady state (ISSUE 14) — measured LIVE
+    this run (CPU-only subprocess): host gap between chained chunks
+    (p50/p99, gate: p99 < 1 ms) and early-exit chunk-overrun savings at
+    decode_chunk {8,32,128}. On-chip, the same schema records the
+    decode-step roofline-ratio delta at the next TPU window."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        _progress("live decode steady-state bench (subprocess, CPU)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "benchmarks", "gateway_bench.py"),
+             "--decode-steady-state"],
+            capture_output=True, text=True, timeout=420, cwd=here, env=env,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT="):
+                out = json.loads(line[len("RESULT="):])
+                out["source"] = "live"
+                return out
+        _progress("decode steady-state bench produced no RESULT line: "
+                  f"{(r.stderr or r.stdout)[-200:]}")
+    except Exception as e:
+        _progress(f"decode steady-state bench failed: {type(e).__name__}: {e}")
+    return {"source": "unavailable"}
+
+
 def last_measured_on_chip() -> dict:
     found = newest_measured_artifact()
     if not found:
@@ -698,6 +725,7 @@ def baseline_extras() -> dict:
     except Exception as e:
         extras["compute_efficiency_error"] = f"{type(e).__name__}: {e}"
     extras["relay"] = relay_numbers()
+    extras["decode_steady_state"] = decode_steady_state_numbers()
     extras["last_measured_on_chip"] = last_measured_on_chip()
     try:
         extras["tokens_per_dollar"] = tokens_per_dollar()

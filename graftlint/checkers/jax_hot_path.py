@@ -28,6 +28,19 @@ Two scopes:
    to overlap. (Fetch functions — ``decode_chunk_fetch``,
    ``prefill_fetch``, ``mixed_step_fetch`` — are the designated sync
    points and are not in scope.)
+
+3. **Chain-steady scope** (ISSUE 14): the host-free chained-decode
+   steady state — ``Engine._chain_submit_locked`` whole, plus every
+   ``if chain:`` branch inside ``decode_chunk_submit``. A chained
+   submit must upload NOTHING and assemble NOTHING, so beyond the sync
+   primitives this scope additionally bans **host-array construction**
+   (any ``np.*`` / ``numpy.*`` call, and ``jnp.asarray`` /
+   ``jnp.array`` — whose one legitimate chained use, the amortized
+   page-horizon refresh, lives in ``_reserve_chain_horizon`` outside
+   this scope) and **python loops** (``for`` / ``while`` — a per-slot
+   loop is exactly the per-chunk host work the desynchronized decode
+   tentpole removed; vectorized reads of the persistent host mirror
+   are fine, loops are not).
 """
 
 from __future__ import annotations
@@ -66,6 +79,19 @@ _SYNC_DOTTED = {"jax.device_get"}
 # only host-side numpy materialization forces a blocking readback.
 _NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 
+# Chain-steady scope (ISSUE 14): whole functions forming the host-free
+# chained submit. decode_chunk_submit additionally gets its `if chain:`
+# branches scanned wherever it is defined (relpath suffix -> names).
+CHAIN_STEADY_SCOPES = {
+    "serving/engine.py": {"_chain_submit_locked"},
+}
+# Uploads are banned in the chain-steady scope too: a chained submit
+# that jnp.asarray's host data re-introduces the per-chunk h2d the
+# tentpole removed (the amortized horizon refresh lives in
+# _reserve_chain_horizon, outside this scope).
+_CHAIN_UPLOADS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+                  "jax.numpy.array"}
+
 
 def _is_jit_decorated(fn: ast.AST) -> bool:
     for dec in getattr(fn, "decorator_list", []):
@@ -91,10 +117,12 @@ def _submit_scope_names(mod: ParsedModule) -> set[str]:
 
 
 def _scan(fn: ast.AST, mod: ParsedModule, out: list[Finding], *,
-          jitted: bool) -> None:
+          jitted: bool, exclude: set[int] | None = None) -> None:
     where = ("inside a jitted step function" if jitted
              else "in a submit-path function (dispatch must not wait)")
     for node in ast.walk(fn):
+        if exclude and id(node) in exclude:
+            continue  # already covered by the stricter chain-steady scan
         if not isinstance(node, ast.Call):
             continue
         func = node.func
@@ -119,14 +147,91 @@ def _scan(fn: ast.AST, mod: ParsedModule, out: list[Finding], *,
                  f"concretization error at trace time")
 
 
+def _chain_scope_names(mod: ParsedModule) -> set[str]:
+    for suffix, names in CHAIN_STEADY_SCOPES.items():
+        if mod.path.endswith(suffix):
+            return names
+    return set()
+
+
+def _is_chain_test(test: ast.AST) -> bool:
+    """True for `if chain:` / `if chain and ...:` — the branch whose body
+    is the host-free steady state."""
+    if isinstance(test, ast.Name) and test.id == "chain":
+        return True
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        return any(_is_chain_test(v) for v in test.values)
+    return False
+
+
+def _scan_chain_steady(nodes, mod: ParsedModule, out: list[Finding],
+                       where: str) -> None:
+    """The ISSUE 14 host-free rule set: sync primitives as in the submit
+    scope, PLUS host-array construction (np.* calls, jnp.asarray/array
+    uploads) and python loops — the steady state reads persistent state
+    and dispatches, nothing else."""
+    for top in nodes:
+        for node in ast.walk(top):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                flag(out, mod, CHECKER, node,
+                     f"python loop {where} — per-slot host iteration is "
+                     f"exactly the per-chunk work the host-free steady "
+                     f"state removed (vectorize it, or move it to the "
+                     f"amortized horizon path)")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS \
+                    and not node.args:
+                flag(out, mod, CHECKER, node,
+                     f"host sync '.{func.attr}()' {where}")
+                continue
+            d = dotted_name(func)
+            if d in _SYNC_DOTTED:
+                flag(out, mod, CHECKER, node, f"host sync '{d}(...)' {where}")
+            elif d in _CHAIN_UPLOADS:
+                flag(out, mod, CHECKER, node,
+                     f"'{d}(...)' {where} — a chained submit must upload "
+                     f"nothing; stage device state at chain=False/admission "
+                     f"or in the amortized horizon refresh instead")
+            elif d is not None and (d.startswith("np.") or d.startswith("numpy.")):
+                flag(out, mod, CHECKER, node,
+                     f"host-array construction '{d}(...)' {where} — the "
+                     f"chained steady state may only read the persistent "
+                     f"host mirror, never build arrays per chunk")
+
+
 def check(mod: ParsedModule) -> list[Finding]:
     out: list[Finding] = []
     submit_names = _submit_scope_names(mod)
+    chain_names = _chain_scope_names(mod)
     for fn in ast.walk(mod.tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if _is_jit_decorated(fn):
             _scan(fn, mod, out, jitted=True)
-        elif fn.name in submit_names:
-            _scan(fn, mod, out, jitted=False)
+            continue
+        if fn.name in chain_names:
+            _scan_chain_steady(
+                fn.body, mod, out,
+                "in the host-free chained-submit path (chain-steady scope)")
+            continue
+        chain_covered: set[int] = set()
+        if fn.name == "decode_chunk_submit" and chain_names:
+            # Branch-aware: the `if chain:` bodies are chain-steady even
+            # though the surrounding fresh-submit path legitimately
+            # builds host arrays. Nodes covered here are excluded from
+            # the broader submit scan below so one defect never yields
+            # two findings.
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If) and _is_chain_test(node.test):
+                    _scan_chain_steady(
+                        node.body, mod, out,
+                        "in the chain=True branch of decode_chunk_submit "
+                        "(chain-steady scope)")
+                    for top in node.body:
+                        chain_covered.update(id(n) for n in ast.walk(top))
+        if fn.name in submit_names:
+            _scan(fn, mod, out, jitted=False, exclude=chain_covered)
     return out

@@ -131,6 +131,29 @@ _SERVING_RAGGED_DOC = [
 ]
 
 
+# Emitted under the Serving section: the desynchronized decode steady
+# state (ISSUE 14) in one paragraph; design in docs/performance.md.
+_SERVING_DESYNC_DOC = [
+    "### Host-free decode steady state",
+    "",
+    "`SERVING_DECODE_EARLY_EXIT` (on by default) moves the decode loop's",
+    "control decisions on device: per-slot stop-token tables, max_tokens",
+    "budgets, and the grammar accept-state ride the fused chunk carry, so",
+    "finished slots freeze (no further sampling, KV writes masked) and the",
+    "chunk exits its device loop the moment every slot is done — long",
+    "`SERVING_DECODE_CHUNK` values stop paying chunk-overrun waste, and",
+    "chained chunk submits upload nothing (paged write indices are computed",
+    "on device from a pre-reserved page horizon).",
+    "`SERVING_DECODE_PIPELINE_DEPTH` chunks stay in flight so the device",
+    "never waits on the host between chunks; the `engine.host_gap_ms`",
+    "histogram and `/debug/roofline` host-gap percentiles measure exactly",
+    "that. Greedy and seeded streams are byte-identical with the feature on",
+    "or off; stop *strings* remain a host-side backstop that truncates",
+    "after the fact. Design: [docs/performance.md](docs/performance.md).",
+    "",
+]
+
+
 # Emitted under the Serving section: the serving-path fault model in one
 # paragraph (ISSUE 7); the full story lives in docs/resilience.md.
 _SERVING_FAULT_TOLERANCE_DOC = [
@@ -278,6 +301,7 @@ def generate_configurations_md(spec: dict) -> str:
         elif section == "serving":
             out.extend(_SERVING_DATA_PLANE_DOC)
             out.extend(_SERVING_RAGGED_DOC)
+            out.extend(_SERVING_DESYNC_DOC)
             out.extend(_SERVING_FAULT_TOLERANCE_DOC)
         elif section == "structured":
             out.extend(_STRUCTURED_DOC)
@@ -502,6 +526,9 @@ def check_config_defaults(spec: dict) -> list[str]:
         "SERVING_ADMIN_ENABLED": cfg.serving.admin_enabled,
         "SERVING_MIXED_STEP_ENABLE": cfg.serving.mixed_step_enable,
         "SERVING_MIXED_STEP_TOKENS": cfg.serving.mixed_step_tokens,
+        "SERVING_DECODE_EARLY_EXIT": cfg.serving.decode_early_exit,
+        "SERVING_DECODE_CHUNK": cfg.serving.decode_chunk,
+        "SERVING_DECODE_PIPELINE_DEPTH": cfg.serving.decode_pipeline_depth,
         "STRUCTURED_ENABLE": cfg.structured.enable,
         "STRUCTURED_CACHE_SIZE": cfg.structured.cache_size,
         "STRUCTURED_MAX_SCHEMA_BYTES": cfg.structured.max_schema_bytes,

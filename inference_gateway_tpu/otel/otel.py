@@ -35,6 +35,12 @@ EVENTLOOP_LAG_BOUNDARIES = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1
 # of decode steps lands in the tens-of-ms band.
 ENGINE_STEP_BOUNDARIES = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                           0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# Host gap between chained decode chunks (ISSUE 14), in MILLISECONDS:
+# a host-free steady state dispatches in tens of µs of Python; anything
+# past 1 ms means host work (allocator loops, array assembly, uploads)
+# crept back between chunks.
+HOST_GAP_MS_BOUNDARIES = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                          10.0, 25.0, 50.0)
 # Schema→token-mask compile times (ISSUE 13): a cache hit is ~0; cold
 # compiles run milliseconds for small schemas up to ~1s for deep
 # generic-JSON grammars over large vocabularies.
@@ -202,6 +208,12 @@ class OpenTelemetry:
             "engine.step_duration",
             "Engine step wall time by kind (prefill/decode/spec/spec_ngram)",
             ("gen_ai_request_model", "kind"), ENGINE_STEP_BOUNDARIES, unit="s",
+        )
+        self.engine_host_gap = r.histogram(
+            "engine.host_gap_ms",
+            "Host wall time between fetching chunk N and dispatching chunk "
+            "N+1 — the direct measure of the host-free decode steady state",
+            ("gen_ai_request_model", "kind"), HOST_GAP_MS_BOUNDARIES, unit="ms",
         )
         self.slow_request_counter = r.counter(
             "inference_gateway.slow_requests",
@@ -499,6 +511,10 @@ class OpenTelemetry:
 
     def record_eventloop_stall(self, source: str) -> None:
         self.eventloop_stall_counter.add(1, {"source": source})
+
+    def record_host_gap(self, model: str, kind: str, gap_ms: float) -> None:
+        self.engine_host_gap.record(
+            gap_ms, {"gen_ai_request_model": model, "kind": kind})
 
     def record_engine_step(self, model: str, kind: str, seconds: float) -> None:
         self.engine_step_duration.record(
@@ -830,6 +846,9 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_engine_step(self, *a, **k) -> None:
+        pass
+
+    def record_host_gap(self, *a, **k) -> None:
         pass
 
     def record_slow_request(self, *a, **k) -> None:

@@ -573,6 +573,14 @@ def roofline_report(accounting: PerfAccounting,
             "gap_factor": round(p50_d / p50_r, 2) if p50_r > 0 else None,
             "bound": max(set(bounds), key=bounds.count),
         }
+        # Host gap between chained chunks (ISSUE 14): the host's wall
+        # time between fetching chunk N and dispatching chunk N+1 —
+        # p50/p99 per step kind, present only where the scheduler
+        # stamped it (chained decode dispatches).
+        gaps = sorted(r["host_gap_ms"] for r in recs if "host_gap_ms" in r)
+        if gaps:
+            per_kind[kind]["host_gap_ms_p50"] = round(_pick(gaps, 0.50), 4)
+            per_kind[kind]["host_gap_ms_p99"] = round(_pick(gaps, 0.99), 4)
     out: dict[str, Any] = {
         "measured": accounting.measured,
         "chip": accounting.cost.chip.name,

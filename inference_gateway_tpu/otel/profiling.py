@@ -447,9 +447,19 @@ class StepTimeline:
         self.steps = 0
         self.records = 0
 
+    def record_host_gap(self, kind: str, gap_ms: float) -> None:
+        """One host-gap observation (ISSUE 14): wall time the host spent
+        between finishing its last device interaction and dispatching
+        the next chunk — recorded per DISPATCH into the
+        engine.host_gap_ms histogram (the latest gap also rides the next
+        step record's host_gap_ms field via record())."""
+        if self.otel is not None:
+            self.otel.record_host_gap(self.model, kind, gap_ms)
+
     def record(self, kind: str, duration_s: float, *, n_steps: int = 1, batch: int = 0,
                tokens: int = 0, kv_utilization: float = 0.0, queue_depth: int = 0,
-               cost: dict[str, Any] | None = None) -> None:
+               cost: dict[str, Any] | None = None,
+               host_gap_ms: float | None = None) -> None:
         rec = {
             "ts": time.time(),
             "kind": kind,
@@ -460,6 +470,11 @@ class StepTimeline:
             "kv_utilization": round(kv_utilization, 4),
             "queue_depth": queue_depth,
         }
+        if host_gap_ms is not None:
+            # Host wall time between the previous fetch and this chunk's
+            # dispatch (ISSUE 14) — the "host-free steady state" measure
+            # /debug/roofline aggregates to p50/p99 per step kind.
+            rec["host_gap_ms"] = round(host_gap_ms, 4)
         if cost:
             # Analytic step cost from the accounting layer (ISSUE 6):
             # flops / hbm_bytes / roofline_ms / bound ride every record

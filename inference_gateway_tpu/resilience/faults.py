@@ -366,10 +366,16 @@ class EngineFaultInjector:
         from inference_gateway_tpu.serving.kv_cache import OutOfPagesError
 
         e = OutOfPagesError("injected page exhaustion")
-        if slot is None and op == "decode_submit" and len(args) >= 3:
+        if slot is None and op == "decode_submit":
             import numpy as np
 
-            live = np.flatnonzero(np.asarray(args[2]))  # ``active``
-            slot = int(live[-1]) if live.size else None
+            # ``active`` rides the call for fresh submits; chained
+            # host-free submits (ISSUE 14) carry no arrays — the
+            # engine's chain mirror is the authoritative live set.
+            active = args[2] if len(args) >= 3 and args[2] is not None \
+                else getattr(self.engine, "_chain_active", None)
+            if active is not None:
+                live = np.flatnonzero(np.asarray(active))
+                slot = int(live[-1]) if live.size else None
         e.slot = slot
         raise e

@@ -82,8 +82,23 @@ class EngineConfig:
     # of allocator bookkeeping + dispatch measured through the remote
     # tunnel, round-3 profile) behind device execution. Cost: finish
     # detection lags by depth chunks, so up to depth*decode_chunk wasted
-    # steps per finished request.
+    # steps per finished request — ~zero with decode_early_exit, which
+    # freezes finished slots on device.
     pipeline_depth: int = 2
+    # On-device stopping + early-exit chunks (ISSUE 14): per-slot stop
+    # token tables (EOS + stop_token_ids), max_tokens budgets, and the
+    # grammar accept-state ride the fused chunk carry, so a per-slot
+    # ``done`` flag is computed ON DEVICE — finished slots freeze (no
+    # further sampling, KV writes masked) and the chunk exits its
+    # lax.while_loop as soon as every active slot is done. This makes
+    # long decode_chunk values safe (chunk_overrun waste ~0) and makes
+    # chain=True submits genuinely host-free: the paged write indices
+    # are computed on device from a pre-reserved page-table horizon, so
+    # a chained submit uploads NOTHING. Greedy and seeded streams are
+    # byte-identical with the flag on or off (host stop detection stays
+    # authoritative; the device criterion is a strict subset — stop
+    # STRINGS and disconnects remain host-side backstops).
+    decode_early_exit: bool = True
     # Speculative decoding: spec_draft names a llama-family draft model
     # (preset name or HF path, same vocab as the target) that proposes
     # spec_k tokens per round, or the special value "ngram" for
@@ -136,6 +151,28 @@ class EngineConfig:
     structured_states: int = 4096
     structured_cache: int = 64
     structured_max_schema_bytes: int = 65536
+
+
+# Width of the per-slot on-device stop-token table (ISSUE 14): EOS plus
+# up to STOP_TABLE_WIDTH-1 request stop ids, padded with -1 (never a
+# vocab id). Requests with more stop ids than fit keep the overflow
+# host-side only — the device stops later (or not at all) and the host
+# finish check truncates exactly as before, so truncation is always
+# safe, never wrong.
+STOP_TABLE_WIDTH = 8
+
+
+def build_stop_row(eos_id: int | None, stop_ids=()) -> np.ndarray:
+    """One slot's padded device stop row: EOS first, then sorted stop
+    ids, truncated to the table width."""
+    row = np.full((STOP_TABLE_WIDTH,), -1, np.int32)
+    ids: list[int] = []
+    if eos_id is not None and eos_id >= 0:
+        ids.append(int(eos_id))
+    ids.extend(t for t in sorted(stop_ids) if t not in ids)
+    ids = ids[:STOP_TABLE_WIDTH]
+    row[: len(ids)] = ids
+    return row
 
 
 class PromptTooLongError(ValueError):
@@ -514,11 +551,36 @@ class Engine:
         self._lock = threading.Lock()
         # Device-resident chained decode state (decode_chunk_submit):
         # (pending token, position, grammar mask state) carry from the
-        # last chunk, plus the uploaded sampling params. Any prefill
-        # invalidates the carry — newly admitted slots' tokens exist
-        # only on the host.
+        # last chunk, plus the uploaded sampling params. With
+        # decode_early_exit the carry additionally holds the per-slot
+        # done flag, the remaining max_tokens budget, and the chunk rng
+        # key (so chained submits derive randomness on device instead of
+        # uploading a fresh key). Any prefill invalidates the carry —
+        # newly admitted slots' tokens exist only on the host.
         self._dev_carry = None
         self._dev_sampling = None
+        # Host mirror of the chained steady state (ISSUE 14): which
+        # slots the chain serves, their predicted write positions, and
+        # how many cache tokens each has pages reserved for. Chained
+        # submits consult ONLY these host arrays (vectorized ops, no
+        # np.* construction — graftlint-enforced); when the reservation
+        # horizon is exhausted, _reserve_chain_horizon tops it up in one
+        # batched allocator pass and refreshes the device-resident page
+        # table — the only time a chained steady state touches h2d.
+        # Gated off for pipeline-parallel engines: the pp forward runs
+        # stage-sharded shard_maps whose interaction with a dynamic
+        # while_loop trip count is unexercised (pp is the one layout the
+        # CPU CI cannot compile) — pp keeps the legacy fixed-scan chunk.
+        self._early_exit = bool(config.decode_early_exit) and not self.pp
+        S = config.max_slots
+        self._chain_active = np.zeros((S,), bool)
+        self._pred_pos = np.zeros((S,), np.int64)
+        self._reserved = np.zeros((S,), np.int64)
+        self._dev_page_table = None
+        self._dev_reserved = None
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        self._eos_id = eos if isinstance(eos, int) else None
+        self._eos_stop_row = build_stop_row(self._eos_id)
         # Structured outputs (ISSUE 13): grammar mask tables + logit-bias
         # rows. Construction is lazy-cheap; device buffers materialize on
         # the first constrained/biased admission (StructuredRuntime.live
@@ -538,6 +600,7 @@ class Engine:
         self._no_mask_tables = (
             jnp.zeros((1, 1), jnp.int32), jnp.zeros((1, 1), jnp.uint32),
             jnp.zeros((1, 1), jnp.float32))
+        self._no_term_table = jnp.zeros((1,), bool)
         self._zero_mstates = np.zeros((config.max_slots,), np.int32)
         # Serving metrics surfaced via the sidecar's /metrics endpoint.
         self.metrics = {
@@ -593,6 +656,18 @@ class Engine:
         if rt is not None and rt.live:
             return True, rt.next_dev, rt.bits_dev, rt.bias_dev
         return (False,) + self._no_mask_tables
+
+    def _mask_args_ee(self):
+        """_mask_args plus the per-state TERMINAL table (ISSUE 14): the
+        early-exit chunk fns read ``mterm[state]`` to fold "the grammar
+        has nothing further to say" into the on-device done flag — the
+        device mirror of GrammarSession.feed returning "end" on the next
+        token."""
+        rt = self.structured
+        if rt is not None and rt.live:
+            return True, rt.next_dev, rt.bits_dev, rt.term_dev, rt.bias_dev
+        t = self._no_mask_tables
+        return False, t[0], t[1], self._no_term_table, t[2]
 
     def structured_register(self, slot: int, grammar, logit_bias) -> None:
         """Admission hook: make the request's grammar span device-resident
@@ -816,6 +891,159 @@ class Engine:
         )
         return toks, logprobs, tok_f, pos_f, ms_f, cache
 
+    # -- early-exit fused chunks (ISSUE 14) -----------------------------
+    def _chunk_done0(self, tokens, positions, done, budgets, stop_table,
+                     mstates, mterm, masked):
+        """Initial per-slot done flags at chunk entry: the carried flag,
+        plus every condition the PENDING token may already have tripped
+        (async admission scatters first tokens without a host check —
+        an EOS first token must freeze the row before step 0, exactly
+        where Scheduler._emit will finish the stream)."""
+        max_len = self.config.max_seq_len
+        d = done | jnp.any(stop_table == tokens[:, None], axis=-1)
+        d = d | (budgets <= 0) | (positions + 1 >= max_len)
+        if masked:
+            d = d | mterm[mstates]
+        return d
+
+    def _chunk_step_ee(self, params, i, cache, tok, pos, ms, done, bud, gumbels,
+                       k_eff, temps, top_ps, stop_table, mstates_args, write_args):
+        """One early-exit decode step, shared by the dense and paged
+        while_loop bodies. Live rows advance exactly as the legacy scan
+        did (same forward, same pre-drawn gumbel, same mask gathers —
+        byte-identical streams); done rows FREEZE: carry unchanged, the
+        emitted token is the frozen one (the host's stop detection
+        re-fires on it and truncates), and paged KV writes are masked.
+        Returns (cache, out_tok, out_lp, new carry...)."""
+        masked, mnext, mbits, mterm, mbias = mstates_args
+        max_len = self.config.max_seq_len
+        pos_att = jnp.minimum(pos, max_len - 1)
+        if write_args is None:
+            # pp engines keep the legacy scan (early exit gated off in
+            # __init__), so only the single-program forwards land here.
+            logits, cache = self._model.forward(
+                params, self.model_cfg, tok[:, None], pos_att[:, None],
+                pos_att + 1, cache, mode="decode")
+            logits = logits[:, 0]
+        else:
+            page_table, reserved = write_args
+            ps = self.config.page_size
+            page = jnp.take_along_axis(
+                page_table, (pos_att // ps)[:, None], axis=1)[:, 0]
+            # int32 throughout: the legacy int64 host write_idx was
+            # truncated to int32 at upload anyway (no x64), and a flat
+            # paged cache index always fits.
+            w = page * ps + pos_att % ps
+            valid = (~done) & (pos < max_len) & (pos < reserved)
+            w = jnp.where(valid, w, self._flat_size)
+            logits, cache = self._model.forward_paged(
+                params, self.model_cfg, tok[:, None], pos_att[:, None],
+                pos_att + 1, cache, w[:, None], page_table, mode="decode",
+                last_only=True, mesh=self.mesh)
+        if masked:
+            logits = logits + self._mask_bias(mbits, ms, mbias[:-1])
+        nxt = sample_tokens_pregumbel(logits, temps, top_ps, gumbels[i], k_eff)
+        nxt = nxt.astype(jnp.int32)
+        lp = compute_logprobs(logits, nxt)
+        nms = mnext[ms, nxt] if masked else ms
+        nbud = bud - 1
+        ndone = jnp.any(stop_table == nxt[:, None], axis=-1)
+        ndone = ndone | (nbud <= 0) | (pos + 2 >= max_len)
+        if masked:
+            ndone = ndone | mterm[nms]
+        out_tok = jnp.where(done, tok, nxt)
+        out_lp = jnp.where(done, 0.0, lp)
+        tok = jnp.where(done, tok, nxt)
+        pos = jnp.where(done, pos, pos + 1)
+        ms = jnp.where(done, ms, nms)
+        bud = jnp.where(done, bud, nbud)
+        done = done | ndone
+        return cache, out_tok, out_lp, tok, pos, ms, done, bud
+
+    def _run_chunk_ee(self, params, cache, tokens, positions, done, budgets,
+                      stop_table, temps, top_ps, seeds, use_seed, rng, mask_args,
+                      write_args, n_steps):
+        """The early-exit chunk driver: a lax.while_loop over up to
+        ``n_steps`` decode steps that stops the moment every slot is
+        done — the Kernel Looping move (arxiv 2410.23668): the
+        synchronization boundary between decode iterations is gone, and
+        the ITERATION COUNT itself is now a device-side decision. Output
+        buffers are pre-filled with each row's frozen token, so steps
+        the loop never ran still emit the token the host's stop
+        detection expects."""
+        masked = mask_args[0]
+        mstates = mask_args[1]
+        mask_tail = (masked,) + mask_args[2:]
+        keys = chunk_row_keys(rng, seeds, use_seed, positions, n_steps)
+        k_eff = effective_top_k(self.config.top_k, self.model_cfg.vocab_size)
+        gumbels = chunk_gumbels(keys, k_eff)
+        done0 = self._chunk_done0(tokens, positions, done, budgets, stop_table,
+                                  mstates, mask_args[4], masked)
+        S = tokens.shape[0]
+        out_toks0 = jnp.broadcast_to(tokens[None, :], (n_steps, S)).astype(jnp.int32)
+        out_lps0 = jnp.zeros((n_steps, S), jnp.float32)
+
+        def cond(carry):
+            i, _cache, _tok, _pos, _ms, done, _bud, _ot, _ol = carry
+            return (i < n_steps) & jnp.any(~done)
+
+        def body(carry):
+            i, cache, tok, pos, ms, done, bud, out_t, out_l = carry
+            cache, o_tok, o_lp, tok, pos, ms, done, bud = self._chunk_step_ee(
+                params, i, cache, tok, pos, ms, done, bud, gumbels, k_eff,
+                temps, top_ps, stop_table, mask_tail, write_args)
+            out_t = jax.lax.dynamic_update_index_in_dim(out_t, o_tok, i, 0)
+            out_l = jax.lax.dynamic_update_index_in_dim(out_l, o_lp, i, 0)
+            return (i + 1, cache, tok, pos, ms, done, bud, out_t, out_l)
+
+        (i_ran, cache, tok_f, pos_f, ms_f, done_f, bud_f, out_toks, out_lps) = \
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cache, tokens, positions, mstates, done0,
+                 budgets, out_toks0, out_lps0))
+        # Steps the loop never ran emit each row's FINAL frozen token
+        # (not the chunk-entry one), so the emitted block reads exactly
+        # like a chunk whose frozen rows kept repeating their last
+        # token — the host's stop detection re-fires on it either way.
+        skipped = jnp.arange(n_steps)[:, None] >= i_ran
+        out_toks = jnp.where(skipped, tok_f[None, :], out_toks)
+        rng_next = jax.random.fold_in(rng, 1)
+        return out_toks, out_lps, tok_f, pos_f, ms_f, done_f, bud_f, rng_next, cache
+
+    @partial(jax.jit, static_argnames=("self", "n_steps", "masked"), donate_argnums=(2,))
+    def _decode_chunk_fn_ee(self, params, cache, tokens, positions, done, budgets,
+                            stop_table, temps, top_ps, seeds, use_seed, rng,
+                            mstates=None, mnext=None, mbits=None, mterm=None,
+                            mbias=None, n_steps=8, masked=False):
+        """Early-exit variant of _decode_chunk_fn (dense cache): on-device
+        stopping (stop table / budget / grammar terminal state in the
+        carry), frozen rows rewrite their last real token's KV (bitwise
+        identical values — a deterministic forward at an unchanged
+        position), and the whole chunk exits early when every slot is
+        done."""
+        mask_args = (masked, mstates, mnext, mbits, mterm, mbias)
+        return self._run_chunk_ee(
+            params, cache, tokens, positions, done, budgets, stop_table, temps,
+            top_ps, seeds, use_seed, rng, mask_args, None, n_steps)
+
+    @partial(jax.jit, static_argnames=("self", "n_steps", "masked"), donate_argnums=(2,))
+    def _decode_chunk_fn_paged_ee(self, params, cache, tokens, positions, done,
+                                  budgets, stop_table, page_table, reserved,
+                                  temps, top_ps, seeds, use_seed, rng,
+                                  mstates=None, mnext=None, mbits=None, mterm=None,
+                                  mbias=None, n_steps=8, masked=False):
+        """Early-exit variant of _decode_chunk_fn_paged: the flat paged
+        write index is computed ON DEVICE from the resident page table
+        (page_table[slot, pos // page_size] · page_size + pos % page_size)
+        and masked OOB for done rows and positions beyond the reserved
+        horizon — the host no longer assembles write_idx per chunk, so a
+        chained submit uploads nothing (ISSUE 14 tentpole b)."""
+        mask_args = (masked, mstates, mnext, mbits, mterm, mbias)
+        return self._run_chunk_ee(
+            params, cache, tokens, positions, done, budgets, stop_table, temps,
+            top_ps, seeds, use_seed, rng, mask_args, (page_table, reserved),
+            n_steps)
+
     @partial(jax.jit, static_argnames=("self", "ring", "masked"), donate_argnums=(2,))
     def _prefill_fn_paged(self, params, cache, tokens, positions, lengths, write_idx,
                           page_table, temps, top_ps, seeds, use_seed, rng,
@@ -995,11 +1223,13 @@ class Engine:
     def prefill(self, prompts: list[list[int]], slots: list[int], temps: list[float],
                 top_ps: list[float], embeds: list | None = None,
                 seeds: list | None = None, grammars: list | None = None,
-                biases: list | None = None) -> list[PrefillResult]:
+                biases: list | None = None, stop_rows: np.ndarray | None = None,
+                budgets: np.ndarray | None = None) -> list[PrefillResult]:
         """Synchronous prefill: submit + fetch."""
         return self.prefill_fetch(self.prefill_submit(
             prompts, slots, temps, top_ps, embeds=embeds, seeds=seeds,
-            grammars=grammars, biases=biases))
+            grammars=grammars, biases=biases, stop_rows=stop_rows,
+            budgets=budgets))
 
     def prefill_fetch(self, handle: PrefillHandle) -> list[PrefillResult]:
         """Block until a submitted prefill's first tokens are on host."""
@@ -1022,19 +1252,41 @@ class Engine:
                 upd(top_ps, new_tps), upd(seeds, new_seeds), upd(use_seed, new_use),
                 upd(mstate, new_mstates))
 
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=tuple(range(1, 11)))
+    def _admit_scatter_fn_ee(self, tok, pos, ms, done, bud, temps, top_ps, seeds,
+                             use_seed, stop_tab, slot_arr, new_toks, new_lens,
+                             new_mstates, new_buds, new_stops, new_temps, new_tps,
+                             new_seeds, new_use):
+        """_admit_scatter_fn for the early-exit carry (ISSUE 14): also
+        re-arms the admitted slots' on-device stop state — done flags
+        clear, fresh max_tokens budgets and stop-token rows land — so
+        the next chained chunk serves them with zero host involvement."""
+        upd = lambda a, v: a.at[slot_arr].set(v.astype(a.dtype), mode="drop")
+        return (upd(tok, new_toks), upd(pos, new_lens), upd(ms, new_mstates),
+                done.at[slot_arr].set(False, mode="drop"), upd(bud, new_buds),
+                stop_tab.at[slot_arr].set(new_stops, mode="drop"),
+                upd(temps, new_temps), upd(top_ps, new_tps),
+                upd(seeds, new_seeds), upd(use_seed, new_use))
+
     def prefill_submit(self, prompts: list[list[int]], slots: list[int], temps: list[float],
                        top_ps: list[float], embeds: list | None = None,
                        seeds: list | None = None, grammars: list | None = None,
-                       biases: list | None = None) -> PrefillHandle:
+                       biases: list | None = None,
+                       stop_rows: np.ndarray | None = None,
+                       budgets: np.ndarray | None = None) -> PrefillHandle:
         """Prefill a batch of prompts into their slots WITHOUT waiting.
 
         Pads to (max_prefill_batch, bucket). ``embeds`` optionally
         carries per-row (T_i, H) multimodal embedding overrides (from
         prepare_multimodal); ``grammars``/``biases`` per-row structured
         sessions and logit_bias maps (ISSUE 13) — registered here so the
-        batch's first tokens are already grammar-masked. Long-prompt
-        paths (ring / chunked) resolve synchronously inside and return a
-        materialized handle.
+        batch's first tokens are already grammar-masked. ``stop_rows``
+        (B, STOP_TABLE_WIDTH) / ``budgets`` (B,) arm each admitted
+        slot's ON-DEVICE stop criteria (ISSUE 14) when the chained carry
+        exists; None keeps EOS-only tables and an unbounded budget (the
+        host finish checks stay the backstop). Long-prompt paths (ring /
+        chunked) resolve synchronously inside and return a materialized
+        handle.
         """
         assert prompts and len(prompts) == len(slots)
         # Structured admission first: span acquire + bias scatter set the
@@ -1088,6 +1340,8 @@ class Engine:
                     seeds=[(seeds or [None] * len(prompts))[i] for i in short_idx] if seeds else None,
                     grammars=[sessions[i] for i in short_idx] if grammars else None,
                     biases=[(biases or [None] * len(prompts))[i] for i in short_idx] if biases else None,
+                    stop_rows=stop_rows[short_idx] if stop_rows is not None else None,
+                    budgets=budgets[short_idx] if budgets is not None else None,
                 )
                 results.extend(zip(short_idx, sub))
             ordered = [r for _, r in sorted(results)]
@@ -1108,7 +1362,7 @@ class Engine:
                                 for s in (seeds or [None] * len(prompts))], np.int32),
                     np.asarray([seeds is not None and s is not None
                                 for s in (seeds or [None] * len(prompts))]),
-                    mstates=post_states,
+                    mstates=post_states, stop_rows=stop_rows, budgets=budgets,
                 )
             return PrefillHandle(
                 np.asarray([r.first_token for r in ordered], np.int32),
@@ -1229,31 +1483,75 @@ class Engine:
                 )
             # Fold results into chained decode state on-device (futures
             # stay futures — no sync): admission is not a barrier. The
-            # grammar states after the first sampled tokens ride along.
+            # grammar states after the first sampled tokens ride along,
+            # as do the per-slot stop rows / budgets arming the on-device
+            # stop criteria (padding rows carry defaults and drop OOB).
+            pad_stop = pad_bud = None
+            if self._early_exit:
+                pad_stop = np.broadcast_to(
+                    self._eos_stop_row, (Bp, STOP_TABLE_WIDTH)).copy()
+                pad_bud = np.full((Bp,), 1 << 30, np.int64)
+                if stop_rows is not None:
+                    pad_stop[: len(prompts)] = stop_rows[: len(prompts)]
+                if budgets is not None:
+                    pad_bud[: len(prompts)] = budgets[: len(prompts)]
             scattered = self._scatter_admission(
                 slot_arr, toks, lengths, t_arr, p_arr, seed_arr, use_seed,
-                mstates=nstates)
+                mstates=nstates, stop_rows=pad_stop, budgets=pad_bud)
         return PrefillHandle(toks[: len(slots)], logprobs[: len(slots)],
                              list(slots), scattered=scattered)
 
     def _scatter_admission(self, slot_arr, toks, lengths, t_arr, p_arr,
-                           seed_arr, use_seed, mstates=None) -> bool:
-        """Scatter a prefill batch's (token, pos, sampling, mask-state)
+                           seed_arr, use_seed, mstates=None, stop_rows=None,
+                           budgets=None) -> bool:
+        """Scatter a prefill batch's (token, pos, sampling, mask-state —
+        and under decode_early_exit: stop-row, budget, cleared done)
         rows into the device-resident chained state, if it exists.
         Caller holds _lock or is on the scheduler thread."""
         if self._dev_carry is None:
             return False
-        tok_d, pos_d, ms_d = self._dev_carry
-        te_d, tp_d, se_d, us_d = self._dev_sampling
         if mstates is None:
             mstates = np.zeros((len(slot_arr),), np.int32)
-        new = self._admit_scatter_fn(
-            tok_d, pos_d, te_d, tp_d, se_d, us_d, ms_d,
+        if not self._early_exit:
+            tok_d, pos_d, ms_d = self._dev_carry
+            te_d, tp_d, se_d, us_d = self._dev_sampling
+            new = self._admit_scatter_fn(
+                tok_d, pos_d, te_d, tp_d, se_d, us_d, ms_d,
+                jnp.asarray(slot_arr), jnp.asarray(toks), jnp.asarray(lengths),
+                jnp.asarray(t_arr), jnp.asarray(p_arr), jnp.asarray(seed_arr),
+                jnp.asarray(use_seed), jnp.asarray(mstates))
+            self._dev_carry = (new[0], new[1], new[6])
+            self._dev_sampling = tuple(new[2:6])
+            return True
+        Bp = len(slot_arr)
+        if stop_rows is None:
+            stop_rows = np.broadcast_to(
+                self._eos_stop_row, (Bp, STOP_TABLE_WIDTH))
+        if budgets is None:
+            budgets = np.full((Bp,), 1 << 30, np.int64)
+        tok_d, pos_d, ms_d, done_d, bud_d, rng_d = self._dev_carry
+        te_d, tp_d, se_d, us_d, stop_d = self._dev_sampling
+        new = self._admit_scatter_fn_ee(
+            tok_d, pos_d, ms_d, done_d, bud_d, te_d, tp_d, se_d, us_d, stop_d,
             jnp.asarray(slot_arr), jnp.asarray(toks), jnp.asarray(lengths),
+            jnp.asarray(mstates), jnp.asarray(budgets, dtype=jnp.int32),
+            jnp.asarray(stop_rows, dtype=jnp.int32),
             jnp.asarray(t_arr), jnp.asarray(p_arr), jnp.asarray(seed_arr),
-            jnp.asarray(use_seed), jnp.asarray(mstates))
-        self._dev_carry = (new[0], new[1], new[6])
-        self._dev_sampling = tuple(new[2:6])
+            jnp.asarray(use_seed))
+        self._dev_carry = (new[0], new[1], new[2], new[3], new[4], rng_d)
+        self._dev_sampling = (new[6], new[7], new[8], new[9], new[5])
+        # Chained steady-state host mirror: admitted slots join the chain
+        # at their prompt length, with pages already reserved by the
+        # prefill that produced these results (OOB padding rows drop).
+        ok = slot_arr < self.config.max_slots
+        s = slot_arr[ok]
+        self._chain_active[s] = True
+        self._pred_pos[s] = lengths[ok]
+        if self.paged:
+            ps = self.config.page_size
+            self._reserved[s] = (lengths[ok] + ps - 1) // ps * ps
+            self._dev_page_table = jnp.asarray(self.allocator.page_table())
+            self._dev_reserved = jnp.asarray(self._reserved)
         return True
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray, lengths: np.ndarray, temps: np.ndarray, top_ps: np.ndarray):
@@ -1394,11 +1692,160 @@ class Engine:
             e.slot = slot
             raise
 
+    def _reserve_chain_horizon(self, need: np.ndarray, n: int) -> None:
+        """Batched KV-page pre-reservation for the chained-decode horizon
+        (ISSUE 14): every slot flagged in ``need`` gets pages covering
+        pipeline_depth+1 future chunks (falling back to one chunk under
+        page pressure, so deep horizons never manufacture exhaustion the
+        legacy per-chunk path wouldn't have hit), then the device-resident
+        page table and reserved spans are refreshed ONCE. This is the
+        only h2d traffic the chained steady state ever causes, amortized
+        over the whole horizon; the common chained submit finds
+        ``need`` empty and uploads nothing."""
+        from inference_gateway_tpu.serving.kv_cache import OutOfPagesError
+
+        ps = self.config.page_size
+        max_len = self.config.max_seq_len
+        depth = max(self.config.pipeline_depth, 1)
+        cap = np.minimum(self._pred_pos + n * (depth + 1), max_len)
+        base = np.minimum(self._pred_pos + n, max_len)
+        try:
+            for slot in np.nonzero(need)[0]:
+                s = int(slot)
+                try:
+                    self._ensure_with_evict(s, int(cap[s]))
+                    got = int(cap[s])
+                except OutOfPagesError:
+                    # Tagged with .slot by _ensure_with_evict if this
+                    # raises too — the scheduler's preemption path takes
+                    # over.
+                    self._ensure_with_evict(s, int(base[s]))
+                    got = int(base[s])
+                self._reserved[s] = max(
+                    int(self._reserved[s]), (got + ps - 1) // ps * ps)
+        finally:
+            # ALWAYS refresh the device mirrors, even when a later slot's
+            # reservation raised: earlier slots in this loop already
+            # extended their page lists and bumped the host mirror — if
+            # the device tables stayed stale, the next chained chunk
+            # would mask their writes OOB / read a page table missing
+            # their new pages, silently corrupting those streams.
+            self._dev_page_table = jnp.asarray(self.allocator.page_table())
+            self._dev_reserved = jnp.asarray(self._reserved)
+
+    def _chain_submit_locked(self, n: int):
+        """The host-free chained submit (ISSUE 14 tentpole): everything —
+        pending tokens, positions, grammar states, done flags, budgets,
+        the rng key, sampling params, stop tables, page table, reserved
+        spans — is already device-resident, so dispatching the next
+        chunk uploads NOTHING and builds no host arrays (vectorized
+        reads of the persistent host mirror only; graftlint's
+        jax-hot-path chain-steady scope enforces this shape). Caller
+        holds the engine lock."""
+        if self._dev_carry is None:
+            raise RuntimeError(
+                "decode_chunk_submit(chain=True) with no device carry: "
+                "a prefill or failure invalidated chained decode state; "
+                "resubmit with chain=False")
+        tok_in, pos_in, ms_in, done_in, bud_in, rng = self._dev_carry
+        temps_d, tps_d, seeds_d, used_d, stop_d = self._dev_sampling
+        masked, mnext, mbits, mterm, mbias = self._mask_args_ee()
+        if self.paged:
+            # Slots already at the cache cap are finishing ("length") —
+            # excluding them keeps the reservation check from re-firing
+            # every chunk once pred_pos runs past max_seq_len.
+            need = (self._chain_active
+                    & (self._pred_pos + n > self._reserved)
+                    & (self._pred_pos < self.config.max_seq_len))
+            if need.any():
+                self._reserve_chain_horizon(need, n)
+            toks, logprobs, tok_f, pos_f, ms_f, done_f, bud_f, rng_f, self.cache = \
+                self._decode_chunk_fn_paged_ee(
+                    self.params, self.cache, tok_in, pos_in, done_in, bud_in,
+                    stop_d, self._dev_page_table, self._dev_reserved,
+                    temps_d, tps_d, seeds_d, used_d, rng,
+                    mstates=ms_in, mnext=mnext, mbits=mbits, mterm=mterm,
+                    mbias=mbias, n_steps=n, masked=masked)
+        else:
+            toks, logprobs, tok_f, pos_f, ms_f, done_f, bud_f, rng_f, self.cache = \
+                self._decode_chunk_fn_ee(
+                    self.params, self.cache, tok_in, pos_in, done_in, bud_in,
+                    stop_d, temps_d, tps_d, seeds_d, used_d, rng,
+                    mstates=ms_in, mnext=mnext, mbits=mbits, mterm=mterm,
+                    mbias=mbias, n_steps=n, masked=masked)
+        self._pred_pos = self._pred_pos + n * self._chain_active
+        self._dev_carry = (tok_f, pos_f, ms_f, done_f, bud_f, rng_f)
+        n_active = int(self._chain_active.sum())
+        self.metrics["decode_tokens"] += n_active * n
+        self.metrics["decode_steps"] += n
+        both = jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0)
+        return _DecodeChunkHandle(both, n)
+
+    def _fresh_submit_ee_locked(self, tokens, positions, active, temps, top_ps,
+                                n, seeds, use_seed, mstates, stop_tables, budgets):
+        """chain=False under decode_early_exit: host state is
+        authoritative — upload it all (first chunk, failure recovery),
+        arm the on-device stop criteria, and (re)build the chained
+        steady-state host mirror the later host-free submits read.
+        Caller holds the engine lock."""
+        S = self.config.max_slots
+        if stop_tables is None:
+            stop_tables = np.broadcast_to(
+                self._eos_stop_row, (S, STOP_TABLE_WIDTH))
+        if budgets is None:
+            # Effectively unbounded: the host max_tokens check remains
+            # the backstop for callers that don't ship budgets.
+            budgets = np.full((S,), 1 << 30, np.int64)
+        active = np.asarray(active, bool)
+        tok_in = jnp.asarray(np.asarray(tokens, np.int32))
+        pos_in = jnp.asarray(np.asarray(positions, np.int32))
+        done_in = jnp.asarray(~active)
+        bud_in = jnp.asarray(np.asarray(budgets, np.int32))
+        ms_in = jnp.asarray(mstates if mstates is not None
+                            else self._zero_mstates)
+        temps_d, tps_d = jnp.asarray(temps), jnp.asarray(top_ps)
+        seeds_d, used_d = jnp.asarray(seeds), jnp.asarray(use_seed)
+        stop_d = jnp.asarray(np.asarray(stop_tables, np.int32))
+        self._dev_sampling = (temps_d, tps_d, seeds_d, used_d, stop_d)
+        self._chain_active = active.copy()
+        self._pred_pos = np.asarray(positions, np.int64).copy()
+        rng = self._next_rng()
+        masked, mnext, mbits, mterm, mbias = self._mask_args_ee()
+        if self.paged:
+            # Fresh reservation state: recompute the horizon from the
+            # allocator's truth (stale mirrors from a previous stream
+            # must not understate OR overstate what is safe to write).
+            self._reserved[:] = 0
+            self._reserve_chain_horizon(active, n)
+            toks, logprobs, tok_f, pos_f, ms_f, done_f, bud_f, rng_f, self.cache = \
+                self._decode_chunk_fn_paged_ee(
+                    self.params, self.cache, tok_in, pos_in, done_in, bud_in,
+                    stop_d, self._dev_page_table, self._dev_reserved,
+                    temps_d, tps_d, seeds_d, used_d, rng,
+                    mstates=ms_in, mnext=mnext, mbits=mbits, mterm=mterm,
+                    mbias=mbias, n_steps=n, masked=masked)
+        else:
+            toks, logprobs, tok_f, pos_f, ms_f, done_f, bud_f, rng_f, self.cache = \
+                self._decode_chunk_fn_ee(
+                    self.params, self.cache, tok_in, pos_in, done_in, bud_in,
+                    stop_d, temps_d, tps_d, seeds_d, used_d, rng,
+                    mstates=ms_in, mnext=mnext, mbits=mbits, mterm=mterm,
+                    mbias=mbias, n_steps=n, masked=masked)
+        self._pred_pos = self._pred_pos + n * self._chain_active
+        self._dev_carry = (tok_f, pos_f, ms_f, done_f, bud_f, rng_f)
+        n_active = int(active.sum())
+        self.metrics["decode_tokens"] += n_active * n
+        self.metrics["decode_steps"] += n
+        both = jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0)
+        return _DecodeChunkHandle(both, n)
+
     def decode_chunk_submit(self, tokens: np.ndarray, positions: np.ndarray,
                             active: np.ndarray, temps: np.ndarray, top_ps: np.ndarray,
                             n_steps: int | None = None, seeds: np.ndarray | None = None,
                             use_seed: np.ndarray | None = None, chain: bool = False,
-                            mstates: np.ndarray | None = None):
+                            mstates: np.ndarray | None = None,
+                            stop_tables: np.ndarray | None = None,
+                            budgets: np.ndarray | None = None):
         """Dispatch ``n_steps`` fused decode steps WITHOUT waiting.
 
         JAX dispatch is asynchronous — the returned handle's arrays are
@@ -1409,14 +1856,21 @@ class Engine:
 
         chain=False: decode state (pending token, position, sampling
         params) is loaded from the host arrays — required for the first
-        chunk and after any admission or failure recovery.
+        chunk and after any admission or failure recovery. With
+        decode_early_exit, ``stop_tables`` (S, STOP_TABLE_WIDTH) and
+        ``budgets`` (S,) additionally arm the on-device stop criteria
+        (None = EOS-only tables and an effectively-unbounded budget —
+        the host finish checks remain the backstop either way).
         chain=True: the previous chunk's device-resident final carry is
         the input — no host upload, no sync. ``tokens`` is ignored;
-        ``positions``/``active`` are used only for paged write-index
-        allocation and metrics, so the caller passes its *predicted*
-        positions (last processed + in-flight steps). Invalid after any
-        prefill (which clears the carry): submitting chain=True then
-        raises instead of silently decoding stale tokens.
+        under decode_early_exit every array argument is ignored (the
+        carry, sampling params, stop state, and page-table horizon are
+        all device/host-mirror resident) and the submit is genuinely
+        host-free. Without early exit, ``positions``/``active`` are used
+        for host-side paged write-index assembly as before. Invalid
+        after any prefill (which clears the carry): submitting
+        chain=True then raises instead of silently decoding stale
+        tokens.
         """
         S = self.config.max_slots
         n = n_steps or self.config.decode_chunk
@@ -1424,6 +1878,13 @@ class Engine:
             seeds = np.zeros((S,), np.int32)
         if use_seed is None:
             use_seed = np.zeros((S,), bool)
+        if self._early_exit:
+            with self._lock:
+                if chain:
+                    return self._chain_submit_locked(n)
+                return self._fresh_submit_ee_locked(
+                    tokens, positions, active, temps, top_ps, n, seeds,
+                    use_seed, mstates, stop_tables, budgets)
         masked, mnext, mbits, mbias = self._mask_args()
         with self._lock:
             if chain:
@@ -1813,15 +2274,43 @@ class Engine:
 
         save_checkpoint(path, self.params, self.model_cfg)
 
-    def release_slot(self, slot: int) -> None:
+    @partial(jax.jit, static_argnames=("self",), donate_argnums=(1,))
+    def _mark_done_fn(self, done, slot):
+        """Freeze one slot in the chained early-exit carry (ISSUE 14):
+        its pages are being released, so chunks submitted from here on
+        must stop sampling AND stop writing KV for it (the device write
+        mask keys off this flag). In-flight chunks submitted earlier are
+        safe by program ordering — any stale write lands before the
+        page's next occupant prefills over it, the same ordering
+        argument the legacy host-built write_idx path relied on."""
+        return done.at[slot].set(True)
+
+    def release_slot(self, slot: int, frozen: bool = False) -> None:
         """Return a finished slot's KV pages to the pool, drop its
-        grammar-span reference, and zero its logit-bias row."""
-        if self.allocator is not None or self.structured is not None:
-            with self._lock:
-                if self.allocator is not None:
-                    self.allocator.release(slot)
-                if self.structured is not None:
-                    self.structured.release_slot(slot)
+        grammar-span reference, zero its logit-bias row, and freeze its
+        row in any chained early-exit carry.
+
+        ``frozen=True`` promises the device ALREADY froze the row (the
+        finish was one the on-device stop state detected — the common
+        case), so no carry patch is dispatched: the hot finish path
+        stays pure-Python. Host-only finishes (stop strings,
+        disconnects, preemption, failures) pass False and pay one tiny
+        scatter so later chained chunks stop writing into freed pages."""
+        if (self.allocator is None and self.structured is None
+                and not self._early_exit):
+            return
+        with self._lock:
+            if self.allocator is not None:
+                self.allocator.release(slot)
+            if self.structured is not None:
+                self.structured.release_slot(slot)
+            if self._early_exit:
+                self._chain_active[slot] = False
+                if not frozen and self._dev_carry is not None:
+                    tok, pos, ms, done, bud, rng = self._dev_carry
+                    self._dev_carry = (
+                        tok, pos, ms,
+                        self._mark_done_fn(done, jnp.int32(slot)), bud, rng)
 
     def context_window(self) -> int:
         return min(self.config.max_seq_len, self.model_cfg.max_position_embeddings)

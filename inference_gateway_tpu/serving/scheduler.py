@@ -18,11 +18,11 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Any, Callable
 
 import numpy as np
 
-from inference_gateway_tpu.serving.engine import Engine
+from inference_gateway_tpu.serving.engine import Engine, STOP_TABLE_WIDTH, build_stop_row
 from inference_gateway_tpu.serving.kv_cache import OutOfPagesError
 
 # Callback payload: (token_id, logprob, finished, finish_reason)
@@ -121,6 +121,14 @@ class _SlotState:
     # Admission sequence number: larger = younger. Preemption picks the
     # youngest victim (least sunk prefill/decode cost).
     seq: int = 0
+    # On-device stopping (ISSUE 14): True once this request finished on
+    # a criterion the device stop state also enforces (stop token in
+    # the shipped table, max_tokens budget, cache-row exhaustion,
+    # grammar completion) — the early-exit carry froze the row at the
+    # same step, so trailing chunk tokens were never computed and must
+    # not be billed as chunk_overrun waste. Stays False for host-only
+    # finishes (stop strings, disconnects), which the device over-ran.
+    device_stopped: bool = False
 
 
 def ngram_propose(history: list, K: int, max_n: int = 3) -> list:
@@ -179,9 +187,9 @@ class _PendingPrefill:
 
 
 class Scheduler:
-    def __init__(self, engine: Engine, logger=None, max_queue_depth: int = 0,
+    def __init__(self, engine: Engine, logger: Any = None, max_queue_depth: int = 0,
                  preempt_max: int = 0, preempt_high_water: float = 0.0,
-                 clock=None):
+                 clock: Any = None) -> None:
         from inference_gateway_tpu.logger import NoopLogger
         from inference_gateway_tpu.resilience.clock import MonotonicClock
 
@@ -280,6 +288,16 @@ class Scheduler:
         # consecutive failures are rate-limited and the timeline is
         # disabled outright after _TIMELINE_MAX_FAILURES in a row.
         self._timeline_failures = 0
+        # Host-gap instrumentation (ISSUE 14 satellite): perf_counter
+        # stamp of the most recent completed device interaction (submit
+        # returned / fetch materialized). The wall time from there to
+        # the NEXT chunk dispatch is the host's contribution to the
+        # steady state — the direct measure of "host-free". Recorded
+        # into the engine.host_gap_ms histogram per dispatch and onto
+        # the next decode StepTimeline record; only stamped while an
+        # observer is attached (same None-is-free discipline).
+        self._dev_touch: float | None = None
+        self._pending_host_gap_ms: float | None = None
 
     def active_requests(self) -> int:
         return len(self._slots)
@@ -521,7 +539,7 @@ class Scheduler:
         while self._handles:
             self._process_one(self._handles.popleft())
 
-    def _process_one(self, h) -> None:
+    def _process_one(self, h: object) -> None:
         try:
             if isinstance(h, _Inflight):
                 self._process_chunk(h)
@@ -573,7 +591,8 @@ class Scheduler:
                          - st.req.resume_generated,
                          delivered=st.generated)
         try:
-            self._release(slot, reason)
+            self._release(slot, reason,
+                          frozen=st.device_stopped if st is not None else False)
         except Exception as e:
             self.logger.error("slot release failed", e, "slot", slot)
 
@@ -748,6 +767,21 @@ class Scheduler:
         grammars = [r.grammar for r in batch]
         biases = [r.logit_bias for r in batch]
         self._admitting = batch  # visible to abort_all if prefill wedges
+        stop_rows = budgets = None
+        if getattr(self.engine, "_early_exit", False):
+            # Arm the admitted slots' on-device stop state (ISSUE 14):
+            # the async-scattered first tokens chain straight into fused
+            # chunks, so stop tables and max_tokens budgets must be
+            # device-resident before any of those chunks run. The first
+            # emitted token counts toward generated (pending counts as
+            # 1), hence the -1; resumed requests already spent
+            # resume_generated of their budget.
+            eos = getattr(self.engine, "_eos_id", None)
+            stop_rows = np.stack(
+                [build_stop_row(eos, r.stop_token_ids) for r in batch])
+            budgets = np.asarray(
+                [max(r.max_tokens - r.resume_generated - 1, 0) for r in batch],
+                np.int64)
         try:
             handle = self.engine.prefill_submit(
                 [r.prompt_ids for r in batch], slots,
@@ -756,6 +790,7 @@ class Scheduler:
                 seeds=seeds if any(s is not None for s in seeds) else None,
                 grammars=grammars if any(g is not None for g in grammars) else None,
                 biases=biases if any(b for b in biases) else None,
+                stop_rows=stop_rows, budgets=budgets,
             )
         except Exception as e:
             self._admitting = []
@@ -806,6 +841,10 @@ class Scheduler:
             return
         self.last_step_time = self.clock.now()
         self.steps_completed += 1
+        if self._observing:
+            # Device interaction completed: host-gap clocks restart here
+            # so a prefill fetch between chunks isn't billed as host gap.
+            self._dev_touch = time.perf_counter()
         for (req, slot), res in zip(p.items, results):
             st = self._slots.get(slot)
             if st is None or st.req is not req:
@@ -822,7 +861,7 @@ class Scheduler:
             finished, reason = self._emit(st, res.first_token, res.logprob)
             if finished:
                 del self._slots[slot]
-                self._release_guarded(slot, reason)
+                self._release_guarded(slot, reason, frozen=st.device_stopped)
             self._flush_emits(req)
         if self._observing:
             prompt_lens = [len(req.prompt_ids) for req, _slot in p.items]
@@ -1109,6 +1148,25 @@ class Scheduler:
         with self._wake:
             if self._waiting and self._free and self._admit_ready():
                 return None
+        n = self.engine.config.decode_chunk
+        observing = self._observing
+        if chain and getattr(self.engine, "_early_exit", False):
+            # Host-free steady state (ISSUE 14): the device carry holds
+            # tokens, positions, stop state, budgets, grammar states,
+            # and the rng; the engine's host mirror holds the page
+            # horizon. NOTHING is assembled here — this branch must stay
+            # free of per-slot loops and host-array construction
+            # (graftlint jax-hot-path chain-steady scope).
+            gap_t0 = time.perf_counter() if observing else 0.0
+            try:
+                handle = self.engine.decode_chunk_submit(
+                    None, None, None, None, None, n_steps=n, chain=True)
+            except Exception as e:
+                self._fail_after_decode_error(e)
+                return None
+            if observing:
+                self._stamp_host_gap("decode", gap_t0)
+            return _Inflight(handle, dict(self._slots), n)
         S = self.engine.config.max_slots
         chunk_handles = [h for h in self._handles if isinstance(h, _Inflight)]
         tokens = np.zeros((S,), np.int32)
@@ -1119,6 +1177,9 @@ class Scheduler:
         seeds = np.zeros((S,), np.int32)
         use_seed = np.zeros((S,), bool)
         mstates = np.zeros((S,), np.int32)
+        stop_tables = np.full((S, STOP_TABLE_WIDTH), -1, np.int32)
+        budgets = np.zeros((S,), np.int64)
+        eos_id = getattr(self.engine, "_eos_id", None)
         max_pos = self.engine.config.max_seq_len - 1
         for slot, st in self._slots.items():
             # Only chunks carrying THIS request (state identity, not slot
@@ -1139,14 +1200,19 @@ class Scheduler:
                 # only happen after a drain, when every emitted token has
                 # been fed (chained submits take the device carry).
                 mstates[slot] = st.req.grammar.global_state
-        n = self.engine.config.decode_chunk
+            stop_tables[slot] = build_stop_row(eos_id, st.req.stop_token_ids)
+            budgets[slot] = max(st.req.max_tokens - st.generated, 0)
+        gap_t0 = time.perf_counter() if observing else 0.0
         try:
             handle = self.engine.decode_chunk_submit(
                 tokens, positions, active, temps, top_ps, n_steps=n,
-                seeds=seeds, use_seed=use_seed, chain=chain, mstates=mstates)
+                seeds=seeds, use_seed=use_seed, chain=chain, mstates=mstates,
+                stop_tables=stop_tables, budgets=budgets)
         except Exception as e:
             self._fail_after_decode_error(e)
             return None
+        if observing:
+            self._stamp_host_gap("decode", gap_t0)
         return _Inflight(handle, dict(self._slots), n)
 
     def _spec_step(self) -> None:
@@ -1322,6 +1388,24 @@ class Scheduler:
         context-token summing."""
         return self.timeline is not None or self.accounting is not None
 
+    def _stamp_host_gap(self, kind: str, dispatch_t0: float) -> None:
+        """Record one host gap (ISSUE 14 satellite): wall time from the
+        end of the last device interaction to this chunk's dispatch —
+        what the device would have idled if the pipeline were depth 1.
+        Feeds the engine.host_gap_ms histogram per dispatch; the latest
+        gap also rides the next decode StepTimeline record so
+        /debug/roofline can report p50/p99 per step kind."""
+        now = time.perf_counter()
+        if self._dev_touch is not None:
+            gap_ms = max(dispatch_t0 - self._dev_touch, 0.0) * 1e3
+            self._pending_host_gap_ms = gap_ms
+            if self.timeline is not None:
+                try:
+                    self.timeline.record_host_gap(kind, gap_ms)
+                except Exception:
+                    pass
+        self._dev_touch = now
+
     def _record_step(self, kind: str, t0: float, *, n_steps: int, batch: int,
                      tokens: int, work_tokens: int = 0, context_tokens: int = 0,
                      sq_tokens: int = 0, pair_tokens: int = 0) -> None:
@@ -1352,10 +1436,12 @@ class Scheduler:
                     work_tokens=work_tokens, context_tokens=context_tokens,
                     sq_tokens=sq_tokens, pair_tokens=pair_tokens)
             if self.timeline is not None:
+                gap = self._pending_host_gap_ms if kind == "decode" else None
+                self._pending_host_gap_ms = None
                 self.timeline.record(
                     kind, duration, n_steps=n_steps, batch=batch,
                     tokens=tokens, kv_utilization=self.engine.kv_utilization(),
-                    queue_depth=self.queue_depth, cost=cost)
+                    queue_depth=self.queue_depth, cost=cost, host_gap_ms=gap)
             self._timeline_failures = 0
         except Exception as e:
             self._timeline_failures += 1
@@ -1405,6 +1491,10 @@ class Scheduler:
             return
         self.last_step_time = self.clock.now()
         self.steps_completed += inf.n_steps
+        if observing:
+            # Fetch N just completed: the clock for "host time between
+            # fetching chunk N and chunk N+1's dispatch" starts here.
+            self._dev_touch = time.perf_counter()
 
         ctx = sum(s.pos for s in inf.states.values()) if observing else 0
         emitted = 0
@@ -1415,8 +1505,12 @@ class Scheduler:
                 # Finished, failed, or re-admitted mid-flight: every row
                 # this chunk computed for the slot served a stream that
                 # already ended (bounded wasted work by design — now
-                # *attributed*, ISSUE 6).
-                overrun += toks.shape[0]
+                # *attributed*, ISSUE 6). If the finish was one the
+                # DEVICE also detected (ISSUE 14), the early-exit carry
+                # froze the row before this chunk sampled anything for
+                # it — nothing was wasted, so nothing is billed.
+                if not snap_st.device_stopped:
+                    overrun += toks.shape[0]
                 continue
             slot_emitted = emitted
             for j in range(toks.shape[0]):
@@ -1433,8 +1527,12 @@ class Scheduler:
                 finished, reason = self._emit(st, st.pending_token, st.pending_logprob)
                 if finished:
                     del self._slots[slot]
-                    self._release_guarded(slot, reason)
-                    overrun += toks.shape[0] - (j + 1)
+                    self._release_guarded(slot, reason, frozen=st.device_stopped)
+                    if not st.device_stopped:
+                        # Device-detected finishes froze the row at this
+                        # very step (ISSUE 14): the trailing block is
+                        # repeats, not computed tokens — zero overrun.
+                        overrun += toks.shape[0] - (j + 1)
                     break
             if emitted > slot_emitted:
                 # One flush per request per CHUNK: a pipelined
@@ -1447,13 +1545,16 @@ class Scheduler:
                               batch=len(inf.states), tokens=emitted,
                               context_tokens=ctx)
 
-    def _release_guarded(self, slot: int, reason: str | None) -> None:
+    def _release_guarded(self, slot: int, reason: str | None,
+                         frozen: bool = False) -> None:
         """Release on the normal finish path: an allocator bookkeeping
         error must fail at most this slot's cleanup, never the scheduler
         thread (the invariant the pre-pipelining loop guarded with its
-        decode-step try/except; code-review round 3)."""
+        decode-step try/except; code-review round 3). ``frozen`` relays
+        whether the device already froze the row (ISSUE 14) so the
+        common finish path skips the carry patch."""
         try:
-            self._release(slot, reason)
+            self._release(slot, reason, frozen=frozen)
         except Exception as e:
             self.logger.error("slot release failed on finish", e, "slot", slot)
 
@@ -1470,15 +1571,31 @@ class Scheduler:
         # completion point): terminate HERE with the stop contract, so
         # the token carries no content and the emitted text is exactly
         # the grammar-complete document.
+        grammar_end = False
         if req.grammar is not None:
             if req.grammar.feed(token) == "end":
-                is_stop = True
+                is_stop = grammar_end = True
         hit_max = st.generated >= req.max_tokens
         out_of_room = st.pos + 1 >= self.engine.config.max_seq_len
         finished = is_stop or hit_max or out_of_room
         reason = None
         if finished:
             reason = "stop" if is_stop else "length"
+            if getattr(self.engine, "_early_exit", False):
+                # On-device stopping (ISSUE 14): did the early-exit carry
+                # freeze this row at the same step? True for every finish
+                # criterion the device enforces — a stop token that fit
+                # the shipped table (EOS rides the table via engine._eos_id
+                # — the SAME source the device was armed from, so an
+                # engine that couldn't ship EOS never overclaims a
+                # freeze), grammar completion, max_tokens, cache-row
+                # exhaustion. False only for host-side backstops (stop
+                # strings at the serving edge arrive as `disconnected`,
+                # handled below), so _process_chunk knows whether
+                # trailing chunk rows were computed or frozen.
+                st.device_stopped = (
+                    hit_max or out_of_room or grammar_end
+                    or (is_stop and int(token) in self._device_stop_ids(req)))
         if req.disconnected and not finished:
             # Early termination (ISSUE 7): the client abandoned the
             # stream — finish at this decode step and free the slot/KV
@@ -1508,8 +1625,18 @@ class Scheduler:
             self._wasted("disconnected", 1, delivered=1)
         return finished, reason
 
-    def _release(self, slot: int, reason: str | None) -> None:
-        self.engine.release_slot(slot)  # frees KV pages in paged mode
+    def _device_stop_ids(self, req: GenRequest) -> set:
+        """The subset of the request's stop ids that fit its on-device
+        stop row (EOS first, then sorted ids, STOP_TABLE_WIDTH wide) —
+        a finish on any other stop id was a host-only detection the
+        device over-ran."""
+        eos = getattr(self.engine, "_eos_id", None)
+        row = build_stop_row(eos, req.stop_token_ids)
+        return {int(t) for t in row if t >= 0}
+
+    def _release(self, slot: int, reason: str | None,
+                 frozen: bool = False) -> None:
+        self.engine.release_slot(slot, frozen=frozen)  # frees KV pages in paged mode
         with self._wake:
             self._free.append(slot)
             self._wake.notify()
@@ -1529,7 +1656,7 @@ def generate_sync(
     """Blocking helper used by tests and the non-streaming path."""
     q: queue.Queue = queue.Queue()
 
-    def cb(token, logprob, finished, reason):
+    def cb(token: int, logprob: float, finished: bool, reason: str | None) -> None:
         q.put((token, finished, reason))
 
     scheduler.submit(GenRequest(

@@ -842,6 +842,13 @@ class SidecarServer:
                 "reason": getattr(self.engine, "attention_path_reason", ""),
                 "mixed_step": getattr(self.engine, "mixed_ok", False),
             },
+            # Desynchronized decode (ISSUE 14): whether the decode loop
+            # stops on device and chains host-free, and at what shape.
+            "decode": {
+                "early_exit": getattr(self.engine, "_early_exit", False),
+                "chunk": self.engine.config.decode_chunk,
+                "pipeline_depth": self.engine.config.pipeline_depth,
+            },
         }
         if self.engine.structured is not None:
             # Structured-outputs snapshot (ISSUE 13): mask-cache hit
@@ -1576,6 +1583,14 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
         config.mixed_step = True
         if svcfg.mixed_step_tokens:
             config.mixed_step_tokens = svcfg.mixed_step_tokens
+    # Desynchronized decode (ISSUE 14): SERVING_DECODE_* maps onto the
+    # engine's early-exit / chunk-size / pipeline-depth knobs before the
+    # engine is built. 0 keeps the engine defaults.
+    config.decode_early_exit = svcfg.decode_early_exit
+    if svcfg.decode_chunk:
+        config.decode_chunk = svcfg.decode_chunk
+    if svcfg.decode_pipeline_depth:
+        config.pipeline_depth = svcfg.decode_pipeline_depth
     engine = Engine(config)
     warm = engine.warmup()
     logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)

@@ -66,6 +66,19 @@ class TokenAutomaton:
             return self.n_states
         return int(self.next_state[state, token])
 
+    def terminal_states(self) -> np.ndarray:
+        """``complete()`` per state, vectorized (ISSUE 14): the (n,)
+        bool vector the StructuredRuntime scatters into the device
+        terminal table, so the early-exit decode carry can fold "the
+        grammar has nothing further to say" into the on-device done
+        flag with one gather."""
+        acc = self.accepts.astype(bool)
+        if 0 <= self.eos_id < self.vocab_size:
+            non_eos = (self._allowed.sum(axis=1)
+                       - self._allowed[:, self.eos_id].astype(np.int64))
+            return acc & (non_eos == 0)
+        return acc & ~self._allowed.any(axis=1)
+
     def complete(self, state: int) -> bool:
         """Accepting state whose only continuation (if any) is EOS —
         the grammar has nothing further to say; the host finishes the

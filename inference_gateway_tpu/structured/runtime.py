@@ -61,6 +61,11 @@ def _set_row(table: jax.Array, row: jax.Array, index: jax.Array) -> jax.Array:
     return jax.lax.dynamic_update_slice(table, row[None, :], (index, 0))
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_span(table: jax.Array, vec: jax.Array, base: jax.Array) -> jax.Array:
+    return jax.lax.dynamic_update_slice(table, vec, (base,))
+
+
 class StructuredRuntime:
     """Owns the compiler cache, the device tables, and span bookkeeping.
 
@@ -91,6 +96,12 @@ class StructuredRuntime:
         self.next_dev: jax.Array | None = None
         self.bits_dev: jax.Array | None = None
         self.bias_dev: jax.Array | None = None
+        # Per-GLOBAL-state terminal flags (ISSUE 14): True where the
+        # grammar is complete (accepting, nothing but EOS left to say) —
+        # gathered by the early-exit chunk carry so constrained rows
+        # freeze on device the moment their document closes. State 0
+        # (the free state) is never terminal.
+        self.term_dev: jax.Array | None = None
         # schema hash -> [base, n_states, refcount]
         self._spans: dict[str, list[int]] = {}
         self._free: list[tuple[int, int]] = [(1, states_budget - 1)]
@@ -133,6 +144,7 @@ class StructuredRuntime:
         self.next_dev = jnp.zeros((self.states_budget, self.vocab_size), jnp.int32)
         self.bits_dev = jnp.asarray(free_bits)
         self.bias_dev = jnp.zeros((self.max_slots + 1, self.vocab_size), jnp.float32)
+        self.term_dev = jnp.zeros((self.states_budget,), bool)
         self.live = True
 
     def _alloc(self, n: int) -> int:
@@ -187,6 +199,10 @@ class StructuredRuntime:
             self.bits_dev = _scatter_rows(self.bits_dev,
                                           jnp.asarray(auto.mask_bits),
                                           jnp.int32(base))
+            assert self.term_dev is not None
+            self.term_dev = _scatter_span(
+                self.term_dev, jnp.asarray(auto.terminal_states()),
+                jnp.int32(base))
             span = [base, auto.n_states, 0]
             self._spans[schema_hash] = span
         span[2] += 1

@@ -99,6 +99,7 @@ def test_noop_recorders_record_nothing():
     noop.record_eventloop_lag("s", 1.0)
     noop.record_eventloop_stall("s")
     noop.record_engine_step("m", "decode", 0.001)
+    noop.record_host_gap("m", "decode", 0.05)
     noop.record_slow_request("s", "total")
     noop.set_engine_gauges("m", slot_occupancy=1.0)
     noop.set_compute_efficiency("m", mfu=0.5, hbm_bandwidth_util=0.5, goodput_mfu=0.5)
@@ -107,6 +108,7 @@ def test_noop_recorders_record_nothing():
     assert noop.token_usage.total_count() == 0
     assert noop.eventloop_lag.total_count() == 0
     assert noop.engine_step_duration.total_count() == 0
+    assert noop.engine_host_gap.total_count() == 0
     assert sum(noop.slow_request_counter.values().values()) == 0
     assert noop.engine_slot_occupancy_gauge.values() == {}
     assert noop.engine_mfu_gauge.values() == {}
@@ -137,6 +139,25 @@ def test_fault_tolerance_instruments_registered_with_expected_shapes():
     degraded = by_name["engine.degraded"]
     assert isinstance(degraded, Gauge)
     assert degraded.label_names == ("gen_ai_request_model",)
+
+
+def test_host_gap_instrument_registered_with_expected_shape():
+    """ISSUE 14: the host-free-steady-state measure — the histogram name,
+    labels, ms unit, and sub-ms boundary coverage are what the
+    acceptance criteria and the bench artifact key on."""
+    from inference_gateway_tpu.otel.metrics import Histogram
+
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    hist = by_name["engine.host_gap_ms"]
+    assert isinstance(hist, Histogram)
+    assert hist.label_names == ("gen_ai_request_model", "kind")
+    assert hist.unit == "ms"
+    # A host-free dispatch is tens of µs of Python: the histogram must
+    # resolve well below 1 ms or the whole measure saturates bucket 0.
+    assert hist.boundaries[0] <= 0.05 and any(b == 1.0 for b in hist.boundaries)
+    otel.record_host_gap("m", "decode", 0.2)
+    assert hist.total_count() == 1
 
 
 def test_attention_path_instrument_registered_with_expected_shape():

@@ -4,6 +4,7 @@ failure damper, and the /debug/roofline e2e on the CPU engine.
 """
 
 import json
+import time
 
 import pytest
 
@@ -493,3 +494,40 @@ def test_bench_accounting_overhead_under_5pct(aloop):
         if result["p99_delta_pct"] < 5.0:
             return
     raise AssertionError(f"p99 overhead above 5% in all 3 runs: {deltas}")
+
+
+def test_early_exit_zeroes_chunk_overrun_waste():
+    """ISSUE 14 regression: a stream finishing mid-chunk with early exit
+    ON records ~zero wasted_tokens{reason="chunk_overrun"} — the device
+    froze the row at the finish, so the trailing steps were never
+    computed and must not be double-counted as waste. With the feature
+    OFF, the legacy over-decode is attributed as before (the contrast
+    pins that the suppression keys off device_stopped, not off the
+    accounting path going dead)."""
+    for early_exit, expect_zero in ((True, True), (False, False)):
+        eng = Engine(EngineConfig(
+            model="test-tiny", max_slots=4, max_seq_len=128, dtype="float32",
+            max_prefill_batch=2, use_mesh=False, decode_chunk=8,
+            decode_early_exit=early_exit))
+        acc = PerfAccounting(StepCostModel.from_engine(eng),
+                             model="test-tiny", measured=False)
+        sched = Scheduler(eng)
+        sched.accounting = acc
+        sched.start()
+        try:
+            # max_tokens=3 finishes in the middle of the first 8-step
+            # chunk, with pipeline_depth more chunks already in flight.
+            generate_sync(sched, [1, 2, 3, 4], max_tokens=3)
+            # Wait for the pipeline tail (the in-flight chunks carrying
+            # the finished stream) to drain — that is where legacy
+            # overrun is attributed.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and (sched._handles or sched._slots):
+                time.sleep(0.02)
+            overrun = acc.wasted.get("chunk_overrun", 0)
+            if expect_zero:
+                assert overrun == 0, f"early exit still billed {overrun} overrun tokens"
+            else:
+                assert overrun > 0, "legacy path stopped attributing overrun"
+        finally:
+            sched.stop()

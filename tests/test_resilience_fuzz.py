@@ -214,8 +214,13 @@ def test_engine_fault_fuzz_no_token_lost_or_duplicated():
                         "prefix of its baseline")
                 assert reqs[i].preempt_count <= PREEMPT_MAX, (trial, i)
             preempted_total += sched.preemptions
+            # Poll the asserted condition itself: a request leaves _slots
+            # a moment before its slot re-enters _free, and that window
+            # now includes the ISSUE 14 carry-freeze dispatch.
             deadline = time.monotonic() + 10
-            while sched.active_requests() and time.monotonic() < deadline:
+            while (time.monotonic() < deadline
+                   and (sched.active_requests()
+                        or len(sched._free) < cfg.max_slots)):
                 time.sleep(0.01)
             assert sorted(sched._free) == list(range(cfg.max_slots)), trial
         finally:
@@ -308,3 +313,154 @@ async def _continuation_trials() -> None:
 
 def test_continuation_fuzz_seeded_kill_scripts(aloop):
     aloop.run(_continuation_trials())
+
+
+# ---------------------------------------------------------------------------
+# Desynchronized-decode byte-identity fuzz (ISSUE 14): seeded trials
+# mixing early-exit on/off, injected KV-pressure preemption,
+# continuation splices, and stop-token / stop-string-shaped /
+# max_tokens / grammar-end finishes. Two full scheduler stacks run the
+# SAME request scripts — one with on-device stopping, one without — and
+# every stream must come out byte-identical and once-only billed in
+# every combination (preemption and early exit are both transparent).
+# ---------------------------------------------------------------------------
+
+DESYNC_SEED = 20260804
+DESYNC_TRIALS = 3
+
+
+def _desync_stack(early_exit: bool):
+    from inference_gateway_tpu.resilience.faults import EngineFaultInjector
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.scheduler import Scheduler
+
+    eng = Engine(EngineConfig(
+        model="test-tiny", max_slots=4, max_seq_len=128, dtype="float32",
+        max_prefill_batch=2, use_mesh=False, attention="paged", page_size=16,
+        prefix_cache=False, decode_chunk=4, prefill_buckets=(16, 32),
+        decode_early_exit=early_exit))
+    sched = Scheduler(eng, preempt_max=5)
+    sched.start()
+    return eng, sched, EngineFaultInjector(eng)
+
+
+def _desync_run(sched, script, timeout=240.0):
+    import queue as _q
+
+    from inference_gateway_tpu.serving.scheduler import GenRequest
+
+    out = [([], [None]) for _ in script]
+    done: _q.Queue = _q.Queue()
+
+    def cb_factory(i):
+        def cb(tok, lp, fin, reason):
+            if not (fin and reason == "stop"):
+                out[i][0].append(tok)
+            if fin:
+                out[i][1][0] = reason
+                done.put(i)
+        return cb
+
+    reqs = []
+    for i, spec in enumerate(script):
+        reqs.append(GenRequest(
+            prompt_ids=list(spec["prompt"]), max_tokens=spec["max_tokens"],
+            temperature=spec["temp"], top_p=0.9 if spec["temp"] else 1.0,
+            seed=spec["seed"], stop_token_ids=frozenset(spec["stops"]),
+            grammar=spec["grammar"], callback=cb_factory(i),
+            resume_generated=spec.get("resume", 0)))
+    for r in reqs:
+        sched.submit(r)
+    for _ in script:
+        done.get(timeout=timeout)
+    return [(toks, r[0]) for toks, r in out]
+
+
+def test_desync_decode_fuzz_byte_identity_and_once_only_billing():
+    rng = random.Random(DESYNC_SEED)
+    eng_on, s_on, inj_on = _desync_stack(True)
+    eng_off, s_off, inj_off = _desync_stack(False)
+    try:
+        seen_tokens: list = []
+        for trial in range(DESYNC_TRIALS):
+            script = []
+            n_reqs = rng.randint(3, 4)
+            for i in range(n_reqs):
+                prompt = [rng.randint(1, 40) for _ in range(rng.randint(2, 6))]
+                temp = rng.choice([0.0, 0.0, 0.7])
+                spec = {
+                    "prompt": prompt,
+                    "max_tokens": rng.randint(1, 18),
+                    "temp": temp,
+                    "seed": rng.randint(1, 10_000) if temp else None,
+                    "stops": set(),
+                    "grammar": None,
+                }
+                # Stop sets drawn from tokens earlier trials actually
+                # emitted, so stop-token finishes really fire; an
+                # occasional oversized set exercises the host backstop
+                # past the device table width.
+                if seen_tokens and rng.random() < 0.5:
+                    spec["stops"] = {rng.choice(seen_tokens)
+                                     for _ in range(rng.randint(1, 3))}
+                    if rng.random() < 0.3:
+                        spec["stops"] |= set(range(3000, 3012))
+                script.append(spec)
+            if trial % 2 == 1:
+                # One grammar-constrained request per odd trial — each
+                # stack gets its OWN session (host-mirror state).
+                script[0]["stops"] = set()
+                script[0]["temp"], script[0]["seed"] = 0.0, None
+                script[0]["max_tokens"] = rng.randint(8, 40)
+                g_on = eng_on.structured.session_for({"type": "json_object"})
+                g_off = eng_off.structured.session_for({"type": "json_object"})
+            # Inject 0-2 recoverable page exhaustions at a shared future
+            # call index: whatever preemption each stack actually
+            # performs, streams must stay identical.
+            for _ in range(rng.randint(0, 2)):
+                off = rng.randint(1, 6)
+                inj_on.at("decode_submit",
+                          inj_on.calls["decode_submit"] + off, "exhaust")
+                inj_off.at("decode_submit",
+                           inj_off.calls["decode_submit"] + off, "exhaust")
+            script_on = [dict(s) for s in script]
+            script_off = [dict(s) for s in script]
+            if trial % 2 == 1:
+                script_on[0]["grammar"] = g_on
+                script_off[0]["grammar"] = g_off
+            got_on = _desync_run(s_on, script_on)
+            got_off = _desync_run(s_off, script_off)
+            assert got_on == got_off, (trial, got_on, got_off)
+            for (toks, reason), spec in zip(got_on, script):
+                # Once-only billing: never more than max_tokens emitted,
+                # across any preemption resume.
+                assert len(toks) <= spec["max_tokens"], (trial, spec, toks)
+                assert reason in ("stop", "length"), (trial, reason)
+                seen_tokens.extend(t for t in toks[2:] if t > 0)
+            # Continuation splice (greedy, unconstrained, length-finished
+            # streams): resume from prompt + emitted-so-far with the
+            # remaining budget — the spliced stream must extend the
+            # original byte-identically on BOTH stacks.
+            constrained_idx = 0 if trial % 2 == 1 else None
+            pick = next((i for i, sp in enumerate(script)
+                         if i != constrained_idx and sp["temp"] == 0.0
+                         and got_on[i][1] == "length" and got_on[i][0]), None)
+            if pick is None:
+                continue
+            head_spec = script[pick]
+            head_toks, _head_reason = got_on[pick]
+            extended = {**head_spec, "grammar": None,
+                        "max_tokens": head_spec["max_tokens"] + 5}
+            splice = {**extended,
+                      "prompt": list(head_spec["prompt"]) + head_toks,
+                      "resume": len(head_toks)}
+            ref_on = _desync_run(s_on, [dict(extended)])
+            spl_on = _desync_run(s_on, [dict(splice)])
+            spl_off = _desync_run(s_off, [dict(splice)])
+            assert head_toks + spl_on[0][0] == ref_on[0][0], trial
+            assert spl_on[0] == spl_off[0], trial
+    finally:
+        inj_on.uninstall()
+        inj_off.uninstall()
+        s_on.stop()
+        s_off.stop()

@@ -442,6 +442,76 @@ def test_jax_hot_path_covers_mixed_descriptor_assembly():
                 select="jax-hot-path") == []
 
 
+def test_jax_hot_path_chain_steady_bans_host_construction_and_loops():
+    """ISSUE 14: the host-free chained-submit scope — the whole of
+    Engine._chain_submit_locked and every `if chain:` branch of
+    decode_chunk_submit — additionally bans np.* host-array
+    construction, jnp.asarray uploads, and python loops: a chained
+    steady-state submit reads persistent state and dispatches, nothing
+    else."""
+    bad_fn = """
+    import numpy as np
+
+    class Engine:
+        def _chain_submit_locked(self, n):
+            write_idx = np.full((8, n), 0)  # per-chunk host assembly
+            for slot in range(8):           # per-slot loop
+                write_idx[slot] = slot
+            return self._decode_chunk_fn_paged_ee(write_idx)
+    """
+    findings = lint(bad_fn, path="inference_gateway_tpu/serving/engine.py",
+                    select="jax-hot-path")
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "np.full" in msgs and "python loop" in msgs
+
+    bad_branch = """
+    import jax.numpy as jnp
+
+    class Engine:
+        def decode_chunk_submit(self, tokens, positions, chain=False):
+            if chain:
+                pos = jnp.asarray(positions)  # upload on the chained path
+                return self._chain_submit_locked(pos)
+            return self._fresh_submit(tokens, positions)
+    """
+    findings = lint(bad_branch, path="inference_gateway_tpu/serving/engine.py",
+                    select="jax-hot-path")
+    assert len(findings) == 1 and "upload" in findings[0].message
+
+    good = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class Engine:
+        def _chain_submit_locked(self, n):
+            need = self._chain_active & (self._pred_pos + n > self._reserved)
+            if need.any():
+                self._reserve_chain_horizon(need, n)  # amortized slow path
+            self._pred_pos = self._pred_pos + n * self._chain_active
+            return self._decode_chunk_fn_paged_ee(self.params, self.cache)
+
+        def _reserve_chain_horizon(self, need, n):
+            # Outside the chain-steady scope: loops + uploads are the
+            # amortized horizon refresh, not per-chunk work.
+            for slot in np.nonzero(need)[0]:
+                self._ensure_with_evict(int(slot), int(n))
+            self._dev_page_table = jnp.asarray(self.allocator.page_table())
+
+        def decode_chunk_submit(self, tokens, positions, chain=False):
+            if chain:
+                return self._chain_submit_locked(8)
+            seeds = np.zeros((8,))  # fresh path may build host arrays
+            return self._fresh_submit(tokens, positions, seeds)
+    """
+    assert lint(good, path="inference_gateway_tpu/serving/engine.py",
+                select="jax-hot-path") == []
+
+    # The scope is path-anchored: another module's decode_chunk_submit
+    # look-alike is not in scope.
+    assert lint(bad_branch, path="somewhere/else.py", select="jax-hot-path") == []
+
+
 def test_jax_hot_path_covers_structured_mask_upload_path():
     """ISSUE 13: the grammar mask scatter/upload path is submit-scope —
     materializing a device table while loading a span (or registering a
