@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from graftlint.checkers.async_blocking import check as _async_blocking
 from graftlint.checkers.clock_discipline import check as _clock_discipline
+from graftlint.checkers.cross_process_state import check as _cross_process_state
 from graftlint.checkers.cross_thread_state import check as _cross_thread_state
 from graftlint.checkers.jax_hot_path import check as _jax_hot_path
 from graftlint.checkers.resource_release import check as _resource_release
@@ -26,6 +27,10 @@ CHECKERS = [
      "attributes mutated both on a worker thread and from other threads "
      "must be lock-protected on every write",
      _cross_thread_state),
+    ("cross-process-state",
+     "counter mutations in slab-bound classes (cluster shared-memory "
+     "consumers) must mirror into the shm segment or carry a reason pragma",
+     _cross_process_state),
     ("jax-hot-path",
      "host syncs (.item, np.asarray, jax.device_get, block_until_ready) "
      "in jitted step functions and the engine/scheduler submit path",

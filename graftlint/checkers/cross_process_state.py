@@ -1,0 +1,128 @@
+"""cross-process-state: slab-bound counter mutations must mirror to shm.
+
+Encodes the ISSUE 16 multi-worker discipline statically. A class that
+binds a cluster slab in ``__init__`` (an attribute assigned from a
+parameter named ``shared``/``slab``/``shared_slab``/``cluster_slab``, or
+assigned to an attribute with one of those names) is *slab-bound*: its
+ledger is part of cluster-wide state, and a counter it bumps only in
+process memory is invisible to every peer worker, the /metrics merge,
+and the supervisor's crash reaper — exactly the phantom-load bug class
+the shared segment exists to kill.
+
+The rule: in a slab-bound class, any method performing an augmented
+assignment on an attribute (``st.in_flight += 1``, ``self.total -= n``,
+``self.counts[k] += 1``) must also touch the slab — a direct call
+through the slab attribute (``self._shared.add(...)``) or a self-call to
+a method that does (one mirror hop, e.g. ``self._mirror(...)``).
+Mutations that are deliberately process-local carry the usual reason
+pragma: ``# graftlint: disable=cross-process-state -- <why>``.
+
+Plain assignments are not flagged (initialization and snapshot swaps are
+legitimate local idioms); *unmirrored counter arithmetic* is the bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from graftlint.core import Finding, ParsedModule, dotted_name, flag
+
+CHECKER = "cross-process-state"
+
+_SLAB_PARAMS = {"shared", "slab", "shared_slab", "cluster_slab"}
+_SLAB_ATTRS = {"_shared", "_slab", "shared", "slab"}
+
+
+def _methods(cls: ast.ClassDef) -> dict[str, ast.AST]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _slab_attrs(cls: ast.ClassDef, methods: dict[str, ast.AST]) -> set[str]:
+    """Attributes holding the bound slab: ``self.<attr> = <slab param>``
+    in ``__init__``, or an assignment onto a slab-named attribute."""
+    init = methods.get("__init__")
+    if init is None:
+        return set()
+    out: set[str] = set()
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign):
+            continue
+        from_param = (isinstance(node.value, ast.Name)
+                      and node.value.id in _SLAB_PARAMS)
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and (from_param or t.attr in _SLAB_ATTRS)):
+                out.add(t.attr)
+    return out
+
+
+def _touches_slab(fn: ast.AST, slab_attrs: set[str]) -> bool:
+    """True when ``fn`` calls through the slab directly
+    (``self._shared.add(...)``, including a longer chain like
+    ``self._shared.segment.tenant_total(...)``)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        parts = d.split(".")
+        if len(parts) >= 3 and parts[0] == "self" and parts[1] in slab_attrs:
+            return True
+    return False
+
+
+def _self_calls(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            out.add(node.func.attr)
+    return out
+
+
+def _counter_mutations(fn: ast.AST) -> list[ast.AST]:
+    """Every augmented assignment whose target is an attribute (or a
+    container slot on an attribute) — counter arithmetic on state."""
+    out: list[ast.AST] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.AugAssign):
+            continue
+        t: ast.expr = node.target
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute):
+            out.append(node)
+    return out
+
+
+def check(mod: ParsedModule) -> list[Finding]:
+    out: list[Finding] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = _methods(cls)
+        slab_attrs = _slab_attrs(cls, methods)
+        if not slab_attrs:
+            continue
+        # One mirror hop: methods that touch the slab directly are
+        # mirrors; a mutating method is compliant if it is one, or
+        # self-calls one.
+        mirrors = {name for name, fn in methods.items()
+                   if _touches_slab(fn, slab_attrs)}
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue  # construction precedes any peer visibility
+            compliant = name in mirrors or bool(_self_calls(fn) & mirrors)
+            if compliant:
+                continue
+            for node in _counter_mutations(fn):
+                flag(out, mod, CHECKER, node,
+                     f"'{cls.name}.{name}' mutates counter state but the "
+                     f"class is slab-bound ({', '.join(sorted(slab_attrs))}) "
+                     f"— mirror the mutation into the shared segment "
+                     f"(self.{sorted(slab_attrs)[0]}.add(...) or a mirror "
+                     f"method) so peer workers and the crash reaper see it, "
+                     f"or carry a reason pragma")
+    return out

@@ -1,0 +1,27 @@
+"""Multi-worker gateway cluster (ISSUE 16 tentpole).
+
+One supervisor process forks N gateway workers onto ``SO_REUSEPORT``
+listeners and keeps them alive (SIGCHLD + heartbeat staleness →
+respawn); cross-worker state — the admission ledger, tenant quota
+counters, prober/breaker verdicts — lives in a crash-safe shared-memory
+segment of lock-free per-worker counter slabs with generation-stamped
+epochs, so a SIGKILLed worker's in-flight tickets and gauge
+contributions are *reaped*, never leaked. ``CLUSTER_WORKERS=1`` (the
+default) keeps today's single-process behavior byte-identical: no
+segment, no supervisor, no extra syscalls.
+
+See docs/scaling.md for the segment layout, the supervisor lifecycle,
+tenant fairness semantics, and what is deliberately NOT shared.
+"""
+
+from inference_gateway_tpu.cluster.shm import ClusterSegment, WorkerSlab
+from inference_gateway_tpu.cluster.supervisor import Supervisor
+from inference_gateway_tpu.cluster.tenancy import TenantPolicy, derive_tenant
+
+__all__ = [
+    "ClusterSegment",
+    "WorkerSlab",
+    "Supervisor",
+    "TenantPolicy",
+    "derive_tenant",
+]

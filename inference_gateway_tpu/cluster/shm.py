@@ -1,0 +1,447 @@
+"""Crash-safe cross-worker shared state (ISSUE 16 tentpole).
+
+One ``multiprocessing.shared_memory`` segment holds a fixed header plus
+one *slab* per worker slot. A slab is single-writer (its worker) and
+many-reader (every worker's /metrics merge, the supervisor's staleness
+check), so no locks exist anywhere in the segment:
+
+- **counters** — an array of aligned signed 64-bit cells, one per name
+  in the schema agreed at creation. The owning worker mirrors its
+  admission ledger into them (``in_flight_streaming`` & co.); readers
+  sum over live slabs to see the cluster ledger. Aligned 8-byte stores
+  are not torn on the platforms the gateway targets, and single-writer
+  slabs make lost updates structurally impossible (pinned by
+  ``tests/race_harness.hammer_shm_ledger``).
+- **tenant cells** — a second array, indexed by ``tenant_slot(id)``
+  (stable hash), carrying per-tenant in-flight occupancy for the
+  cluster-wide quota check. Hash collisions merge two tenants' cells —
+  size ``CLUSTER_TENANT_SLOTS`` ≥ expected active tenants.
+- **a verdict blob** — a seqlock-guarded JSON blob (sequence bumped to
+  odd before the write, even after) where the worker publishes its
+  prober/breaker verdicts; readers retry on an odd or changed sequence,
+  so a torn read is never *returned*.
+- **generation epoch** — stamped by the supervisor before the worker is
+  spawned; ``generation == 0`` means the slot is dead and every reader
+  skips it. ``reap()`` zeroes the generation FIRST, then the cells, so
+  a crashed worker's phantom in-flight tickets, quota holds, and gauge
+  contributions vanish from every aggregate in one store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Sequence
+
+_MAGIC = 0x49475443  # "IGTC"
+_VERSION = 1
+
+# magic u32, version u32, workers u32, counters u32, tenant_slots u32,
+# blob_cap u32 — attach() validates every field against the caller's
+# schema so two builds can never silently disagree about the layout.
+_HEADER = struct.Struct("<IIIIII")
+# Per-slab head: generation u64, pid u64, heartbeat f64 (CLOCK_MONOTONIC
+# seconds — system-wide on Linux, so the supervisor and workers share
+# the timebase without wall-clock jumps faking liveness).
+_SLAB_HEAD = struct.Struct("<QQd")
+_I64 = struct.Struct("<q")
+# Blob head: sequence u64 (odd = write in progress), length u32.
+_BLOB_HEAD = struct.Struct("<QI")
+
+#: Counter names the gateway's admission ledger mirrors (overload.py).
+#: The schema is part of the segment identity: supervisor and workers
+#: must pass the same tuple (both derive it from this constant).
+GATEWAY_COUNTERS: tuple[str, ...] = (
+    "in_flight_streaming",
+    "in_flight_buffered",
+    "queued_streaming",
+    "queued_buffered",
+    "admitted_total",
+    "shed_total",
+)
+
+DEFAULT_TENANT_SLOTS = 64
+DEFAULT_BLOB_CAP = 16384
+
+
+def tenant_slot(tenant: str, slots: int) -> int:
+    """Stable slot index for a tenant id (same in every worker and
+    across restarts — sha256, not ``hash()``, which is salted)."""
+    digest = hashlib.sha256(tenant.encode("utf-8", "replace")).digest()
+    return int.from_bytes(digest[:8], "big") % max(1, slots)
+
+
+def _align(n: int, to: int = 64) -> int:
+    return (n + to - 1) // to * to
+
+
+class ClusterSegment:
+    """One attached (or owned) view of the cluster's shared segment."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, workers: int,
+                 counters: tuple[str, ...], tenant_slots: int, blob_cap: int,
+                 owner: bool) -> None:
+        self._shm = shm
+        self.workers = workers
+        self.counters = counters
+        self.tenant_slots = tenant_slots
+        self.blob_cap = blob_cap
+        self._owner = owner
+        self._index = {name: i for i, name in enumerate(counters)}
+        self._counters_off = _SLAB_HEAD.size
+        self._tenants_off = self._counters_off + 8 * len(counters)
+        self._blob_off = self._tenants_off + 8 * tenant_slots
+        self.slab_size = _align(self._blob_off + _BLOB_HEAD.size + blob_cap)
+        self._base = _align(_HEADER.size)
+
+    # -- lifecycle -------------------------------------------------------
+    @classmethod
+    def create(cls, name: str, workers: int,
+               counters: Sequence[str] = GATEWAY_COUNTERS,
+               tenant_slots: int = DEFAULT_TENANT_SLOTS,
+               blob_cap: int = DEFAULT_BLOB_CAP) -> "ClusterSegment":
+        counters = tuple(counters)
+        probe = cls(None, workers, counters, tenant_slots, blob_cap, owner=True)  # type: ignore[arg-type]
+        size = probe._base + workers * probe.slab_size
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        seg = cls(shm, workers, counters, tenant_slots, blob_cap, owner=True)
+        shm.buf[:size] = b"\x00" * size
+        _HEADER.pack_into(shm.buf, 0, _MAGIC, _VERSION, workers,
+                          len(counters), tenant_slots, blob_cap)
+        return seg
+
+    @classmethod
+    def attach(cls, name: str, workers: int,
+               counters: Sequence[str] = GATEWAY_COUNTERS,
+               tenant_slots: int = DEFAULT_TENANT_SLOTS,
+               blob_cap: int = DEFAULT_BLOB_CAP) -> "ClusterSegment":
+        counters = tuple(counters)
+        shm = shared_memory.SharedMemory(name=name, create=False)
+        # CPython's per-process resource tracker registers every attach
+        # and unlinks the segment when the attaching process exits
+        # (bpo-38119) — so the FIRST worker death would tear the whole
+        # cluster's segment out from under the supervisor and every
+        # respawn would fail to attach. The supervisor owns the
+        # lifetime; attachers must leave teardown to it.
+        try:
+            resource_tracker.unregister(getattr(shm, "_name", name),
+                                        "shared_memory")
+        except Exception:
+            pass
+        magic, version, w, c, t, b = _HEADER.unpack_from(shm.buf, 0)
+        if (magic, version, w, c, t, b) != (
+                _MAGIC, _VERSION, workers, len(counters), tenant_slots, blob_cap):
+            shm.close()
+            raise ValueError(
+                f"cluster segment {name!r} layout mismatch: "
+                f"header={(magic, version, w, c, t, b)} expected="
+                f"{(_MAGIC, _VERSION, workers, len(counters), tenant_slots, blob_cap)}")
+        return cls(shm, workers, counters, tenant_slots, blob_cap, owner=False)
+
+    def close(self, unlink: bool = False) -> None:
+        self._shm.close()
+        if unlink or self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    # -- slab addressing -------------------------------------------------
+    def _slab(self, i: int) -> int:
+        if not 0 <= i < self.workers:
+            raise IndexError(f"worker index {i} out of range 0..{self.workers - 1}")
+        return self._base + i * self.slab_size
+
+    def slab(self, i: int) -> "WorkerSlab":
+        self._slab(i)  # bounds check
+        return WorkerSlab(self, i)
+
+    # -- epoch management (supervisor-side) ------------------------------
+    def begin_generation(self, i: int, generation: int, pid: int = 0,
+                         now: float = 0.0) -> None:
+        """Zero the slab and stamp a fresh epoch. Called by the
+        supervisor BEFORE the worker is spawned (the slab has exactly
+        one writer at any instant: the supervisor while the slot is
+        dead, the worker while it is alive)."""
+        off = self._slab(i)
+        self._shm.buf[off:off + self.slab_size] = b"\x00" * self.slab_size
+        _SLAB_HEAD.pack_into(self._shm.buf, off, generation, pid, now)
+
+    def set_pid(self, i: int, pid: int) -> None:
+        off = self._slab(i)
+        struct.pack_into("<Q", self._shm.buf, off + 8, pid)
+
+    def reap(self, i: int) -> dict[str, int]:
+        """Reclaim a dead worker's slab: generation goes to zero FIRST
+        (readers stop counting the slab in the same store), then every
+        cell is cleared. Returns the reclaimed counter values — the
+        in-flight tickets and quota holds the crash would otherwise
+        have leaked forever (ISSUE 16 ticket-leak satellite)."""
+        off = self._slab(i)
+        reclaimed = {name: self._read_counter(i, idx)
+                     for name, idx in self._index.items()}
+        struct.pack_into("<Q", self._shm.buf, off, 0)  # generation = 0
+        self._shm.buf[off + 8:off + self.slab_size] = \
+            b"\x00" * (self.slab_size - 8)
+        return reclaimed
+
+    # -- raw field access ------------------------------------------------
+    def generation(self, i: int) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, self._slab(i))[0]
+
+    def pid(self, i: int) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, self._slab(i) + 8)[0]
+
+    def heartbeat(self, i: int) -> float:
+        return struct.unpack_from("<d", self._shm.buf, self._slab(i) + 16)[0]
+
+    def _read_counter(self, i: int, idx: int) -> int:
+        off = self._slab(i) + self._counters_off + 8 * idx
+        return _I64.unpack_from(self._shm.buf, off)[0]
+
+    def _read_tenant(self, i: int, slot: int) -> int:
+        off = self._slab(i) + self._tenants_off + 8 * slot
+        return _I64.unpack_from(self._shm.buf, off)[0]
+
+    # -- aggregation (any process) ---------------------------------------
+    def live(self) -> list[int]:
+        return [i for i in range(self.workers) if self.generation(i) != 0]
+
+    def totals(self) -> dict[str, int]:
+        """Cluster-wide counter sums over LIVE slabs only — a reaped
+        worker contributes nothing."""
+        live = self.live()
+        return {name: sum(self._read_counter(i, idx) for i in live)
+                for name, idx in self._index.items()}
+
+    def counter_total(self, name: str) -> int:
+        idx = self._index[name]
+        return sum(self._read_counter(i, idx) for i in self.live())
+
+    def worker_counter(self, i: int, name: str) -> int:
+        return self._read_counter(i, self._index[name])
+
+    def tenant_total(self, slot: int) -> int:
+        return sum(self._read_tenant(i, slot) for i in self.live())
+
+    def tenant_totals(self) -> dict[int, int]:
+        out: dict[int, int] = {}
+        for slot in range(self.tenant_slots):
+            v = self.tenant_total(slot)
+            if v:
+                out[slot] = v
+        return out
+
+    # -- verdict blobs (seqlock) -----------------------------------------
+    def write_blob(self, i: int, payload: dict[str, Any]) -> None:
+        data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        if len(data) > self.blob_cap:
+            data = b"{}"  # over-cap verdicts degrade to empty, never tear
+        off = self._slab(i) + self._blob_off
+        seq, _n = _BLOB_HEAD.unpack_from(self._shm.buf, off)
+        _BLOB_HEAD.pack_into(self._shm.buf, off, seq + 1, len(data))  # odd: writing
+        start = off + _BLOB_HEAD.size
+        self._shm.buf[start:start + len(data)] = data
+        _BLOB_HEAD.pack_into(self._shm.buf, off, seq + 2, len(data))  # even: stable
+
+    def read_blob(self, i: int) -> dict[str, Any] | None:
+        off = self._slab(i) + self._blob_off
+        for _attempt in range(8):
+            seq0, n = _BLOB_HEAD.unpack_from(self._shm.buf, off)
+            if seq0 % 2 == 1:
+                continue  # mid-write: retry
+            if n == 0:
+                return None
+            start = off + _BLOB_HEAD.size
+            data = bytes(self._shm.buf[start:start + min(n, self.blob_cap)])
+            seq1, _ = _BLOB_HEAD.unpack_from(self._shm.buf, off)
+            if seq1 != seq0:
+                continue  # torn: a write landed mid-copy
+            try:
+                parsed = json.loads(data.decode("utf-8"))
+            except ValueError:
+                continue
+            return parsed if isinstance(parsed, dict) else None
+        return None
+
+    def blobs(self) -> dict[int, dict[str, Any]]:
+        out: dict[int, dict[str, Any]] = {}
+        for i in self.live():
+            blob = self.read_blob(i)
+            if blob is not None:
+                out[i] = blob
+        return out
+
+    # -- health read-merge -----------------------------------------------
+    def peer_ejected(self, self_index: int, provider: str, model: str) -> bool:
+        """Read-merged replica-health verdict: True when at least half
+        of the OTHER live workers that published probe verdicts report
+        ``provider/model`` ejected. The local prober stays authoritative
+        for this worker's own evidence; the merge only ADDS peers'
+        detections, so one confused worker can never readmit a replica
+        the rest of the cluster has condemned."""
+        key = f"{provider}/{model}"
+        votes = ejected = 0
+        for i, blob in self.blobs().items():
+            if i == self_index:
+                continue
+            probes = blob.get("probes")
+            if not isinstance(probes, dict) or key not in probes:
+                continue
+            votes += 1
+            if probes[key]:
+                ejected += 1
+        return votes > 0 and ejected * 2 >= votes and ejected > 0
+
+    # -- introspection ---------------------------------------------------
+    def status(self, now: float) -> dict[str, Any]:
+        """The /debug/status "cluster" section: per-worker epoch, pid,
+        heartbeat age, counter cells, and the cluster-wide sums."""
+        per_worker = []
+        for i in range(self.workers):
+            gen = self.generation(i)
+            entry: dict[str, Any] = {"worker": i, "generation": gen}
+            if gen != 0:
+                hb = self.heartbeat(i)
+                entry.update({
+                    "pid": self.pid(i),
+                    "heartbeat_age_s": round(max(0.0, now - hb), 3) if hb else None,
+                    "counters": {name: self._read_counter(i, idx)
+                                 for name, idx in self._index.items()},
+                })
+            per_worker.append(entry)
+        return {
+            "segment": self.name,
+            "workers": self.workers,
+            "live": self.live(),
+            "totals": self.totals(),
+            "tenant_totals": self.tenant_totals(),
+            "per_worker": per_worker,
+        }
+
+    def render_prometheus(self, now: float) -> str:
+        """Cluster-level series appended to any worker's /metrics
+        exposition: whichever worker the scrape lands on (SO_REUSEPORT
+        picks one), the cluster aggregates are identical — that is the
+        per-worker metric merge the metrics listener owes operators."""
+        lines = [
+            "# HELP cluster_worker_up Live (generation-stamped) cluster worker slots.",
+            "# TYPE cluster_worker_up gauge",
+        ]
+        live = set(self.live())
+        for i in range(self.workers):
+            lines.append(f'cluster_worker_up{{worker="{i}"}} {1 if i in live else 0}')
+        lines += [
+            "# HELP cluster_worker_heartbeat_age_seconds Seconds since each live worker's heartbeat.",
+            "# TYPE cluster_worker_heartbeat_age_seconds gauge",
+        ]
+        for i in sorted(live):
+            hb = self.heartbeat(i)
+            age = max(0.0, now - hb) if hb else 0.0
+            lines.append(f'cluster_worker_heartbeat_age_seconds{{worker="{i}"}} {age:.3f}')
+        lines += [
+            "# HELP cluster_admission Cluster-wide admission ledger (live slabs summed).",
+            "# TYPE cluster_admission gauge",
+        ]
+        for name, value in sorted(self.totals().items()):
+            lines.append(f'cluster_admission{{counter="{name}"}} {value}')
+        tenants = self.tenant_totals()
+        if tenants:
+            lines += [
+                "# HELP cluster_tenant_in_flight Cluster-wide per-tenant-slot in-flight occupancy.",
+                "# TYPE cluster_tenant_in_flight gauge",
+            ]
+            for slot, value in sorted(tenants.items()):
+                lines.append(f'cluster_tenant_in_flight{{slot="{slot}"}} {value}')
+        return "\n".join(lines) + "\n"
+
+
+class WorkerSlab:
+    """One worker's single-writer view of its slab. Every mutation is a
+    read-modify-write on a cell only this process writes, so there is
+    nothing to lock; the generation is stamped by the supervisor before
+    spawn and never touched from here."""
+
+    __slots__ = ("_seg", "index")
+
+    def __init__(self, seg: ClusterSegment, index: int) -> None:
+        self._seg = seg
+        self.index = index
+
+    @property
+    def generation(self) -> int:
+        return self._seg.generation(self.index)
+
+    @property
+    def segment(self) -> ClusterSegment:
+        """The whole segment — consumers (the admission ledger's
+        cluster-wide quota check, the metrics merge) read aggregates
+        through this; writes stay slab-scoped."""
+        return self._seg
+
+    def add(self, name: str, delta: int) -> None:
+        idx = self._seg._index[name]
+        off = self._seg._slab(self.index) + self._seg._counters_off + 8 * idx
+        cur = _I64.unpack_from(self._seg._shm.buf, off)[0]
+        _I64.pack_into(self._seg._shm.buf, off, cur + delta)
+
+    def get(self, name: str) -> int:
+        return self._seg._read_counter(self.index, self._seg._index[name])
+
+    def tenant_add(self, slot: int, delta: int) -> None:
+        off = (self._seg._slab(self.index) + self._seg._tenants_off
+               + 8 * (slot % self._seg.tenant_slots))
+        cur = _I64.unpack_from(self._seg._shm.buf, off)[0]
+        _I64.pack_into(self._seg._shm.buf, off, cur + delta)
+
+    def tenant_get(self, slot: int) -> int:
+        return self._seg._read_tenant(self.index, slot % self._seg.tenant_slots)
+
+    def beat(self, now: float) -> None:
+        struct.pack_into("<d", self._seg._shm.buf,
+                         self._seg._slab(self.index) + 16, now)
+
+    def publish(self, payload: dict[str, Any]) -> None:
+        self._seg.write_blob(self.index, payload)
+
+
+def _hammer_main(argv: list[str]) -> int:
+    """Child entry for ``tests/race_harness.hammer_shm_ledger``:
+    ``python -m inference_gateway_tpu.cluster.shm --hammer <name>
+    <workers> <index> <iters>``. Attaches the hammer segment and drives
+    its slab exactly as the harness' conservation math expects."""
+    name, workers, index, iters = argv[0], int(argv[1]), int(argv[2]), int(argv[3])
+    seg = ClusterSegment.attach(name, workers=workers,
+                                counters=("held", "ops"), tenant_slots=8,
+                                blob_cap=1024)
+    try:
+        slab = seg.slab(index)
+        for j in range(iters):
+            slab.add("held", 1)
+            slab.add("ops", 1)
+            slab.tenant_add(index % 8, 1)
+            if j % 100 == 0:
+                slab.publish({"worker": index, "progress": j})
+        for j in range(iters - (index + 1)):
+            slab.add("held", -1)
+            slab.add("ops", 1)
+            slab.tenant_add(index % 8, -1)
+        slab.publish({"worker": index, "progress": iters, "done": True})
+    finally:
+        seg.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    import sys
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "--hammer":
+        raise SystemExit(_hammer_main(sys.argv[2:]))
+    raise SystemExit("usage: python -m inference_gateway_tpu.cluster.shm --hammer "
+                     "<name> <workers> <index> <iters>")

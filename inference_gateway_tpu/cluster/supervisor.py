@@ -1,0 +1,295 @@
+"""The cluster supervisor (ISSUE 16 tentpole).
+
+A small, boring process: it owns the shared segment and N worker
+subprocesses, and does exactly three things —
+
+- **detect death**: SIGCHLD (where the loop allows signal handlers)
+  wakes the monitor immediately; a polling pass every
+  ``CLUSTER_CHECK_INTERVAL`` catches the rest, plus *heartbeat
+  staleness* — a worker that is alive as a process but wedged (event
+  loop stuck, VM paused) stops beating and is killed and replaced;
+- **reap the dead generation**: ``segment.reap(i)`` reclaims the
+  crashed worker's in-flight tickets, quota holds, and gauge
+  contributions before the replacement spawns — phantom load never
+  outlives one check interval;
+- **respawn with zero downtime**: the other workers' ``SO_REUSEPORT``
+  listeners never close, so the shared port keeps accepting while the
+  replacement boots. A rolling restart (``rolling_restart()``, wired to
+  SIGHUP) SIGTERMs one worker at a time and rides each worker's own
+  graceful drain (PR 2 ``begin_drain()``/``wait_idle()``).
+
+The supervisor itself serves no traffic and holds no locks: every
+judgement reads the lock-free segment.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from inference_gateway_tpu.cluster.shm import ClusterSegment
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
+
+SpawnFn = Callable[[int, int], "subprocess.Popen[bytes]"]
+
+
+@dataclass
+class WorkerHandle:
+    index: int
+    generation: int
+    proc: "subprocess.Popen[bytes]"
+    started: float
+    restarts: int = 0
+
+
+class Supervisor:
+    """Crash supervision for one fixed-size worker fleet."""
+
+    def __init__(self, segment: ClusterSegment, spawn: SpawnFn, *,
+                 heartbeat_timeout: float = 5.0,
+                 check_interval: float = 0.5,
+                 term_grace: float = 35.0,
+                 clock: Clock | None = None,
+                 logger: Any = None) -> None:
+        self.segment = segment
+        self._spawn_fn = spawn
+        self.heartbeat_timeout = heartbeat_timeout
+        self.check_interval = check_interval
+        self.term_grace = term_grace
+        self.clock = clock or MonotonicClock()
+        self.logger = logger
+        self.workers: dict[int, WorkerHandle] = {}
+        self.respawns = 0
+        self._next_generation = 1
+        self._wake = asyncio.Event()
+        self._stopping = False
+        self._sigchld_installed = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        """Stamp epochs and fork the initial fleet."""
+        for i in range(self.segment.workers):
+            self._spawn(i)
+        try:
+            # SIGCHLD makes death detection immediate; the polling pass
+            # remains the correctness path (signal handlers are only
+            # installable on a main-thread loop).
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGCHLD, self._wake.set)
+            self._sigchld_installed = True
+        except (ValueError, NotImplementedError, RuntimeError):
+            self._sigchld_installed = False
+
+    def _spawn(self, index: int, restarts: int = 0) -> WorkerHandle:
+        generation = self._next_generation
+        self._next_generation += 1
+        now = self.clock.now()
+        # The epoch is stamped BEFORE the fork: the slab has exactly one
+        # writer at any instant (the supervisor while the slot is dead,
+        # the worker once it boots), and the stamp doubles as the
+        # initial heartbeat so a slow boot isn't read as staleness.
+        self.segment.begin_generation(index, generation, now=now)
+        proc = self._spawn_fn(index, generation)
+        self.segment.set_pid(index, proc.pid)
+        handle = WorkerHandle(index=index, generation=generation, proc=proc,
+                              started=now, restarts=restarts)
+        self.workers[index] = handle
+        if self.logger is not None:
+            self.logger.info("cluster worker spawned", "worker", index,
+                             "generation", generation, "pid", proc.pid)
+        return handle
+
+    # -- death detection -------------------------------------------------
+    def check_once(self) -> list[int]:
+        """One monitor pass: reap-and-respawn every dead or heartbeat-
+        stale worker. Returns the respawned indices."""
+        respawned: list[int] = []
+        if self._stopping:
+            return respawned
+        now = self.clock.now()
+        for index, handle in list(self.workers.items()):
+            exited = handle.proc.poll() is not None
+            stale = False
+            if not exited and self.heartbeat_timeout > 0:
+                stale = now - self.segment.heartbeat(index) > self.heartbeat_timeout
+            if not exited and not stale:
+                continue
+            if stale and not exited:
+                # Wedged, not dead: a drain would hang on the stuck
+                # loop — replace it the hard way.
+                try:
+                    handle.proc.kill()
+                except OSError:
+                    pass
+                handle.proc.wait()
+            reclaimed = self.segment.reap(index)
+            self.respawns += 1
+            if self.logger is not None:
+                self.logger.warn(
+                    "cluster worker died; respawning",
+                    "worker", index, "generation", handle.generation,
+                    "cause", "stale_heartbeat" if stale else "exited",
+                    "exit_code", handle.proc.returncode,
+                    "reclaimed_in_flight",
+                    sum(v for k, v in reclaimed.items() if k.startswith("in_flight")))
+            self._spawn(index, restarts=handle.restarts + 1)
+            respawned.append(index)
+        return respawned
+
+    async def run(self) -> None:
+        """Monitor until ``stop()``: SIGCHLD wakes the pass early,
+        ``check_interval`` bounds detection latency either way."""
+        while not self._stopping:
+            self._wake.clear()
+            try:
+                await self.clock.wait_for(self._wake.wait(), self.check_interval)
+            except asyncio.TimeoutError:
+                pass
+            self.check_once()
+
+    # -- orchestrated restarts -------------------------------------------
+    async def _wait_exited(self, handle: WorkerHandle, timeout: float) -> bool:
+        deadline = self.clock.now() + timeout
+        while handle.proc.poll() is None:
+            if self.clock.now() >= deadline:
+                return False
+            await self.clock.sleep(0.05)
+        return True
+
+    async def _wait_live(self, index: int, timeout: float = 10.0) -> bool:
+        """A replacement counts live once its heartbeat moves past the
+        spawn stamp (the worker's own loop is beating)."""
+        handle = self.workers[index]
+        deadline = self.clock.now() + timeout
+        while self.clock.now() < deadline:
+            if self.segment.heartbeat(index) > handle.started:
+                return True
+            if handle.proc.poll() is not None:
+                return False
+            await self.clock.sleep(0.05)
+        return False
+
+    async def rolling_restart(self) -> None:
+        """Zero-downtime restart: one worker at a time — SIGTERM (the
+        worker drains through its own begin_drain/wait_idle path), reap
+        its generation, respawn, and only move on once the replacement
+        is beating. N-1 listeners keep accepting throughout."""
+        for index in sorted(self.workers):
+            handle = self.workers[index]
+            handle.proc.terminate()
+            if not await self._wait_exited(handle, self.term_grace):
+                handle.proc.kill()
+                handle.proc.wait()
+            self.segment.reap(index)
+            self._spawn(index, restarts=handle.restarts + 1)
+            await self._wait_live(index)
+            if self.logger is not None:
+                self.logger.info("cluster worker restarted", "worker", index)
+
+    async def stop(self) -> None:
+        """SIGTERM the fleet and wait out each worker's drain."""
+        self._stopping = True
+        if self._sigchld_installed:
+            try:
+                asyncio.get_running_loop().remove_signal_handler(signal.SIGCHLD)
+            except (ValueError, NotImplementedError, RuntimeError):
+                pass
+        for handle in self.workers.values():
+            if handle.proc.poll() is None:
+                handle.proc.terminate()
+        for handle in self.workers.values():
+            if not await self._wait_exited(handle, self.term_grace):
+                handle.proc.kill()
+                handle.proc.wait()
+            self.segment.reap(handle.index)
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        now = self.clock.now()
+        return {
+            "respawns": self.respawns,
+            "workers": [
+                {
+                    "worker": h.index,
+                    "generation": h.generation,
+                    "pid": h.proc.pid,
+                    "alive": h.proc.poll() is None,
+                    "restarts": h.restarts,
+                    "heartbeat_age_s": round(
+                        max(0.0, now - self.segment.heartbeat(h.index)), 3),
+                }
+                for h in self.workers.values()
+            ],
+        }
+
+
+def gateway_spawn(segment_name: str, workers: int,
+                  extra_env: dict[str, str] | None = None,
+                  quiet: bool = False) -> SpawnFn:
+    """The production spawn function: fork a full gateway process that
+    attaches the segment and binds its listeners with SO_REUSEPORT.
+    Workers inherit the supervisor's environment, so every configured
+    knob applies identically to each worker. ``quiet`` discards worker
+    stdout/stderr (benchmarks, whose contract is one machine-readable
+    line on stdout)."""
+
+    def spawn(index: int, generation: int) -> "subprocess.Popen[bytes]":
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env.update({
+            "CLUSTER_SEGMENT_NAME": segment_name,
+            "CLUSTER_WORKER_INDEX": str(index),
+            "CLUSTER_GENERATION": str(generation),
+            "CLUSTER_WORKERS": str(workers),
+        })
+        sink = subprocess.DEVNULL if quiet else None
+        return subprocess.Popen([sys.executable, "-m", "inference_gateway_tpu.main"],
+                                env=env, stdout=sink, stderr=sink)
+
+    return spawn
+
+
+async def run_supervisor(cfg: Any, logger: Any = None) -> None:
+    """``CLUSTER_WORKERS > 1`` entry point: create the segment, fork the
+    fleet, supervise until SIGINT/SIGTERM (graceful fleet drain), with
+    SIGHUP wired to a rolling restart."""
+    name = f"ig-cluster-{os.getpid()}"
+    segment = ClusterSegment.create(
+        name, workers=int(cfg.cluster.workers),
+        tenant_slots=int(cfg.cluster.tenant_slots))
+    sup = Supervisor(
+        segment, gateway_spawn(name, int(cfg.cluster.workers)),
+        heartbeat_timeout=cfg.cluster.heartbeat_timeout,
+        check_interval=cfg.cluster.check_interval,
+        term_grace=cfg.overload.drain_deadline + 5.0,
+        logger=logger)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    rolling: list["asyncio.Task[None]"] = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    loop.add_signal_handler(
+        signal.SIGHUP,
+        lambda: rolling.append(loop.create_task(sup.rolling_restart())))
+    sup.start()
+    if logger is not None:
+        logger.info("cluster supervisor running", "workers", segment.workers,
+                    "segment", name)
+    monitor = loop.create_task(sup.run())
+    try:
+        await stop.wait()
+    finally:
+        for task in rolling:
+            task.cancel()
+        await sup.stop()
+        monitor.cancel()
+        try:
+            await monitor
+        except asyncio.CancelledError:
+            pass
+        segment.close(unlink=True)

@@ -1,0 +1,116 @@
+"""Per-tenant isolation (ISSUE 16 tentpole).
+
+Tenant identity is derived at the admission edge — BEFORE auth runs, so
+a request that will be shed for fairness costs no OIDC round trip:
+
+1. an API key (``X-API-Key`` or a non-JWT ``Authorization: Bearer``)
+   hashes to a stable opaque id (``key:<sha256-prefix>`` — raw keys
+   must never become metric labels or log fields);
+2. a JWT bearer falls back to its **unverified** ``sub`` claim
+   (``sub:<subject>``). Unverified is safe here: the auth middleware
+   still rejects invalid tokens downstream, and a forged ``sub`` only
+   picks which fairness bucket the request is counted against — exactly
+   what choosing an API key does;
+3. everything else lands in the configurable anonymous tenant.
+
+``TenantPolicy`` carries the weight table (``TENANT_WEIGHTS`` →
+``tenant:weight`` pairs) and quota tiers (``TENANT_QUOTA_BASE`` × weight
+= the tenant's cluster-wide in-flight cap). The fairness math itself
+lives in the OverloadController, which owns the ledger it protects.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import re
+from typing import Any
+
+_LABEL_SAFE = re.compile(r"[^A-Za-z0-9_.:@-]+")
+_MAX_TENANT_LEN = 64
+
+
+def _sanitize(raw: str) -> str:
+    """Collapse a tenant id to a metric-label-safe token."""
+    out = _LABEL_SAFE.sub("_", raw.strip())[:_MAX_TENANT_LEN]
+    return out or "invalid"
+
+
+def _jwt_subject(token: str) -> str | None:
+    """The ``sub`` claim of a JWT, decoded without verification (see
+    module docstring for why that is sufficient here)."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        return None
+    payload = parts[1]
+    try:
+        decoded = base64.urlsafe_b64decode(payload + "=" * (-len(payload) % 4))
+        claims = json.loads(decoded)
+    except (ValueError, json.JSONDecodeError):
+        return None
+    sub = claims.get("sub") if isinstance(claims, dict) else None
+    return str(sub) if sub else None
+
+
+def _key_id(key: str) -> str:
+    return "key:" + hashlib.sha256(key.encode("utf-8", "replace")).hexdigest()[:10]
+
+
+def derive_tenant(headers: Any, policy: "TenantPolicy") -> str:
+    """Tenant id for one request: API key → OIDC subject → anonymous."""
+    api_key = headers.get("x-api-key")
+    if api_key:
+        return _key_id(api_key)
+    auth = headers.get("authorization") or ""
+    if auth.lower().startswith("bearer "):
+        token = auth[7:].strip()
+        if token:
+            sub = _jwt_subject(token)
+            if sub is not None:
+                return _sanitize("sub:" + sub)
+            return _key_id(token)
+    return policy.anonymous
+
+
+class TenantPolicy:
+    """The weight/quota table behind fairness-weighted shedding."""
+
+    def __init__(self, cfg: Any = None) -> None:
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self.anonymous = _sanitize(getattr(cfg, "anonymous", "anonymous") or "anonymous")
+        self.default_weight = max(0.001, float(getattr(cfg, "default_weight", 1.0)))
+        self.quota_base = max(0, int(getattr(cfg, "quota_base", 0)))
+        self.weights: dict[str, float] = {}
+        raw = getattr(cfg, "weights", "") or ""
+        for pair in raw.split(","):
+            pair = pair.strip()
+            if not pair or ":" not in pair:
+                continue
+            tenant, _, weight = pair.rpartition(":")
+            try:
+                parsed = float(weight)
+            except ValueError:
+                continue
+            if parsed > 0:
+                self.weights[_sanitize(tenant)] = parsed
+
+    def weight(self, tenant: str) -> float:
+        return self.weights.get(tenant, self.default_weight)
+
+    def quota(self, tenant: str) -> int:
+        """Cluster-wide in-flight cap for this tenant's tier, or 0 when
+        quotas are off. Tiers ride the weight table: a 10×-weight tenant
+        bought 10× the base quota."""
+        if self.quota_base <= 0:
+            return 0
+        return max(1, int(self.quota_base * self.weight(tenant)))
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "anonymous": self.anonymous,
+            "default_weight": self.default_weight,
+            "quota_base": self.quota_base,
+            "weights": dict(self.weights),
+        }

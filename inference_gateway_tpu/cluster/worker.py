@@ -1,0 +1,148 @@
+"""Worker-side cluster runtime (ISSUE 16 tentpole).
+
+Each gateway worker owns exactly one slab in the shared segment. The
+``WorkerRuntime`` is the background task that keeps that slab honest:
+
+- **heartbeat** — stamps CLOCK_MONOTONIC into the slab head every
+  interval; the supervisor reads staleness from the same system-wide
+  timebase, so a wedged event loop (alive process, dead loop) is
+  detected without any RPC;
+- **verdict publishing** — serializes the local prober/breaker verdicts
+  into the slab's seqlock blob, so peers can read-merge replica health
+  (``ClusterSegment.peer_ejected``) without a consensus protocol.
+
+The counter mirroring itself does NOT live here — the
+OverloadController mirrors its ledger into the slab synchronously at
+each admit/release (see ``resilience/overload.py``), because phantom
+load must be visible to peers the instant it exists, not an interval
+later.
+
+The module is also the subprocess entry the supervisor tests drive:
+``python -m inference_gateway_tpu.cluster.worker --idle ...`` boots a
+minimal worker that only attaches + beats, with scripted death/wedge
+switches for crash-supervision tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any
+
+from inference_gateway_tpu.cluster.shm import ClusterSegment, WorkerSlab
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock, VirtualClock
+
+
+class WorkerRuntime:
+    """Heartbeat + verdict-publisher loop for one worker's slab."""
+
+    def __init__(self, slab: WorkerSlab, *, prober: Any = None,
+                 breakers: Any = None, interval: float = 1.0,
+                 clock: Clock | None = None, logger: Any = None) -> None:
+        self.slab = slab
+        self.prober = prober
+        self.breakers = breakers
+        self.interval = interval
+        self.clock = clock or MonotonicClock()
+        self.logger = logger
+        self._task: "asyncio.Task[None] | None" = None
+
+    def publish_once(self) -> None:
+        """One beat: stamp the heartbeat, then publish verdicts. Order
+        matters — the heartbeat proves this loop alive; the blob is only
+        meaningful when its writer is."""
+        self.slab.beat(self.clock.now())
+        payload: dict[str, Any] = {"pid": os.getpid()}
+        if self.prober is not None:
+            payload["probes"] = self.prober.verdicts()
+        if self.breakers is not None:
+            payload["breakers"] = {
+                f"{p}/{m}": state
+                for (p, m), state in self.breakers.snapshot().items()}
+        self.slab.publish(payload)
+
+    def start(self) -> None:
+        self.publish_once()  # first beat before any interval elapses
+        if isinstance(self.clock, VirtualClock):
+            # Zero-sleep tests call publish_once() directly; a virtual
+            # sleep loop would spin the event loop (same auto-disable
+            # contract as HealthProber / EngineWatchdog).
+            return
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await self.clock.sleep(self.interval)
+            try:
+                self.publish_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # a beat must never kill the loop
+                if self.logger is not None:
+                    self.logger.warn("cluster heartbeat failed", "error", repr(e))
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+
+def _idle_main(argv: list[str]) -> int:
+    """Scripted minimal worker for supervisor tests: attach the segment,
+    beat until told otherwise.
+
+    ``python -m inference_gateway_tpu.cluster.worker --idle <name>
+    <workers> <index> [--interval S] [--exit-after N] [--wedge-after N]``
+
+    ``--exit-after N`` dies (exit 3) after N beats — exercises SIGCHLD /
+    poll detection; ``--wedge-after N`` keeps the process alive but
+    stops beating — exercises heartbeat-staleness detection.
+    """
+    name, workers, index = argv[0], int(argv[1]), int(argv[2])
+    interval = 0.05
+    exit_after = wedge_after = -1
+    rest = argv[3:]
+    while rest:
+        flag = rest.pop(0)
+        if flag == "--interval":
+            interval = float(rest.pop(0))
+        elif flag == "--exit-after":
+            exit_after = int(rest.pop(0))
+        elif flag == "--wedge-after":
+            wedge_after = int(rest.pop(0))
+        else:
+            raise SystemExit(f"unknown idle-worker flag {flag!r}")
+
+    async def run() -> int:
+        seg = ClusterSegment.attach(name, workers=workers)
+        clock = MonotonicClock()
+        slab = seg.slab(index)
+        beats = 0
+        try:
+            while True:
+                if exit_after >= 0 and beats >= exit_after:
+                    return 3
+                if wedge_after < 0 or beats < wedge_after:
+                    slab.beat(clock.now())
+                    slab.publish({"pid": os.getpid(), "beats": beats})
+                beats += 1
+                await clock.sleep(interval)
+        finally:
+            seg.close()
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    import sys
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "--idle":
+        raise SystemExit(_idle_main(sys.argv[2:]))
+    raise SystemExit("usage: python -m inference_gateway_tpu.cluster.worker "
+                     "--idle <name> <workers> <index> [--interval S] "
+                     "[--exit-after N] [--wedge-after N]")

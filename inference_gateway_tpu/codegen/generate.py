@@ -580,6 +580,19 @@ def check_config_defaults(spec: dict) -> list[str]:
         "OVERLOAD_ENGINE_DEPTH_HIGH_WATER": cfg.overload.engine_depth_high_water,
         "DRAIN_DEADLINE": cfg.overload.drain_deadline,
         "DRAIN_RETRY_AFTER": cfg.overload.drain_retry_after,
+        "CLUSTER_WORKERS": cfg.cluster.workers,
+        "CLUSTER_HEARTBEAT_INTERVAL": cfg.cluster.heartbeat_interval,
+        "CLUSTER_HEARTBEAT_TIMEOUT": cfg.cluster.heartbeat_timeout,
+        "CLUSTER_CHECK_INTERVAL": cfg.cluster.check_interval,
+        "CLUSTER_TENANT_SLOTS": cfg.cluster.tenant_slots,
+        "CLUSTER_SEGMENT_NAME": cfg.cluster.segment_name,
+        "CLUSTER_WORKER_INDEX": cfg.cluster.worker_index,
+        "CLUSTER_GENERATION": cfg.cluster.generation,
+        "TENANT_ENABLED": cfg.tenant.enabled,
+        "TENANT_ANONYMOUS": cfg.tenant.anonymous,
+        "TENANT_DEFAULT_WEIGHT": cfg.tenant.default_weight,
+        "TENANT_WEIGHTS": cfg.tenant.weights,
+        "TENANT_QUOTA_BASE": cfg.tenant.quota_base,
     }
     problems = []
     seen = set()

@@ -486,6 +486,66 @@ class RoutingConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """CLUSTER_* — multi-worker scale-out (ISSUE 16). ``workers`` is the
+    fleet size: 1 (the default) is today's single-process mode,
+    byte-identical — no supervisor, no shared segment, no extra
+    syscalls; > 1 forks that many gateway workers onto SO_REUSEPORT
+    listeners under a crash supervisor. ``segment_name`` /
+    ``worker_index`` / ``generation`` are the supervisor→worker
+    handshake (set in each worker's environment at spawn, never by
+    operators)."""
+
+    workers: int = 1
+    heartbeat_interval: float = 1.0
+    heartbeat_timeout: float = 5.0
+    check_interval: float = 0.5
+    tenant_slots: int = 64
+    segment_name: str = ""
+    worker_index: int = -1
+    generation: int = 0
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "CLUSTER_") -> "ClusterConfig":
+        return cls(
+            workers=_get_int(env, prefix + "WORKERS", 1),
+            heartbeat_interval=_get_duration(env, prefix + "HEARTBEAT_INTERVAL", "1s"),
+            heartbeat_timeout=_get_duration(env, prefix + "HEARTBEAT_TIMEOUT", "5s"),
+            check_interval=_get_duration(env, prefix + "CHECK_INTERVAL", "500ms"),
+            tenant_slots=_get_int(env, prefix + "TENANT_SLOTS", 64),
+            segment_name=_get_str(env, prefix + "SEGMENT_NAME"),
+            worker_index=_get_int(env, prefix + "WORKER_INDEX", -1),
+            generation=_get_int(env, prefix + "GENERATION", 0),
+        )
+
+
+@dataclass
+class TenantConfig:
+    """TENANT_* — per-tenant isolation at the admission edge (ISSUE 16):
+    API-key/OIDC-derived tenant ids, weight-tiered quotas
+    (``quota_base`` × weight = the tenant's cluster-wide in-flight cap;
+    0 disables quotas), and fairness-weighted shedding under overload.
+    ``weights`` is a ``tenant:weight`` comma list; unlisted tenants get
+    ``default_weight``."""
+
+    enabled: bool = False
+    anonymous: str = "anonymous"
+    default_weight: float = 1.0
+    weights: str = ""
+    quota_base: int = 0
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "TENANT_") -> "TenantConfig":
+        return cls(
+            enabled=_get_bool(env, prefix + "ENABLED", False),
+            anonymous=_get_str(env, prefix + "ANONYMOUS", "anonymous"),
+            default_weight=_get_float(env, prefix + "DEFAULT_WEIGHT", 1.0),
+            weights=_get_str(env, prefix + "WEIGHTS"),
+            quota_base=_get_int(env, prefix + "QUOTA_BASE", 0),
+        )
+
+
+@dataclass
 class Config:
     """Top-level gateway configuration (config.go:20-43)."""
 
@@ -505,6 +565,8 @@ class Config:
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     structured: StructuredConfig = field(default_factory=StructuredConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    tenant: TenantConfig = field(default_factory=TenantConfig)
     providers: dict[str, ProviderConfig] = field(default_factory=dict)
 
     @classmethod
@@ -530,6 +592,8 @@ class Config:
             overload=OverloadConfig.load(env),
             serving=ServingConfig.load(env),
             structured=StructuredConfig.load(env),
+            cluster=ClusterConfig.load(env),
+            tenant=TenantConfig.load(env),
         )
         if not env.get("RESILIENCE_REQUEST_BUDGET"):
             # Follow the operator's upstream timeout unless the budget is

@@ -21,6 +21,9 @@ from inference_gateway_tpu.api.middlewares.auth import OIDCAuthenticator, oidc_a
 from inference_gateway_tpu.api.middlewares.logger import logger_middleware
 from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware, tracing_middleware
 from inference_gateway_tpu.api.routes import RouterImpl, Response
+from inference_gateway_tpu.cluster.shm import ClusterSegment, WorkerSlab
+from inference_gateway_tpu.cluster.tenancy import TenantPolicy
+from inference_gateway_tpu.cluster.worker import WorkerRuntime
 from inference_gateway_tpu.config import Config
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio.client import ClientConfig, HTTPClient
@@ -59,6 +62,9 @@ class Gateway:
     profiler: SamplingProfiler | None = None
     watchdog: EventLoopWatchdog | None = None
     slow_log: SlowRequestLog | None = None
+    cluster_segment: ClusterSegment | None = None
+    cluster_slab: WorkerSlab | None = None
+    cluster_runtime: WorkerRuntime | None = None
     port: int = 0
     metrics_port: int = 0
     _tasks: list[asyncio.Task] = field(default_factory=list)
@@ -67,17 +73,26 @@ class Gateway:
     async def start(self, host: str | None = None, port: int | None = None) -> int:
         host = host or self.cfg.server.host
         port = int(port if port is not None else self.cfg.server.port)
+        # Cluster workers share both listener ports via SO_REUSEPORT (the
+        # kernel balances accepts; a respawn rebinds while siblings keep
+        # the port open). Single-process mode binds exactly as before.
+        reuse_port = self.cluster_slab is not None
         if self.metrics_server is not None:
             self.metrics_port = await self.metrics_server.start(
-                host, int(self.cfg.telemetry.metrics_port)
+                host, int(self.cfg.telemetry.metrics_port), reuse_port=reuse_port
             )
             self.logger.info("metrics server listening", "port", self.metrics_port)
         if self.mcp_client is not None:
             await self.mcp_client.initialize_all()
             self.mcp_client.start_status_polling()
         self.port = await self.api_server.start(
-            host, port, self.cfg.server.tls_cert_path, self.cfg.server.tls_key_path
+            host, port, self.cfg.server.tls_cert_path, self.cfg.server.tls_key_path,
+            reuse_port=reuse_port,
         )
+        if self.cluster_runtime is not None:
+            # First heartbeat the moment the listener is up: the
+            # supervisor's staleness clock starts at spawn.
+            self.cluster_runtime.start()
         # Performance introspection (ISSUE 4): the continuous sampler is
         # a daemon thread, the watchdog heartbeat a loop task — both
         # started here (the loop exists now) and torn down in shutdown().
@@ -137,6 +152,12 @@ class Gateway:
             await self.metrics_server.shutdown()
         if self.profiler is not None:
             self.profiler.stop()
+        if self.cluster_runtime is not None:
+            await self.cluster_runtime.stop()
+        if self.cluster_segment is not None:
+            # Detach only: the supervisor owns the segment's lifetime
+            # and reaps this worker's slab once the process exits.
+            self.cluster_segment.close()
         self.logger.info("gateway stopped")
 
 
@@ -145,6 +166,26 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     if cfg is None:
         cfg = Config.load(env, logger=logger)
     logger = logger or new_logger(cfg.environment)
+
+    # Cluster worker mode (ISSUE 16): the supervisor spawned this process
+    # with a segment handshake in the environment — attach the shared
+    # segment and claim our slab. Absent the handshake (the default),
+    # nothing below changes: no segment, no mirror writes, no REUSEPORT.
+    cluster_segment = None
+    cluster_slab = None
+    if cfg.cluster.segment_name and cfg.cluster.worker_index >= 0:
+        cluster_segment = ClusterSegment.attach(
+            cfg.cluster.segment_name, workers=max(1, cfg.cluster.workers),
+            tenant_slots=cfg.cluster.tenant_slots)
+        cluster_slab = cluster_segment.slab(cfg.cluster.worker_index)
+        logger.info("cluster worker attached", "segment", cfg.cluster.segment_name,
+                    "worker", cfg.cluster.worker_index,
+                    "generation", cluster_slab.generation)
+
+    # Per-tenant isolation policy (ISSUE 16): built unconditionally (the
+    # admission edge and ledger consult .enabled), weights/quotas from
+    # TENANT_*.
+    tenancy = TenantPolicy(cfg.tenant)
 
     otel = None
     metrics_server = None
@@ -161,7 +202,14 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         )
 
         async def prometheus_handler(req: Request) -> Response:
-            return Response.text(otel.expose_prometheus(), content_type="text/plain; version=0.0.4")
+            body = otel.expose_prometheus()
+            if cluster_segment is not None:
+                # Per-worker metric merge (ISSUE 16): whichever worker
+                # the scrape lands on, the cluster_* series (live
+                # workers, heartbeat ages, summed admission ledger) are
+                # identical — read straight from the shared segment.
+                body += cluster_segment.render_prometheus(resilience.clock.now())
+            return Response.text(body, content_type="text/plain; version=0.0.4")
 
         metrics_router = Router()
         metrics_router.get("/metrics", prometheus_handler)
@@ -209,8 +257,12 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
 
     # Overload protection (ISSUE 2): one admission ledger per gateway —
     # the admission middleware, the health handler (readiness), and
-    # shutdown (graceful drain) all coordinate through it.
-    overload = OverloadController(cfg.overload, otel=otel, logger=logger)
+    # shutdown (graceful drain) all coordinate through it. Clustered
+    # (ISSUE 16), every ledger mutation is mirrored into this worker's
+    # shared slab and tenant quota/fairness policy rides the same admit
+    # path.
+    overload = OverloadController(cfg.overload, otel=otel, logger=logger,
+                                  tenancy=tenancy, shared=cluster_slab)
 
     selector = None
     prober = None
@@ -279,8 +331,18 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             otel=otel, logger=logger, clock=resilience.clock)
         resilience.migrator = migrator
 
-        def fleet_health(d, _h=health, _m=migrator):
-            return _h(d) and not _m.draining(d.provider, d.model)
+        def fleet_health(d, _h=health, _m=migrator, _seg=cluster_segment,
+                         _idx=cfg.cluster.worker_index):
+            if not _h(d) or _m.draining(d.provider, d.model):
+                return False
+            # Cross-worker health merge (ISSUE 16): peers' published
+            # probe verdicts can only REMOVE a candidate — one confused
+            # worker can never readmit a replica the rest of the cluster
+            # has condemned, and a worker with no local evidence still
+            # avoids a replica its peers know is dead.
+            if _seg is not None and _seg.peer_ejected(_idx, d.provider, d.model):
+                return False
+            return True
 
         # Fleet router (ISSUE 11 tentpole a): prefix-affinity consistent-
         # hash ordering with bounded-load spill; keyless requests (and
@@ -335,7 +397,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     if watchdog is not None:
         # Stall wide events ride the access-log sink when it exists.
         watchdog.access_log = access_log
-    middlewares.append(admission_middleware(overload, logger))
+    middlewares.append(admission_middleware(overload, logger, tenancy=tenancy))
     if otel is not None and cfg.telemetry.tracing_enable:
         middlewares.append(tracing_middleware(otel.tracer))
     middlewares.append(logger_middleware(logger))
@@ -377,12 +439,24 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         if metrics_server is not None:
             watchdog.add_context("metrics_connections", metrics_server.connection_count)
 
+    cluster_runtime = None
+    if cluster_slab is not None:
+        # Heartbeat + verdict publisher: beats the slab on the interval
+        # the supervisor's staleness check expects, and publishes local
+        # prober/breaker verdicts for peers to read-merge.
+        cluster_runtime = WorkerRuntime(
+            cluster_slab, prober=prober, breakers=resilience.breakers,
+            interval=cfg.cluster.heartbeat_interval, clock=resilience.clock,
+            logger=logger)
+
     gw = Gateway(
         cfg=cfg, logger=logger, otel=otel, registry=registry, client=client,
         router_impl=router_impl, api_server=api_server, metrics_server=metrics_server,
         mcp_client=mcp_client, overload=overload, resilience=resilience,
         prober=prober, migrator=migrator, access_log=access_log,
         profiler=profiler, watchdog=watchdog, slow_log=slow_log,
+        cluster_segment=cluster_segment, cluster_slab=cluster_slab,
+        cluster_runtime=cluster_runtime,
     )
     # Uptime reads through the resilience clock (graftlint
     # clock-discipline): stamp the start on the same timebase.
@@ -424,6 +498,12 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
                 status["profiling"] = profiler.stats()
             if watchdog is not None:
                 status["eventloop"] = watchdog.stats()
+            if cluster_segment is not None:
+                # Cluster view (ISSUE 16): live workers, heartbeat ages,
+                # per-worker admission cells, cluster-wide sums —
+                # identical from whichever worker answered the scrape.
+                status["cluster"] = cluster_segment.status(resilience.clock.now())
+                status["cluster"]["self_worker"] = cfg.cluster.worker_index
             return Response.json(status)
 
         metrics_router.get("/debug/status", debug_status_handler)
@@ -471,8 +551,19 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
 
 
 async def run() -> None:
-    """Run until SIGINT/SIGTERM (main.go:326-343)."""
-    gw = build_gateway()
+    """Run until SIGINT/SIGTERM (main.go:326-343).
+
+    CLUSTER_WORKERS > 1 turns this process into the supervisor: it
+    creates the shared segment and forks that many gateway workers
+    (each re-entering here WITH the segment handshake set, so they take
+    the normal serving path below on SO_REUSEPORT listeners)."""
+    cfg = Config.load()
+    if cfg.cluster.workers > 1 and not cfg.cluster.segment_name:
+        from inference_gateway_tpu.cluster.supervisor import run_supervisor
+
+        await run_supervisor(cfg, new_logger(cfg.environment))
+        return
+    gw = build_gateway(cfg)
     await gw.start()
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

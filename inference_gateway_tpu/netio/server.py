@@ -241,7 +241,8 @@ class HTTPServer:
         return len(self._conns)
 
     # -- lifecycle -----------------------------------------------------
-    async def start(self, host: str, port: int, tls_cert: str = "", tls_key: str = "") -> int:
+    async def start(self, host: str, port: int, tls_cert: str = "", tls_key: str = "",
+                    reuse_port: bool = False) -> int:
         ssl_ctx = None
         if tls_cert and tls_key:
             ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -250,8 +251,16 @@ class HTTPServer:
         # connect burst (the BASELINE north-star concurrency); the
         # retransmit costs each straggler ~1 s of TTFB (measured p95
         # 1.08 s at 128 streams, round 3).
+        #
+        # reuse_port: cluster workers (CLUSTER_WORKERS > 1) bind the SAME
+        # port with SO_REUSEPORT — the kernel load-balances accepts
+        # across workers, and a respawning worker rebinds while its
+        # siblings' listeners keep the port open (zero-downtime respawn).
+        # Single-process mode never sets it, so the default path is
+        # byte-identical to before.
         self._server = await asyncio.start_server(self._handle_conn, host, port,
-                                                  ssl=ssl_ctx, backlog=1024)
+                                                  ssl=ssl_ctx, backlog=1024,
+                                                  reuse_port=reuse_port or None)
         return self._server.sockets[0].getsockname()[1]
 
     async def shutdown(self, drain: float = 0.0, ledger=None) -> None:

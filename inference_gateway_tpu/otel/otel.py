@@ -150,6 +150,25 @@ class OpenTelemetry:
             "Graceful-drain lifecycle events (begun/completed/timed_out)",
             ("phase",), unit="{event}",
         )
+        # Per-tenant isolation instruments (ISSUE 16): tenant-labelled
+        # edge series. NEW instruments rather than a new label on the
+        # overload series — adding a label to an existing exposition
+        # breaks every pinned dashboard query against it.
+        self.tenant_request_counter = r.counter(
+            "inference_gateway.tenant.requests",
+            "Admitted requests per tenant at the admission edge",
+            ("tenant",), unit="{request}",
+        )
+        self.tenant_shed_counter = r.counter(
+            "inference_gateway.tenant.shed",
+            "Requests rejected by per-tenant quota or fairness shedding",
+            ("tenant", "reason"), unit="{request}",
+        )
+        self.tenant_in_flight_gauge = r.gauge(
+            "inference_gateway.tenant.in_flight",
+            "In-flight requests per tenant on this worker",
+            ("tenant",),
+        )
         # Token-level streaming instruments (ISSUE 3): the per-token
         # latency visibility the ROADMAP north star is judged against —
         # TPOT from the SSE relay and the scheduler emit path, queue wait
@@ -445,6 +464,22 @@ class OpenTelemetry:
 
     def record_drain_event(self, phase: str) -> None:
         self.drain_counter.add(1, {"phase": phase})
+
+    # -- per-tenant isolation (ISSUE 16) ---------------------------------
+    def record_tenant_request(self, tenant: str) -> None:
+        self.tenant_request_counter.add(1, {"tenant": tenant})
+
+    def record_tenant_shed(self, tenant: str, reason: str) -> None:
+        self.tenant_shed_counter.add(1, {"tenant": tenant, "reason": reason})
+
+    def set_tenant_in_flight(self, tenant: str, value: int) -> None:
+        self.tenant_in_flight_gauge.set(value, {"tenant": tenant})
+
+    def remove_tenant_gauge(self, tenant: str) -> None:
+        """A tenant back at zero in-flight leaves the exposition: tenant
+        ids are unbounded (hashed API keys), so idle series must be
+        dropped or the gauge cardinality only ever grows."""
+        self.tenant_in_flight_gauge.remove({"tenant": tenant})
 
     # -- token-level streaming metrics (ISSUE 3) -------------------------
     def record_time_to_first_chunk(self, source: str, team: str, provider: str,
@@ -813,6 +848,18 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_drain_event(self, *a, **k) -> None:
+        pass
+
+    def record_tenant_request(self, *a, **k) -> None:
+        pass
+
+    def record_tenant_shed(self, *a, **k) -> None:
+        pass
+
+    def set_tenant_in_flight(self, *a, **k) -> None:
+        pass
+
+    def remove_tenant_gauge(self, *a, **k) -> None:
         pass
 
     def record_time_to_first_chunk(self, *a, **k) -> None:

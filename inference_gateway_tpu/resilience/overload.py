@@ -23,6 +23,20 @@ package so tests run on a virtual clock with zero real sleeps:
   requests (including SSE streams, whose admission ticket is released
   only when the stream finishes) complete within the drain deadline
   before the listener closes.
+
+ISSUE 16 adds two multi-worker dimensions on the same ledger:
+
+- **Cluster mirroring** — when the gateway runs as a cluster worker,
+  every ledger mutation is mirrored synchronously into this worker's
+  shared-memory slab (``_mirror``), so peers and /metrics see
+  cluster-wide admission state and the supervisor can *reap* a dead
+  worker's in-flight tickets instead of leaking them as phantom load.
+- **Per-tenant isolation** — tenant quota tiers (cluster-wide in-flight
+  caps read from the shared tenant cells) and fairness-weighted
+  shedding: once an endpoint class saturates, a tenant holding at least
+  its weighted share of the cap is rejected (429 ``tenant_fair_share``)
+  instead of queueing, so a noisy tenant saturates only itself and can
+  never starve another tenant's admission.
 """
 
 from __future__ import annotations
@@ -32,6 +46,8 @@ import math
 from collections import deque
 from typing import Any, Callable
 
+from inference_gateway_tpu.cluster.shm import WorkerSlab, tenant_slot
+from inference_gateway_tpu.cluster.tenancy import TenantPolicy, derive_tenant
 from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
 
 # Shed order: higher value is shed first. Critical is never shed — a
@@ -140,13 +156,14 @@ class Ticket:
     """An admission: holds one in-flight slot until released. Release is
     idempotent — middleware finallys and error paths may both fire."""
 
-    __slots__ = ("_controller", "_state", "_t0", "_released")
+    __slots__ = ("_controller", "_state", "_t0", "_tenant", "_released")
 
     def __init__(self, controller: "OverloadController", state: _ClassState | None,
-                 t0: float) -> None:
+                 t0: float, tenant: str | None = None) -> None:
         self._controller = controller
         self._state = state
         self._t0 = t0
+        self._tenant = tenant
         self._released = False
 
     def release(self) -> None:
@@ -159,6 +176,8 @@ class Ticket:
         st = self._state
         # Observed service time feeds the Retry-After hint.
         st.service.observe(ctrl.clock.now() - self._t0)
+        if self._tenant is not None:
+            ctrl._tenant_add(self._tenant, -1)
         ctrl._release_slot(st)
 
 
@@ -168,7 +187,8 @@ class OverloadController:
     no locks, every mutation happens on the serving loop."""
 
     def __init__(self, cfg: Any = None, otel: Any = None, logger: Any = None,
-                 clock: Clock | None = None) -> None:
+                 clock: Clock | None = None, tenancy: TenantPolicy | None = None,
+                 shared: WorkerSlab | None = None) -> None:
         self.enabled = getattr(cfg, "enabled", True)
         self.otel = otel
         self.logger = logger
@@ -193,6 +213,72 @@ class OverloadController:
         self._depth_probes: list[Callable[[], int]] = []
         self.draining = False
         self._idle_event = asyncio.Event()
+        # Multi-worker mirror + per-tenant isolation (ISSUE 16): the slab
+        # is this worker's single-writer window into the cluster segment;
+        # None in single-process mode (every _mirror call no-ops).
+        self.tenancy = tenancy
+        self._shared = shared
+        self._tenants: dict[str, int] = {}
+
+    # -- cluster mirroring ----------------------------------------------
+    def _mirror(self, name: str, delta: int) -> None:
+        """Mirror one ledger mutation into this worker's shared slab, so
+        peers, the /metrics merge, and the supervisor's reaper all see
+        cluster-wide admission state the instant it changes."""
+        if self._shared is not None:
+            self._shared.add(name, delta)
+
+    # -- per-tenant isolation -------------------------------------------
+    def _tenant_occupancy(self, tenant: str) -> int:
+        """The tenant's in-flight occupancy for the quota check:
+        cluster-wide (every live worker's tenant cell summed) when
+        clustered, this worker's ledger otherwise. Hash-slotted cells
+        mean colliding tenants share a quota bucket — size
+        CLUSTER_TENANT_SLOTS for the expected active-tenant count."""
+        if self._shared is not None:
+            seg = self._shared.segment
+            return seg.tenant_total(tenant_slot(tenant, seg.tenant_slots))
+        return self._tenants.get(tenant, 0)
+
+    def _tenant_add(self, tenant: str, delta: int) -> None:
+        n = self._tenants.get(tenant, 0) + delta
+        if n > 0:
+            self._tenants[tenant] = n
+        else:
+            self._tenants.pop(tenant, None)
+            n = 0
+        if self._shared is not None:
+            seg = self._shared.segment
+            self._shared.tenant_add(tenant_slot(tenant, seg.tenant_slots), delta)
+        if self.otel is not None:
+            if n > 0:
+                self.otel.set_tenant_in_flight(tenant, n)
+            else:
+                # Tenant ids are unbounded (hashed keys): idle series
+                # leave the exposition or cardinality only ever grows.
+                self.otel.remove_tenant_gauge(tenant)
+
+    def _over_fair_share(self, st: _ClassState, tenant: str) -> bool:
+        """Fairness-weighted shedding, consulted only once the class is
+        saturated: the tenant's local in-flight measured against its
+        weighted share of the cap over currently-active tenants
+        (``cap × w / Σw``). A tenant holding nothing is never
+        fairness-shed and the share floor is one slot — so a noisy
+        tenant is shed against its own weight while a quiet tenant still
+        queues and receives freed slots (``_release_slot`` handover)."""
+        policy = self.tenancy
+        if policy is None:
+            return False
+        mine = self._tenants.get(tenant, 0)
+        if mine <= 0:
+            return False
+        active = set(self._tenants)
+        active.add(tenant)
+        total_w = sum(policy.weight(t) for t in active)
+        if total_w <= 0:
+            return False
+        fair = st.cap * policy.weight(tenant) / total_w
+        return mine >= max(1.0, fair)
 
     # -- observability -------------------------------------------------
     def _set_gauges(self, st: _ClassState) -> None:
@@ -200,14 +286,21 @@ class OverloadController:
             self.otel.set_overload_in_flight(st.name, st.in_flight)
             self.otel.set_overload_queue_depth(st.name, len(st.waiters))
 
-    def _record_shed(self, endpoint_class: str, priority: int, reason: str) -> None:
+    def _record_shed(self, endpoint_class: str, priority: int, reason: str,
+                     tenant: str | None = None) -> None:
+        self._mirror("shed_total", 1)
         if self.logger is not None:
-            self.logger.warn("request shed", "class", endpoint_class,
-                             "priority", PRIORITY_NAMES.get(priority, str(priority)),
-                             "reason", reason)
+            fields: list[Any] = ["class", endpoint_class,
+                                 "priority", PRIORITY_NAMES.get(priority, str(priority)),
+                                 "reason", reason]
+            if tenant is not None:
+                fields += ["tenant", tenant]
+            self.logger.warn("request shed", *fields)
         if self.otel is not None:
             self.otel.record_overload_shed(
                 endpoint_class, PRIORITY_NAMES.get(priority, str(priority)), reason)
+            if tenant is not None:
+                self.otel.record_tenant_shed(tenant, reason)
 
     def _record_drain(self, phase: str) -> None:
         if self.logger is not None:
@@ -242,7 +335,7 @@ class OverloadController:
                 probes.append(int(probe()))
             except Exception:
                 probes.append(None)  # a broken probe is itself a finding
-        return {
+        snap: dict[str, Any] = {
             "enabled": self.enabled,
             "draining": self.draining,
             "classes": {
@@ -257,6 +350,15 @@ class OverloadController:
             },
             "engine_depth_probes": probes,
         }
+        if self.tenancy is not None and self.tenancy.enabled:
+            snap["tenancy"] = self.tenancy.snapshot()
+            snap["tenants_in_flight"] = dict(sorted(self._tenants.items()))
+        if self._shared is not None:
+            # The cluster-wide view of the same ledger (live slabs
+            # summed) — lets /debug/status on any worker show the whole
+            # fleet's admission state.
+            snap["cluster_totals"] = self._shared.segment.totals()
+        return snap
 
     def overloaded(self) -> bool:
         """High-water check driving the shed decision: any admission
@@ -301,16 +403,38 @@ class OverloadController:
             len(st.waiters) + 1 + self._cluster_backlog(), st.cap)
 
     # -- admission -----------------------------------------------------
-    async def admit(self, endpoint_class: str, priority: int) -> Ticket:
+    def _admitted(self, st: _ClassState, tenant: str | None, t0: float,
+                  handover: bool = False) -> Ticket:
+        """Admission bookkeeping for every accepted path. On a slot
+        handover the releaser kept ``in_flight`` counted for us, so only
+        the first-admission paths increment it."""
+        if not handover:
+            st.in_flight += 1
+            self._mirror("in_flight_" + st.name, 1)
+        self._mirror("admitted_total", 1)
+        if tenant is not None:
+            self._tenant_add(tenant, 1)
+            if self.otel is not None:
+                self.otel.record_tenant_request(tenant)
+        self._set_gauges(st)
+        return Ticket(self, st, t0, tenant)
+
+    async def admit(self, endpoint_class: str, priority: int,
+                    tenant: str | None = None) -> Ticket:
         """Admit or reject one request. Returns a Ticket that MUST be
         released when the response (including a streamed body) is done;
-        raises AdmissionRejectedError otherwise."""
+        raises AdmissionRejectedError otherwise. ``tenant`` (derived at
+        the admission edge) selects the quota/fairness bucket; None
+        bypasses tenant policy entirely."""
         if endpoint_class == CLASS_CONTROL or priority <= PRIORITY_CRITICAL:
             # Control-plane traffic is never capped, queued, or counted:
             # health polls during drain must not hold shutdown open.
             return Ticket(self, None, 0.0)
+        policy = self.tenancy
+        if policy is None or not policy.enabled:
+            tenant = None
         if self.draining:
-            self._record_shed(endpoint_class, priority, "draining")
+            self._record_shed(endpoint_class, priority, "draining", tenant)
             raise AdmissionRejectedError(
                 503, "Service is draining for shutdown. Please retry.",
                 self.drain_retry_after, "draining", endpoint_class, priority)
@@ -319,9 +443,17 @@ class OverloadController:
             # Kill switch: no caps/queue/shed, but in-flight accounting
             # stays on — graceful drain is a shutdown correctness
             # property, not an overload policy.
-            st.in_flight += 1
-            self._set_gauges(st)
-            return Ticket(self, st, self.clock.now())
+            return self._admitted(st, tenant, self.clock.now())
+        if tenant is not None and policy is not None and policy.quota_base > 0:
+            quota = policy.quota(tenant)
+            if quota > 0 and self._tenant_occupancy(tenant) >= quota:
+                # Cluster-wide tier cap: the tenant's holds on EVERY
+                # live worker count against it (shared tenant cells).
+                self._record_shed(endpoint_class, priority, "tenant_quota", tenant)
+                raise AdmissionRejectedError(
+                    429, "Tenant concurrency quota exceeded. Please retry later.",
+                    self.estimate_retry_after(endpoint_class), "tenant_quota",
+                    endpoint_class, priority)
         if priority >= PRIORITY_BATCH and self.overloaded():
             self._record_shed(endpoint_class, priority, "shed")
             raise AdmissionRejectedError(
@@ -329,17 +461,26 @@ class OverloadController:
                 self.estimate_retry_after(endpoint_class), "shed",
                 endpoint_class, priority)
         if st.in_flight < st.cap:
-            st.in_flight += 1
-            self._set_gauges(st)
-            return Ticket(self, st, self.clock.now())
+            return self._admitted(st, tenant, self.clock.now())
+        if tenant is not None and self._over_fair_share(st, tenant):
+            # The class is saturated and this tenant already holds its
+            # weighted share of it: shed the tenant against itself
+            # rather than letting it stack the wait queue and starve
+            # everyone else's admission (ISSUE 16 fairness).
+            self._record_shed(endpoint_class, priority, "tenant_fair_share", tenant)
+            raise AdmissionRejectedError(
+                429, "Tenant exceeded its fair share under load. Please retry later.",
+                self.estimate_retry_after(endpoint_class), "tenant_fair_share",
+                endpoint_class, priority)
         if len(st.waiters) >= st.queue_cap:
-            self._record_shed(endpoint_class, priority, "capacity")
+            self._record_shed(endpoint_class, priority, "capacity", tenant)
             raise AdmissionRejectedError(
                 429, "Too many requests. Please retry later.",
                 self.estimate_retry_after(endpoint_class), "capacity",
                 endpoint_class, priority)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         st.waiters.append(fut)
+        self._mirror("queued_" + st.name, 1)
         self._set_gauges(st)
         t_enqueued = self.clock.now()
         try:
@@ -347,12 +488,13 @@ class OverloadController:
         except asyncio.TimeoutError:
             if fut in st.waiters:
                 st.waiters.remove(fut)
+                self._mirror("queued_" + st.name, -1)
             elif fut.done() and not fut.cancelled() and fut.exception() is None:
                 # Race: a releaser handed us the slot in the same tick
                 # the timeout fired — give it back (or it leaks forever).
                 self._release_slot(st)
             self._set_gauges(st)
-            self._record_shed(endpoint_class, priority, "queue_timeout")
+            self._record_shed(endpoint_class, priority, "queue_timeout", tenant)
             raise AdmissionRejectedError(
                 429, "Too many requests. Please retry later.",
                 self.estimate_retry_after(endpoint_class), "queue_timeout",
@@ -360,19 +502,24 @@ class OverloadController:
         # Admitted via slot handover: the releaser kept in_flight counted
         # for us, so the ticket's clock starts at enqueue time (queue wait
         # is part of the service the client observed).
-        self._set_gauges(st)
-        return Ticket(self, st, t_enqueued)
+        return self._admitted(st, tenant, t_enqueued, handover=True)
 
     def _release_slot(self, st: _ClassState) -> None:
         """Return one slot: hand it to the oldest live waiter, else
         decrement in-flight (and wake the drain waiter at zero)."""
         while st.waiters:
             fut = st.waiters.popleft()
+            # Every future leaves the deque exactly once (here, a
+            # timeout removal, or a drain flush) — each exit mirrors one
+            # queued decrement, so the shared cell conserves.
+            self._mirror("queued_" + st.name, -1)
             if not fut.done():
                 fut.set_result(True)
                 self._set_gauges(st)
                 return
-        st.in_flight = max(0, st.in_flight - 1)
+        if st.in_flight > 0:
+            st.in_flight -= 1
+            self._mirror("in_flight_" + st.name, -1)
         self._set_gauges(st)
         # Wake the drain waiter on EVERY decrement (not just at zero):
         # wait_idle re-checks and re-arms, and a deadline overrun is only
@@ -395,6 +542,7 @@ class OverloadController:
         for st in self._classes.values():
             while st.waiters:
                 fut = st.waiters.popleft()
+                self._mirror("queued_" + st.name, -1)
                 if not fut.done():
                     self._record_shed(st.name, PRIORITY_INTERACTIVE, "draining")
                     fut.set_exception(AdmissionRejectedError(
@@ -438,9 +586,12 @@ class OverloadController:
                 self.otel.remove_overload_gauges(st.name)
 
 
-def admission_middleware(overload: OverloadController, logger: Any = None) -> Any:
+def admission_middleware(overload: OverloadController, logger: Any = None,
+                         tenancy: TenantPolicy | None = None) -> Any:
     """Outermost middleware: admission is decided before any other work
     (tracing, logging, auth) is spent on a request that will be shed.
+    The tenant id is derived here too — BEFORE auth — so a request shed
+    for fairness costs no OIDC round trip (ISSUE 16).
 
     In-process self-dispatch (the provider layer's /proxy double hop,
     ``client=("inprocess", 0)``) bypasses admission: the edge request
@@ -452,8 +603,16 @@ def admission_middleware(overload: OverloadController, logger: Any = None) -> An
         if req.client is not None and req.client[0] == "inprocess":
             return await nxt(req)
         endpoint_class, priority = classify_request(req.method, req.path)
+        tenant: str | None = None
+        if tenancy is not None and tenancy.enabled:
+            tenant = derive_tenant(req.headers, tenancy)
+            event = req.ctx.get("wide_event")
+            if event is not None:
+                # The tenant label on the wide-event access log — set
+                # for EVERY edge request, shed or served.
+                event["tenant"] = tenant
         try:
-            ticket = await overload.admit(endpoint_class, priority)
+            ticket = await overload.admit(endpoint_class, priority, tenant)
         except AdmissionRejectedError as e:
             event = req.ctx.get("wide_event")
             if event is not None:

@@ -270,6 +270,15 @@ class HealthProber:
                 pass
             self._task = None
 
+    def verdicts(self) -> dict[str, bool]:
+        """Probe verdicts keyed ``provider/model`` → ejected, the shape
+        the cluster worker publishes into its shared-memory verdict blob
+        (``ClusterSegment.peer_ejected`` read-merges them across
+        workers so the fleet agrees on replica health)."""
+        with self._lock:
+            return {f"{p}/{m}": bool(st["ejected"])
+                    for (p, m), st in self._state.items()}
+
     # -- introspection ---------------------------------------------------
     def snapshot(self) -> dict[str, Any]:
         """The /debug/status view of probe state."""

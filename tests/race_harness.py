@@ -474,3 +474,134 @@ def hammer_prober(prober, flip_threads: int = 4, reader_threads: int = 3,
         if not tgt["ejected"] and not prober.healthy(tgt["provider"], tgt["model"]):
             fail(f"{tgt['provider']}/{tgt['model']}: snapshot/healthy disagree")
     return errors
+
+
+def hammer_shm_ledger(workers: int = 4, iters: int = 2000,
+                      reader_threads: int = 2) -> list[str]:
+    """Multi-PROCESS hammer for the cluster shm segment (ISSUE 16).
+
+    The single-writer-per-slab discipline means no two processes ever
+    write the same cell, so the hammer's job is different from the
+    thread harnesses: prove that (a) concurrent writers on distinct
+    slabs never corrupt each other's counters — exact conservation math
+    holds at quiesce — and (b) readers merging the segment mid-storm
+    (the /metrics scrape, /debug/status, the supervisor's staleness
+    scan) never throw or observe a torn blob, thanks to the seqlock.
+
+    N child processes (``python -m inference_gateway_tpu.cluster.shm
+    --hammer``) each do ``iters`` increments of held/ops/tenant then
+    ``iters - (index+1)`` decrements, leaving exact residues:
+    ``held[i] == i+1``, ``ops[i] == 2*iters - (i+1)``, tenant slot
+    ``i % 8`` accumulating ``i+1`` per mapped worker. Reader threads in
+    the parent hammer totals()/blobs()/render_prometheus() throughout.
+    Finally worker 0 is reaped and the totals must drop by exactly its
+    residue — the crash-reclaim path the ticket-leak fix rides on.
+    """
+    import os
+    import subprocess
+    import sys
+    import uuid
+
+    from inference_gateway_tpu.cluster.shm import ClusterSegment
+
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    name = f"ig-hammer-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    seg = ClusterSegment.create(name, workers=workers,
+                                counters=("held", "ops"), tenant_slots=8,
+                                blob_cap=1024)
+    procs: list["subprocess.Popen[bytes]"] = []
+    stop_readers = threading.Event()
+    try:
+        for i in range(workers):
+            seg.begin_generation(i, i + 1)
+        for i in range(workers):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "inference_gateway_tpu.cluster.shm",
+                 "--hammer", name, str(workers), str(i), str(iters)],
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+        def reader() -> None:
+            while not stop_readers.is_set():
+                try:
+                    totals = seg.totals()
+                    if totals.get("held", 0) < 0:
+                        fail(f"negative held total: {totals}")
+                    seg.tenant_totals()
+                    for blob in seg.blobs().values():
+                        if blob and "worker" not in blob:
+                            fail(f"torn blob: {blob!r}")
+                    seg.render_prometheus(0.0)
+                    seg.status(0.0)
+                except Exception as e:
+                    fail(f"reader: {e!r}")
+                    return
+
+        readers = [threading.Thread(target=reader, name=f"shm-r{t}", daemon=True)
+                   for t in range(reader_threads)]
+        for t in readers:
+            t.start()
+        for i, p in enumerate(procs):
+            try:
+                rc = p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                fail(f"worker {i} hung")
+                continue
+            if rc != 0:
+                fail(f"worker {i} exited {rc}")
+        stop_readers.set()
+        for t in readers:
+            t.join(timeout=30)
+            if t.is_alive():
+                fail(f"{t.name} did not finish")
+        if errors:
+            return errors
+
+        # Conservation at quiesce: exact residues, nothing lost or torn.
+        held_want = workers * (workers + 1) // 2
+        ops_want = 2 * workers * iters - held_want
+        totals = seg.totals()
+        if totals.get("held") != held_want:
+            fail(f"held total {totals.get('held')} != {held_want}")
+        if totals.get("ops") != ops_want:
+            fail(f"ops total {totals.get('ops')} != {ops_want}")
+        for i in range(workers):
+            if seg.worker_counter(i, "held") != i + 1:
+                fail(f"worker {i} held residue "
+                     f"{seg.worker_counter(i, 'held')} != {i + 1}")
+        tenant_want = [0] * 8
+        for i in range(workers):
+            tenant_want[i % 8] += i + 1
+        got = seg.tenant_totals()
+        for slot, want in enumerate(tenant_want):
+            if got.get(slot, 0) != want:
+                fail(f"tenant slot {slot}: {got.get(slot, 0)} != {want}")
+        blobs = seg.blobs()
+        for i in range(workers):
+            b = blobs.get(i)
+            if not b or not b.get("done") or b.get("progress") != iters:
+                fail(f"worker {i} final blob wrong: {b!r}")
+
+        # Crash reclaim: reaping worker 0 returns its residue and the
+        # merged totals drop by exactly that much.
+        reclaimed = seg.reap(0)
+        if reclaimed.get("held") != 1:
+            fail(f"reap reclaimed {reclaimed} (held != 1)")
+        totals = seg.totals()
+        if totals.get("held") != held_want - 1:
+            fail(f"post-reap held {totals.get('held')} != {held_want - 1}")
+        if 0 in seg.live():
+            fail("worker 0 still live after reap")
+    finally:
+        stop_readers.set()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        seg.close(unlink=True)
+    return errors

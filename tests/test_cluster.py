@@ -1,0 +1,828 @@
+"""Multi-worker gateway cluster (ISSUE 16).
+
+Four layers, matching the tentpole:
+
+- ``ClusterSegment``/``WorkerSlab`` unit behavior: layout validation on
+  attach, generation epochs, live-slab merges, seqlock blobs, the
+  peer-ejection quorum, the Prometheus/status merge surfaces.
+- Tenant derivation and the admission ledger's quota/fairness policy on
+  a VirtualClock (zero real sleeps): the noisy-tenant acceptance — a
+  10×-weight tenant offering 2× the class cap sheds against ITSELF
+  while a quiet tenant is never shed below its fair share — plus
+  cluster-wide quota occupancy through the shared segment and the
+  kill-switch posture.
+- The supervisor against real scripted worker processes: exit-code
+  death and wedged-heartbeat staleness both reap + respawn under a
+  fresh generation, and a SIGKILLed worker's admission tickets, quota
+  holds, and tenant gauge series are reclaimed by the generation reap
+  (the ticket-leak regression).
+- The full real-process e2e: a supervisor forking two REAL gateway
+  workers onto one SO_REUSEPORT port in front of a real TPU sidecar —
+  SIGKILLing one worker drops zero non-streamed requests, and a
+  mid-SSE-stream SIGKILL completes byte-identically through the PR 9
+  continuation splice under one trace id with once-only billing.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import uuid
+from pathlib import Path
+
+import pytest
+
+from inference_gateway_tpu.cluster.shm import (
+    GATEWAY_COUNTERS,
+    ClusterSegment,
+    tenant_slot,
+)
+from inference_gateway_tpu.cluster.supervisor import Supervisor, gateway_spawn
+from inference_gateway_tpu.cluster.tenancy import TenantPolicy, derive_tenant
+from inference_gateway_tpu.config import OverloadConfig, TenantConfig
+from inference_gateway_tpu.netio.client import HTTPClient, HTTPClientError
+from inference_gateway_tpu.netio.server import Headers
+from inference_gateway_tpu.otel.access_log import AccessLog
+from inference_gateway_tpu.resilience import (
+    CLASS_STREAMING,
+    PRIORITY_INTERACTIVE,
+    AdmissionRejectedError,
+    OverloadController,
+    VirtualClock,
+)
+from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+from inference_gateway_tpu.serving.server import SidecarServer
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+TRACEPARENT = "00-abcdefabcdefabcdefabcdefabcdef34-1234567890abcdef-01"
+
+
+def _name() -> str:
+    return f"ig-test-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+
+
+def _wait(pred, timeout: float = 90.0, interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+async def _await(pred, timeout: float = 90.0, interval: float = 0.05) -> bool:
+    """Async twin of ``_wait`` for the e2e tests: they share ONE event
+    loop with the supervisor's monitor task, so blocking in time.sleep
+    would also stop the reaper whose effect they are waiting for."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory segment
+# ---------------------------------------------------------------------------
+def test_segment_create_attach_merge_roundtrip():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    try:
+        seg.begin_generation(0, 1)
+        seg.begin_generation(1, 2)
+        seg.slab(0).add("in_flight_streaming", 2)
+        seg.slab(1).add("in_flight_streaming", 3)
+        seg.slab(1).add("shed_total", 1)
+        other = ClusterSegment.attach(name, workers=2)
+        try:
+            assert other.totals()["in_flight_streaming"] == 5
+            assert other.counter_total("shed_total") == 1
+            assert other.worker_counter(0, "in_flight_streaming") == 2
+            assert other.live() == [0, 1]
+        finally:
+            other.close()
+    finally:
+        seg.close(unlink=True)
+
+
+def test_attach_rejects_layout_mismatch():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    try:
+        with pytest.raises(ValueError):
+            ClusterSegment.attach(name, workers=3)
+        with pytest.raises(ValueError):
+            ClusterSegment.attach(name, workers=2, counters=("held",))
+    finally:
+        seg.close(unlink=True)
+
+
+def test_dead_slot_is_excluded_and_reap_reclaims():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    try:
+        seg.begin_generation(0, 1)
+        seg.begin_generation(1, 2)
+        seg.slab(0).add("in_flight_buffered", 4)
+        seg.slab(0).tenant_add(5, 2)
+        reclaimed = seg.reap(0)
+        assert reclaimed["in_flight_buffered"] == 4
+        assert seg.generation(0) == 0
+        assert seg.live() == [1]
+        # Dead slab contributes nothing to any merge surface.
+        assert seg.totals().get("in_flight_buffered", 0) == 0
+        assert seg.tenant_totals() == {}
+        # A fresh generation starts from zero.
+        seg.begin_generation(0, 3)
+        assert seg.worker_counter(0, "in_flight_buffered") == 0
+        assert seg.slab(0).generation == 3
+    finally:
+        seg.close(unlink=True)
+
+
+def test_blob_seqlock_roundtrip():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=1)
+    try:
+        seg.begin_generation(0, 1)
+        assert seg.read_blob(0) is None
+        seg.slab(0).publish({"pid": 42, "probes": {"tpu/m": True}})
+        assert seg.read_blob(0) == {"pid": 42, "probes": {"tpu/m": True}}
+        seg.slab(0).publish({"pid": 42, "probes": {}})
+        assert seg.blobs() == {0: {"pid": 42, "probes": {}}}
+    finally:
+        seg.close(unlink=True)
+
+
+def test_peer_ejected_quorum_only_removes_candidates():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=4)
+    try:
+        for i in range(4):
+            seg.begin_generation(i, i + 1)
+        # Only one peer has an opinion and it says ejected -> ejected
+        # (ties eject: the merge is deliberately pessimistic — it can
+        # only REMOVE candidates, never readmit them).
+        seg.slab(1).publish({"probes": {"tpu/m": True}})
+        assert seg.peer_ejected(0, "tpu", "m") is True
+        # One eject vs one healthy is still "at least half" -> ejected.
+        seg.slab(2).publish({"probes": {"tpu/m": False}})
+        assert seg.peer_ejected(0, "tpu", "m") is True
+        # Healthy peers outvoting the one confused worker -> admitted.
+        seg.slab(3).publish({"probes": {"tpu/m": False}})
+        assert seg.peer_ejected(0, "tpu", "m") is False
+        # Own slab's opinion is excluded from the peer vote.
+        seg.slab(0).publish({"probes": {"tpu/other": True}})
+        assert seg.peer_ejected(0, "tpu", "other") is False
+        # No votes at all -> no peer ejection.
+        assert seg.peer_ejected(0, "tpu", "missing") is False
+    finally:
+        seg.close(unlink=True)
+
+
+def test_render_prometheus_and_status_merge():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    try:
+        seg.begin_generation(0, 1, pid=111, now=10.0)
+        seg.slab(0).add("admitted_total", 7)
+        seg.slab(0).tenant_add(3, 2)
+        text = seg.render_prometheus(now=10.5)
+        assert 'cluster_worker_up{worker="0"} 1' in text
+        assert 'cluster_worker_up{worker="1"} 0' in text
+        assert 'cluster_admission{counter="admitted_total"} 7' in text
+        assert 'cluster_tenant_in_flight{slot="3"} 2' in text
+        status = seg.status(now=10.5)
+        assert status["live"] == [0]
+        assert status["totals"]["admitted_total"] == 7
+        assert status["per_worker"][0]["pid"] == 111
+        assert status["per_worker"][1] == {"worker": 1, "generation": 0}
+    finally:
+        seg.close(unlink=True)
+
+
+def test_tenant_slot_is_stable_and_bounded():
+    assert tenant_slot("key:abc123", 64) == tenant_slot("key:abc123", 64)
+    assert 0 <= tenant_slot("anything", 8) < 8
+    assert tenant_slot("a", 64) != tenant_slot("b", 64) or True  # collisions legal
+
+
+# ---------------------------------------------------------------------------
+# Tenant derivation + policy
+# ---------------------------------------------------------------------------
+def _headers(**kw) -> Headers:
+    h = Headers()
+    for k, v in kw.items():
+        h.set(k.replace("_", "-"), v)
+    return h
+
+
+def _jwt(sub: str) -> str:
+    import base64
+
+    def b64(obj) -> str:
+        raw = json.dumps(obj).encode()
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    return f"{b64({'alg': 'none'})}.{b64({'sub': sub})}.sig"
+
+
+def test_derive_tenant_sources():
+    policy = TenantPolicy(TenantConfig(enabled=True))
+    # API key wins; the id is a stable digest, never the raw secret.
+    t = derive_tenant(_headers(x_api_key="sk-secret-1"), policy)
+    assert t.startswith("key:") and "secret" not in t
+    assert t == derive_tenant(_headers(x_api_key="sk-secret-1"), policy)
+    assert t != derive_tenant(_headers(x_api_key="sk-secret-2"), policy)
+    # Bearer JWT: the (unverified) subject claim — it only picks a
+    # fairness bucket, authn stays the auth middleware's job.
+    assert derive_tenant(
+        _headers(authorization=f"Bearer {_jwt('team-a')}"), policy) == "sub:team-a"
+    # Opaque bearer tokens hash like keys.
+    opaque = derive_tenant(_headers(authorization="Bearer not.a.jwt!"), policy)
+    assert opaque.startswith("key:")
+    # Nothing at all -> the configured anonymous bucket.
+    assert derive_tenant(_headers(), policy) == "anonymous"
+    # Hostile subjects are sanitized into the label charset.
+    hostile = _jwt("a b\nc{evil}")
+    weird = derive_tenant(_headers(authorization=f"Bearer {hostile}"), policy)
+    assert "\n" not in weird and "{" not in weird
+
+
+def test_tenant_policy_weights_and_quota():
+    policy = TenantPolicy(TenantConfig(
+        enabled=True, weights="noisy:10,quiet:0.5,bad:x,:3", quota_base=4))
+    assert policy.weight("noisy") == 10.0
+    assert policy.weight("quiet") == 0.5
+    assert policy.weight("bad") == 1.0  # unparseable entry -> default
+    assert policy.weight("unknown") == 1.0
+    assert policy.quota("noisy") == 40
+    assert policy.quota("quiet") == 2
+    assert policy.quota("unknown") == 4
+    snap = policy.snapshot()
+    assert snap["enabled"] and snap["quota_base"] == 4
+    assert TenantPolicy(TenantConfig(enabled=True)).quota("any") == 0  # quotas off
+
+
+# ---------------------------------------------------------------------------
+# Fairness + quota on the admission ledger (VirtualClock, zero sleeps)
+# ---------------------------------------------------------------------------
+def _tenant_controller(shared=None, **tenant_kw):
+    cfg = OverloadConfig(
+        max_concurrent_streaming=4, queue_depth_streaming=4,
+        max_concurrent_buffered=4, queue_depth_buffered=4,
+        queue_timeout=5.0, shed_high_water=1.0, engine_depth_high_water=0,
+        drain_deadline=30.0, drain_retry_after=1.0)
+    policy = TenantPolicy(TenantConfig(enabled=True, **tenant_kw))
+    return OverloadController(cfg, clock=VirtualClock(), tenancy=policy,
+                              shared=shared)
+
+
+async def test_noisy_tenant_sheds_against_itself_never_the_quiet_one():
+    """THE fairness acceptance: a 10×-weight noisy tenant at 2× the
+    class cap's offered load saturates the class and is shed against
+    itself; the quiet tenant is never shed — it queues and takes the
+    next released slot (handover)."""
+    ctrl = _tenant_controller(weights="noisy:10")
+    tickets, sheds = [], []
+    for _ in range(8):  # 2x the streaming cap of 4
+        try:
+            tickets.append(await ctrl.admit(
+                CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="noisy"))
+        except AdmissionRejectedError as e:
+            sheds.append(e)
+    assert len(tickets) == 4 and len(sheds) == 4
+    assert {e.reason for e in sheds} == {"tenant_fair_share"}
+    assert all(e.status == 429 for e in sheds)
+
+    # The quiet tenant holds nothing -> NEVER shed: it queues.
+    task = asyncio.ensure_future(ctrl.admit(
+        CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="quiet"))
+    for _ in range(3):
+        await asyncio.sleep(0)
+    assert not task.done()
+    tickets.pop().release()  # handover: quiet takes the freed slot
+    quiet = await task
+    snap = ctrl.snapshot()
+    assert snap["tenants_in_flight"] == {"noisy": 3, "quiet": 1}
+    assert snap["classes"][CLASS_STREAMING]["in_flight"] == 4
+    for t in tickets:
+        t.release()
+    quiet.release()
+    assert ctrl.snapshot().get("tenants_in_flight") == {}
+
+
+async def test_fair_share_floor_is_one_slot_at_saturation():
+    """At saturation every tenant's fair share floors at one slot: a
+    tenant already holding one is shed on its second request, however
+    small its weight — and that IS its fair share, not starvation."""
+    ctrl = _tenant_controller(weights="noisy:10")
+    noisy = [await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="noisy")
+             for _ in range(3)]
+    quiet = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="quiet")
+    with pytest.raises(AdmissionRejectedError) as exc:
+        await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="quiet")
+    assert exc.value.reason == "tenant_fair_share"
+    # Below the cap nobody is fairness-shed at all.
+    quiet.release()
+    noisy[0].release()
+    again = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="quiet")
+    again.release()
+    for t in noisy[1:]:
+        t.release()
+
+
+async def test_tenant_quota_caps_in_flight_per_tenant():
+    ctrl = _tenant_controller(weights="big:2", quota_base=1)
+    big = [await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="big")
+           for _ in range(2)]  # quota = base 1 x weight 2
+    with pytest.raises(AdmissionRejectedError) as exc:
+        await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="big")
+    assert exc.value.reason == "tenant_quota" and exc.value.status == 429
+    # Another tenant's quota is untouched.
+    other = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="other")
+    for t in [*big, other]:
+        t.release()
+
+
+async def test_tenant_quota_counts_cluster_wide_through_the_segment():
+    """Quota occupancy reads the SHARED tenant cells: holds on a peer
+    worker's slab count against this worker's admission decision."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    try:
+        seg.begin_generation(0, 1)
+        seg.begin_generation(1, 2)
+        ctrl = _tenant_controller(shared=seg.slab(0), quota_base=2)
+        slot = tenant_slot("big", seg.tenant_slots)
+        seg.slab(1).tenant_add(slot, 2)  # peer worker already holds 2
+        with pytest.raises(AdmissionRejectedError) as exc:
+            await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="big")
+        assert exc.value.reason == "tenant_quota"
+        # The peer dies; its generation is reaped -> quota frees up.
+        seg.reap(1)
+        ticket = await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="big")
+        assert seg.tenant_total(slot) == 1  # mirrored from THIS worker
+        ticket.release()
+        assert seg.tenant_total(slot) == 0
+    finally:
+        seg.close(unlink=True)
+
+
+async def test_tenant_kill_switch_stops_rejections():
+    """TENANT_ENABLED=false is the isolation kill switch: no quota or
+    fairness rejections, tenant buckets are simply not consulted."""
+    cfg = OverloadConfig(max_concurrent_streaming=4, queue_depth_streaming=8,
+                         queue_timeout=5.0)
+    ctrl = OverloadController(
+        cfg, clock=VirtualClock(),
+        tenancy=TenantPolicy(TenantConfig(enabled=False, quota_base=1,
+                                          weights="noisy:10")))
+    tickets = [await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="noisy")
+               for _ in range(4)]
+    assert ctrl.snapshot().get("tenants_in_flight") is None
+    for t in tickets:
+        t.release()
+
+
+async def test_admission_counters_mirror_into_the_slab():
+    """Every admit/queue/shed/release transition lands in the shared
+    cells, conservation-exact — the /metrics merge and the crash reaper
+    read these, so drift here is a phantom-load bug."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=1)
+    try:
+        seg.begin_generation(0, 1)
+        ctrl = _tenant_controller(shared=seg.slab(0))
+        tickets = [await ctrl.admit(CLASS_STREAMING, PRIORITY_INTERACTIVE,
+                                    tenant="t") for _ in range(4)]
+        assert seg.counter_total("in_flight_streaming") == 4
+        assert seg.counter_total("admitted_total") == 4
+        task = asyncio.ensure_future(ctrl.admit(
+            CLASS_STREAMING, PRIORITY_INTERACTIVE, tenant="u"))
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert seg.counter_total("queued_streaming") == 1
+        tickets.pop().release()
+        (await task).release()
+        for t in tickets:
+            t.release()
+        totals = seg.totals()
+        assert totals["in_flight_streaming"] == 0
+        assert totals["queued_streaming"] == 0
+        assert totals["admitted_total"] == 5
+        assert seg.tenant_totals() == {}
+    finally:
+        seg.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor against real scripted workers
+# ---------------------------------------------------------------------------
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    return env
+
+
+def _idle_spawn(name: str, workers: int, extra: tuple = ()):
+    def spawn(index: int, generation: int):
+        return subprocess.Popen(
+            [sys.executable, "-m", "inference_gateway_tpu.cluster.worker",
+             "--idle", name, str(workers), str(index), "--interval", "0.05",
+             *extra],
+            cwd=str(REPO_ROOT), env=_child_env())
+    return spawn
+
+
+def _stop_supervisor(sup: Supervisor) -> None:
+    asyncio.run(sup.stop())
+
+
+def test_supervisor_respawns_exited_worker():
+    name = _name()
+    seg = ClusterSegment.create(name, workers=1)
+    sup = Supervisor(seg, _idle_spawn(name, 1, ("--exit-after", "3")),
+                     heartbeat_timeout=0, check_interval=0.05)
+    try:
+        sup.start()
+        first = sup.workers[0]
+        assert seg.generation(0) == first.generation == 1
+        assert _wait(lambda: bool(sup.check_once()))
+        assert sup.respawns >= 1
+        replacement = sup.workers[0]
+        assert replacement.generation > first.generation
+        assert seg.generation(0) == replacement.generation
+        assert replacement.proc.pid != first.proc.pid
+        assert replacement.restarts == first.restarts + 1
+    finally:
+        _stop_supervisor(sup)
+        seg.close(unlink=True)
+
+
+def test_supervisor_replaces_wedged_worker_via_heartbeat_staleness():
+    """A worker that stays alive but stops beating (wedged event loop)
+    is killed the hard way and respawned — poll() alone would never
+    catch it."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=1)
+    sup = Supervisor(seg, _idle_spawn(name, 1, ("--wedge-after", "2")),
+                     heartbeat_timeout=0.4, check_interval=0.05)
+    try:
+        sup.start()
+        first_pid = sup.workers[0].proc.pid
+        assert _wait(lambda: bool(sup.check_once()))
+        assert sup.respawns >= 1
+        assert sup.workers[0].proc.pid != first_pid
+    finally:
+        _stop_supervisor(sup)
+        seg.close(unlink=True)
+
+
+_LEAK_CHILD = textwrap.dedent("""
+    import os, sys, time
+    from inference_gateway_tpu.cluster.shm import ClusterSegment
+    name, generation = sys.argv[1], int(sys.argv[2])
+    seg = ClusterSegment.attach(name, workers=1)
+    slab = seg.slab(0)
+    if generation == 1:
+        # First life: take admission holds, then get SIGKILLed with
+        # them still open — the abrupt-death ticket leak.
+        slab.add("in_flight_streaming", 1)
+        slab.add("in_flight_buffered", 1)
+        slab.add("admitted_total", 2)
+        slab.tenant_add(3, 1)
+    slab.beat(time.monotonic())
+    print("ready", flush=True)
+    time.sleep(300)
+""")
+
+
+def test_sigkilled_worker_tickets_and_gauges_reclaimed_by_reap():
+    """The ticket-leak regression (ISSUE 16 satellite): a worker dies
+    abruptly holding admission tickets and a tenant quota hold; the
+    supervisor's generation reap reclaims every one — cluster totals
+    and the tenant gauge series drop the dead worker's contribution
+    within one monitor pass."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=1)
+
+    def spawn(index: int, generation: int):
+        return subprocess.Popen(
+            [sys.executable, "-c", _LEAK_CHILD, name, str(generation)],
+            stdout=subprocess.PIPE, cwd=str(REPO_ROOT), env=_child_env())
+
+    sup = Supervisor(seg, spawn, heartbeat_timeout=0, check_interval=0.05)
+    try:
+        sup.start()
+        proc = sup.workers[0].proc
+        assert proc.stdout.readline().strip() == b"ready"
+        assert seg.counter_total("in_flight_streaming") == 1
+        assert seg.tenant_total(3) == 1
+        assert 'cluster_tenant_in_flight{slot="3"} 1' in seg.render_prometheus(0.0)
+
+        os.kill(proc.pid, signal.SIGKILL)
+        assert _wait(lambda: bool(sup.check_once()))
+        # One monitor pass reclaimed the tickets, the quota hold, and
+        # the gauge series (no dead-worker residue on any surface).
+        totals = seg.totals()
+        assert totals.get("in_flight_streaming", 0) == 0
+        assert totals.get("in_flight_buffered", 0) == 0
+        assert seg.tenant_total(3) == 0
+        assert "cluster_tenant_in_flight" not in seg.render_prometheus(0.0)
+        # The replacement (generation 2) is alive with a clean slab.
+        assert sup.workers[0].proc.stdout.readline().strip() == b"ready"
+        assert seg.counter_total("admitted_total") == 0
+    finally:
+        _stop_supervisor(sup)
+        seg.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# Real-process e2e: supervisor + 2 SO_REUSEPORT gateway workers + sidecar
+# ---------------------------------------------------------------------------
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster_stack(aloop):
+    engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=160,
+                                 dtype="float32", max_prefill_batch=2,
+                                 use_mesh=False, decode_chunk=2))
+    access_log = AccessLog(service="tpu-sidecar", tail_size=128)
+    sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                            access_log=access_log)
+    sidecar_port = aloop.run(sidecar.start("127.0.0.1", 0))
+
+    port = _free_port()
+    metrics_port = _free_port()
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    spawn = gateway_spawn(name, 2, extra_env={
+        "PYTHONPATH": str(REPO_ROOT),
+        "TPU_API_URL": f"http://127.0.0.1:{sidecar_port}/v1",
+        "OLLAMA_API_URL": "http://127.0.0.1:1/v1",
+        "LLAMACPP_API_URL": "http://127.0.0.1:1/v1",
+        "SERVER_HOST": "127.0.0.1",
+        "SERVER_PORT": str(port),
+        "TELEMETRY_ENABLE": "true",
+        "TELEMETRY_TRACING_ENABLE": "true",
+        "TELEMETRY_METRICS_PORT": str(metrics_port),
+        "TENANT_ENABLED": "true",
+        "CLUSTER_HEARTBEAT_INTERVAL": "200ms",
+        "RESILIENCE_PROBE_ENABLED": "false",
+        "DRAIN_DEADLINE": "3s",
+    })
+    sup = Supervisor(seg, spawn, heartbeat_timeout=10.0, check_interval=0.2,
+                     term_grace=8.0)
+    aloop.run(_async_call(sup.start))
+    monitor = asyncio.run_coroutine_threadsafe(sup.run(), aloop.loop)
+    assert _wait(lambda: _fleet_ready(seg, 2), timeout=120), \
+        "gateway workers never became ready"
+    yield seg, sup, port, metrics_port, sidecar, access_log
+    aloop.run(sup.stop())
+    monitor.cancel()
+    seg.close(unlink=True)
+    aloop.run(sidecar.shutdown())
+
+
+async def _async_call(fn):
+    return fn()
+
+
+def _fleet_ready(seg: ClusterSegment, n: int) -> bool:
+    """All n workers live AND past boot: their runtime published a blob
+    (which happens only after the SO_REUSEPORT listeners are bound)."""
+    if len(seg.live()) != n:
+        return False
+    blobs = seg.blobs()
+    return all((blobs.get(i) or {}).get("pid") for i in range(n))
+
+
+def _chat_body(max_tokens=24, **extra) -> dict:
+    return {"model": "tpu/test-tiny", "stream": True, "temperature": 0,
+            "max_tokens": max_tokens,
+            "stream_options": {"include_usage": True},
+            "messages": [{"role": "user", "content": "splice me"}], **extra}
+
+
+def _parse_frames(body: bytes):
+    frames = []
+    for part in body.split(b"\n\n"):
+        part = part.strip()
+        if not part.startswith(b"data:"):
+            continue
+        payload = part[5:].strip()
+        frames.append((part + b"\n\n",
+                       None if payload == b"[DONE]" else json.loads(payload)))
+    return frames
+
+
+async def test_cluster_serves_and_merges_across_workers(cluster_stack):
+    seg, _sup, port, metrics_port, _sidecar, _log = cluster_stack
+    client = HTTPClient()
+    resp = await client.get(f"http://127.0.0.1:{port}/health")
+    assert resp.status == 200
+    # Non-streamed request through the SO_REUSEPORT edge.
+    resp = await client.get(f"http://127.0.0.1:{port}/v1/models?provider=tpu")
+    assert resp.status == 200
+    assert resp.json()["data"][0]["id"] == "tpu/test-tiny"
+    # Whichever worker the scrape lands on, the cluster series merge
+    # all live slabs — the per-worker metric merge surface.
+    resp = await client.get(f"http://127.0.0.1:{metrics_port}/metrics")
+    assert resp.status == 200
+    text = resp.body.decode()
+    assert 'cluster_worker_up{worker="0"} 1' in text
+    assert 'cluster_worker_up{worker="1"} 1' in text
+    assert 'cluster_admission{counter="in_flight_streaming"}' in text
+    # /debug/status carries the merged cluster section.
+    resp = await client.get(f"http://127.0.0.1:{metrics_port}/debug/status")
+    assert resp.status == 200
+    cluster = resp.json()["cluster"]
+    assert cluster["live"] == [0, 1]
+    assert cluster["self_worker"] in (0, 1)
+
+
+async def test_sigkill_one_worker_drops_zero_non_streamed_requests(cluster_stack):
+    """Availability acceptance: SIGKILL 1 of 2 workers, then hammer
+    non-streamed requests — every one succeeds (the dead listener
+    leaves the SO_REUSEPORT group with the process; the survivor takes
+    all accepts) while the supervisor respawns the replacement."""
+    seg, sup, port, _mp, _sidecar, _log = cluster_stack
+    respawns_before = sup.respawns
+    victim = seg.live()[0]
+    victim_gen = seg.generation(victim)
+    handle = sup.workers[victim]
+    os.kill(seg.pid(victim), signal.SIGKILL)
+    # "Beyond those in flight": a SYN racing the dying listener's fd
+    # teardown lands in the corpse's accept queue and is lost with it —
+    # that connection was in flight at the instant of death. The
+    # acceptance is about everything AFTER the process is gone.
+    assert await _await(lambda: handle.proc.poll() is not None, timeout=30)
+    for i in range(20):
+        client = HTTPClient()  # fresh pool: no keep-alive to the corpse
+        resp = await client.get(f"http://127.0.0.1:{port}/health")
+        assert resp.status == 200, f"request {i} dropped after worker kill"
+        if i % 5 == 0:
+            resp = await client.get(
+                f"http://127.0.0.1:{port}/v1/models?provider=tpu")
+            assert resp.status == 200
+    # The supervisor reaps and respawns; the fleet heals to 2.
+    assert await _await(lambda: sup.respawns > respawns_before, timeout=30)
+    assert await _await(lambda: _fleet_ready(seg, 2), timeout=120)
+    assert seg.generation(victim) > victim_gen
+
+
+async def test_sigkill_mid_stream_completes_byte_identical_via_continuation(
+        cluster_stack):
+    """THE chaos acceptance: SIGKILL the worker relaying an SSE stream
+    after the first bytes; the client finishes the stream through a
+    continuation request served by the survivor — byte-identical to an
+    unkilled run, one trace id across the kill, continuation tokens
+    billed exactly once, and the dead worker's streaming ticket
+    reclaimed within one reap interval."""
+    seg, sup, port, _mp, sidecar, access_log = cluster_stack
+    url = f"http://127.0.0.1:{port}/v1/chat/completions"
+    headers = Headers()
+    headers.set("Content-Type", "application/json")
+    headers.set("traceparent", TRACEPARENT)
+
+    # 96 tokens instead of the default 24: the kill must land while the
+    # relay is still streaming, and on a slow box a short stream can
+    # finish into the client's socket buffer (ticket already released)
+    # before two content frames are even parsed.
+    body = json.dumps(_chat_body(max_tokens=96)).encode()
+
+    # Reference run, unkilled.
+    client = HTTPClient()
+    resp = await client.post(url, body, headers=headers, stream=True)
+    assert resp.status == 200
+    unkilled = b""
+    async for block in resp.iter_raw():
+        unkilled += block
+    frames = _parse_frames(unkilled)
+    usage = next(ev["usage"] for _r, ev in frames if ev and ev.get("usage"))
+    assert usage["completion_tokens"] >= 6
+
+    # Killed run: read a few content frames, SIGKILL the worker that
+    # holds the streaming ticket (visible in its shared slab), keep
+    # whatever complete frames arrived.
+    client = HTTPClient()
+    resp = await client.post(url, body, headers=headers, stream=True)
+    assert resp.status == 200
+    buf, got, contents, killed = b"", b"", [], None
+    try:
+        async for block in resp.iter_raw():
+            buf += block
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                raw += b"\n\n"
+                got += raw
+                payload = raw.strip()[5:].strip()
+                if payload != b"[DONE]":
+                    ev = json.loads(payload)
+                    delta = ((ev.get("choices") or [{}])[0].get("delta") or {})
+                    if delta.get("content"):
+                        contents.append(delta["content"])
+            if len(contents) >= 2 and killed is None:
+                for i in seg.live():
+                    if seg.worker_counter(i, "in_flight_streaming") > 0:
+                        killed = seg.pid(i)
+                        os.kill(killed, signal.SIGKILL)
+                        break
+                assert killed is not None, "no worker holds the stream ticket"
+    except (HTTPClientError, OSError, ConnectionError, asyncio.IncompleteReadError):
+        pass
+    assert killed is not None, "stream finished before the kill landed"
+    assert b"[DONE]" not in got, "stream finished before the kill landed"
+
+    # Ticket reclaim within one reap interval (ISSUE 16 acceptance).
+    assert await _await(lambda: seg.counter_total("in_flight_streaming") == 0,
+                        timeout=30)
+
+    # Continuation splice: re-issue against the survivor with the
+    # relayed prefix under the ORIGINAL id — PR 9's resume contract.
+    kept = _parse_frames(got)
+    cid, created = kept[0][1]["id"], kept[0][1]["created"]
+    prefix = "".join(contents)
+    cont_body = _chat_body(max_tokens=96,
+                           continuation={"text": prefix, "id": cid,
+                                         "created": created})
+    client = HTTPClient()
+    resp = await client.post(url, json.dumps(cont_body).encode(),
+                             headers=headers, stream=True)
+    assert resp.status == 200
+    continued = b""
+    async for block in resp.iter_raw():
+        continued += block
+    cont_frames = _parse_frames(continued)
+    assert (cont_frames[0][1]["choices"][0]["delta"] or {}).get("role") == "assistant"
+    assert cont_frames[0][1]["id"] == cid  # ONE completion id spans the kill
+
+    # Byte-identity: kept frames + continuation past its role preamble
+    # must equal the unkilled run, modulo the per-run envelope identity
+    # (fresh runs mint fresh ids/created).
+    spliced = got + b"".join(raw for raw, _ev in cont_frames[1:])
+
+    def normalize(raw_body: bytes) -> bytes:
+        fs = _parse_frames(raw_body)
+        ids = {ev["id"] for _r, ev in fs if ev and ev.get("id")}
+        created_set = {ev["created"] for _r, ev in fs if ev and "created" in ev}
+        assert len(ids) == 1 and len(created_set) == 1, (ids, created_set)
+        return (raw_body.replace(ids.pop().encode(), b"ID")
+                .replace(b'"created":%d' % created_set.pop(), b'"created":0'))
+
+    assert normalize(spliced) == normalize(unkilled)
+
+    # One trace id across the kill: both sidecar establishments (the
+    # killed relay's and the continuation's) logged the edge trace.
+    edge_trace = TRACEPARENT.split("-")[1]
+    lines = [e for e in access_log.tail
+             if e.get("route") == "/v1/chat/completions" and e.get("trace_id")]
+    assert len([e for e in lines if e["trace_id"] == edge_trace]) >= 2
+
+    # Once-only billing: the continuation's sidecar line bills exactly
+    # the tokens past the relayed prefix (the killed attempt's line is
+    # disconnect-attributed asynchronously, so only this is exact).
+    resume = len(sidecar.engine.tokenizer.encode(prefix, add_bos=False))
+    assert 0 < resume < usage["completion_tokens"]
+    assert any(e.get("output_tokens") == usage["completion_tokens"] - resume
+               for e in access_log.tail
+               if e.get("route") == "/v1/chat/completions")
+
+    # The fleet heals for whoever runs next.
+    assert await _await(lambda: _fleet_ready(seg, 2), timeout=120)
+
+
+async def test_tenant_labels_ride_the_edge_in_cluster_mode(cluster_stack):
+    """TENANT_ENABLED=true in the workers: per-tenant occupancy lands
+    in the shared tenant cells and the wide-event access log carries
+    the tenant id — verified via the shared segment after a keyed
+    request."""
+    seg, _sup, port, _mp, _sidecar, _log = cluster_stack
+    client = HTTPClient()
+    headers = Headers()
+    headers.set("Content-Type", "application/json")
+    headers.set("X-API-Key", "sk-tenant-e2e")
+    body = dict(_chat_body(max_tokens=4), stream=False)
+    resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                             json.dumps(body).encode(), headers=headers)
+    assert resp.status == 200
+    assert resp.json()["usage"]["completion_tokens"] > 0
+    # The hold was mirrored in and released back out. The worker
+    # releases its ticket AFTER flushing the response body, so give the
+    # write a moment to land in the segment rather than racing it.
+    assert await _await(lambda: seg.tenant_totals() == {}, timeout=30), \
+        seg.tenant_totals()
+    assert seg.counter_total("admitted_total") > 0

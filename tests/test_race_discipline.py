@@ -25,6 +25,7 @@ from tests.race_harness import (
     hammer_prober,
     hammer_registry,
     hammer_scheduler_preempt,
+    hammer_shm_ledger,
     instrument,
     start_instrumented,
 )
@@ -166,4 +167,15 @@ def test_prober_survives_concurrent_eject_readmit_select():
         [ProbeTarget("tpu", f"model-{i}", f"http://m{i}/health") for i in range(4)],
         eject_after=2, otel=OpenTelemetry())
     errors = hammer_prober(prober)
+    assert errors == [], errors
+
+
+def test_shm_ledger_survives_multiprocess_hammer_and_reap():
+    """The cluster shared-memory ledger is written by every gateway
+    worker process and merged by /metrics scrapes and the supervisor's
+    crash reaper (ISSUE 16): four real child processes hammer their
+    slabs while parent threads read-merge continuously — exact counter
+    conservation at quiesce, no torn blob ever observed, and reaping a
+    worker reclaims exactly its residue."""
+    errors = hammer_shm_ledger(workers=4, iters=2000)
     assert errors == [], errors

@@ -334,6 +334,84 @@ def test_cross_thread_state_single_side_mutation_is_fine():
 
 
 # ----------------------------------------------------------------------
+# cross-process-state
+# ----------------------------------------------------------------------
+
+_XPS_TEMPLATE = """
+class Ledger:
+    def __init__(self, shared):
+        self._shared = shared
+        self.admitted = 0
+        self.shed = 0
+
+    def admit(self):
+        {admit_body}
+
+    def shed_one(self):
+        {shed_body}
+"""
+
+
+def test_cross_process_state_flags_unmirrored_counter():
+    bad = _XPS_TEMPLATE.format(
+        admit_body="self.admitted += 1",
+        shed_body="self.shed += 1")
+    findings = lint(bad, select="cross-process-state")
+    assert len(findings) == 2  # both process-local bumps are invisible to peers
+    assert all("slab-bound" in f.message for f in findings)
+
+
+def test_cross_process_state_good_twin_mirrors_into_slab():
+    good = _XPS_TEMPLATE.format(
+        admit_body=("self.admitted += 1\n"
+                    "        self._shared.add('admitted', 1)"),
+        shed_body=("self.shed += 1\n"
+                   "        self._shared.add('shed', 1)"))
+    assert lint(good, select="cross-process-state") == []
+
+
+def test_cross_process_state_one_mirror_hop_is_compliant():
+    # A method that routes through a self-call which itself touches the
+    # slab (the `_mirror` idiom in OverloadController) is compliant.
+    good = """
+    class Ledger:
+        def __init__(self, shared):
+            self._shared = shared
+            self.admitted = 0
+
+        def _mirror(self, name, delta):
+            self._shared.add(name, delta)
+
+        def admit(self):
+            self.admitted += 1
+            self._mirror("admitted", 1)
+    """
+    assert lint(good, select="cross-process-state") == []
+
+
+def test_cross_process_state_ignores_unbound_classes():
+    # No slab in __init__ -> plain process-local counters are fine.
+    good = """
+    class Local:
+        def __init__(self):
+            self.count = 0
+
+        def hit(self):
+            self.count += 1
+    """
+    assert lint(good, select="cross-process-state") == []
+
+
+def test_cross_process_state_pragma_suppresses_with_reason():
+    ok = _XPS_TEMPLATE.format(
+        admit_body=("self.admitted += 1  "
+                    "# graftlint: disable=cross-process-state -- "
+                    "local-only diagnostic, never merged"),
+        shed_body="pass")
+    assert lint(ok, select="cross-process-state") == []
+
+
+# ----------------------------------------------------------------------
 # jax-hot-path
 # ----------------------------------------------------------------------
 
