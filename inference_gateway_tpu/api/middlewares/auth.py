@@ -134,9 +134,12 @@ class OIDCAuthenticator:
 
 
 def oidc_auth_middleware(authenticator: OIDCAuthenticator | None, logger=None,
-                         exempt_paths: tuple[str, ...] = ("/health",)):
+                         exempt_paths: tuple[str, ...] = ("/health",),
+                         tenancy=None):
     """auth.go:55-81; pass ``authenticator=None`` for the noop variant
-    (auth.go:24,48)."""
+    (auth.go:24,48). ``tenancy`` (a ``TenantPolicy``) learns each
+    verified token's ``sub`` here, so the pre-auth tenant derivation can
+    honor subject buckets without ever trusting an unverified claim."""
 
     async def middleware(req: Request, nxt: Handler) -> Response:
         if authenticator is None or req.path in exempt_paths:
@@ -158,6 +161,8 @@ def oidc_auth_middleware(authenticator: OIDCAuthenticator | None, logger=None,
         # Stash the bearer for upstream forwarding (types/context.go:5).
         req.ctx["auth_token"] = token
         req.ctx["auth_claims"] = claims
+        if tenancy is not None:
+            tenancy.record_verified(token, claims.get("sub"))
         return await nxt(req)
 
     return middleware

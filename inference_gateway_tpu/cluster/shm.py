@@ -280,24 +280,14 @@ class ClusterSegment:
 
     # -- health read-merge -----------------------------------------------
     def peer_ejected(self, self_index: int, provider: str, model: str) -> bool:
-        """Read-merged replica-health verdict: True when at least half
-        of the OTHER live workers that published probe verdicts report
-        ``provider/model`` ejected. The local prober stays authoritative
-        for this worker's own evidence; the merge only ADDS peers'
-        detections, so one confused worker can never readmit a replica
-        the rest of the cluster has condemned."""
-        key = f"{provider}/{model}"
-        votes = ejected = 0
-        for i, blob in self.blobs().items():
-            if i == self_index:
-                continue
-            probes = blob.get("probes")
-            if not isinstance(probes, dict) or key not in probes:
-                continue
-            votes += 1
-            if probes[key]:
-                ejected += 1
-        return votes > 0 and ejected * 2 >= votes and ejected > 0
+        """One-shot read-merged replica-health verdict (see
+        ``PeerHealthView`` for the semantics). This decodes every live
+        peer's blob on each call — the routing hot path must go through
+        a ``PeerHealthView`` refreshed on the heartbeat interval
+        instead."""
+        view = PeerHealthView(self, self_index)
+        view.refresh()
+        return view.ejected(provider, model)
 
     # -- introspection ---------------------------------------------------
     def status(self, now: float) -> dict[str, Any]:
@@ -360,6 +350,49 @@ class ClusterSegment:
             for slot, value in sorted(tenants.items()):
                 lines.append(f'cluster_tenant_in_flight{{slot="{slot}"}} {value}')
         return "\n".join(lines) + "\n"
+
+
+class PeerHealthView:
+    """Cached read-merge of peers' published probe verdicts.
+
+    A deployment is ejected when at least half of the OTHER live
+    workers that voted on it report it ejected. The local prober stays
+    authoritative for this worker's own evidence; the merge only ADDS
+    peers' detections, so one confused worker can never readmit a
+    replica the rest of the cluster has condemned.
+
+    ``refresh()`` decodes every live peer's seqlock blob once and
+    snapshots the merged ejection set; ``ejected()`` is then a set
+    lookup. The WorkerRuntime refreshes on its heartbeat interval, so
+    the routing hot path (one ``ejected()`` per candidate per request)
+    never JSON-decodes blobs inline — peer verdicts propagate within
+    one heartbeat, which is also how fast they are published."""
+
+    __slots__ = ("_seg", "self_index", "_ejected")
+
+    def __init__(self, segment: ClusterSegment, self_index: int) -> None:
+        self._seg = segment
+        self.self_index = self_index
+        self._ejected: frozenset[str] = frozenset()
+
+    def refresh(self) -> None:
+        votes: dict[str, int] = {}
+        ejects: dict[str, int] = {}
+        for i, blob in self._seg.blobs().items():
+            if i == self.self_index:
+                continue
+            probes = blob.get("probes")
+            if not isinstance(probes, dict):
+                continue
+            for key, verdict in probes.items():
+                votes[key] = votes.get(key, 0) + 1
+                if verdict:
+                    ejects[key] = ejects.get(key, 0) + 1
+        self._ejected = frozenset(
+            key for key, n in ejects.items() if n * 2 >= votes[key])
+
+    def ejected(self, provider: str, model: str) -> bool:
+        return f"{provider}/{model}" in self._ejected
 
 
 class WorkerSlab:

@@ -7,7 +7,10 @@ subprocesses, and does exactly three things —
   wakes the monitor immediately; a polling pass every
   ``CLUSTER_CHECK_INTERVAL`` catches the rest, plus *heartbeat
   staleness* — a worker that is alive as a process but wedged (event
-  loop stuck, VM paused) stops beating and is killed and replaced;
+  loop stuck, VM paused) stops beating and is killed and replaced.
+  Staleness only arms after a worker's FIRST observed beat; until then
+  the (larger) ``boot_timeout`` applies, so a slow boot — gateway
+  assembly, MCP init, listener bind — is never crash-looped;
 - **reap the dead generation**: ``segment.reap(i)`` reclaims the
   crashed worker's in-flight tickets, quota holds, and gauge
   contributions before the replacement spawns — phantom load never
@@ -52,6 +55,7 @@ class Supervisor:
 
     def __init__(self, segment: ClusterSegment, spawn: SpawnFn, *,
                  heartbeat_timeout: float = 5.0,
+                 boot_timeout: float = 30.0,
                  check_interval: float = 0.5,
                  term_grace: float = 35.0,
                  clock: Clock | None = None,
@@ -59,6 +63,7 @@ class Supervisor:
         self.segment = segment
         self._spawn_fn = spawn
         self.heartbeat_timeout = heartbeat_timeout
+        self.boot_timeout = boot_timeout
         self.check_interval = check_interval
         self.term_grace = term_grace
         self.clock = clock or MonotonicClock()
@@ -69,6 +74,13 @@ class Supervisor:
         self._wake = asyncio.Event()
         self._stopping = False
         self._sigchld_installed = False
+        # Slots under orchestrated restart: the monitor must not reap or
+        # respawn these — rolling_restart owns them until it is done
+        # (otherwise the SIGTERM'd exit wakes check_once, which respawns
+        # first, and rolling_restart then reaps the LIVE replacement's
+        # slab and double-spawns, orphaning a second writer).
+        self._restarting: set[int] = set()
+        self._rolling = False
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -113,10 +125,26 @@ class Supervisor:
             return respawned
         now = self.clock.now()
         for index, handle in list(self.workers.items()):
+            if index in self._restarting:
+                continue  # rolling_restart owns this slot right now
             exited = handle.proc.poll() is not None
             stale = False
+            cause = "exited"
             if not exited and self.heartbeat_timeout > 0:
-                stale = now - self.segment.heartbeat(index) > self.heartbeat_timeout
+                beat = self.segment.heartbeat(index)
+                if beat > handle.started:
+                    # The worker's own loop has beaten at least once:
+                    # staleness is measured from its last beat.
+                    stale = now - beat > self.heartbeat_timeout
+                    cause = "stale_heartbeat"
+                elif self.boot_timeout > 0:
+                    # Still booting — the slab holds only the spawn
+                    # stamp. build_gateway + listener bind can lawfully
+                    # take longer than a heartbeat interval, so boots
+                    # get their own (larger) deadline instead of being
+                    # crash-looped by the steady-state timeout.
+                    stale = now - handle.started > self.boot_timeout
+                    cause = "boot_timeout"
             if not exited and not stale:
                 continue
             if stale and not exited:
@@ -133,7 +161,7 @@ class Supervisor:
                 self.logger.warn(
                     "cluster worker died; respawning",
                     "worker", index, "generation", handle.generation,
-                    "cause", "stale_heartbeat" if stale else "exited",
+                    "cause", cause,
                     "exit_code", handle.proc.returncode,
                     "reclaimed_in_flight",
                     sum(v for k, v in reclaimed.items() if k.startswith("in_flight")))
@@ -161,11 +189,12 @@ class Supervisor:
             await self.clock.sleep(0.05)
         return True
 
-    async def _wait_live(self, index: int, timeout: float = 10.0) -> bool:
+    async def _wait_live(self, index: int, timeout: float | None = None) -> bool:
         """A replacement counts live once its heartbeat moves past the
         spawn stamp (the worker's own loop is beating)."""
         handle = self.workers[index]
-        deadline = self.clock.now() + timeout
+        deadline = self.clock.now() + (
+            timeout if timeout is not None else max(10.0, self.boot_timeout))
         while self.clock.now() < deadline:
             if self.segment.heartbeat(index) > handle.started:
                 return True
@@ -174,22 +203,53 @@ class Supervisor:
             await self.clock.sleep(0.05)
         return False
 
+    @property
+    def rolling(self) -> bool:
+        return self._rolling
+
     async def rolling_restart(self) -> None:
         """Zero-downtime restart: one worker at a time — SIGTERM (the
         worker drains through its own begin_drain/wait_idle path), reap
         its generation, respawn, and only move on once the replacement
-        is beating. N-1 listeners keep accepting throughout."""
-        for index in sorted(self.workers):
-            handle = self.workers[index]
-            handle.proc.terminate()
-            if not await self._wait_exited(handle, self.term_grace):
-                handle.proc.kill()
-                handle.proc.wait()
-            self.segment.reap(index)
-            self._spawn(index, restarts=handle.restarts + 1)
-            await self._wait_live(index)
+        is beating. N-1 listeners keep accepting throughout.
+
+        Exactly one rolling restart runs at a time (a second call —
+        e.g. rapid SIGHUPs — is a no-op while one is in progress), and
+        each slot is guarded against the monitor for the whole
+        SIGTERM→reap→respawn window: without the guard, the SIGTERM'd
+        exit would wake check_once, which reaps and respawns first, and
+        this coroutine would then zero the LIVE replacement's slab and
+        spawn an unsupervised second writer for it."""
+        if self._rolling:
             if self.logger is not None:
-                self.logger.info("cluster worker restarted", "worker", index)
+                self.logger.warn("rolling restart already in progress; ignoring")
+            return
+        self._rolling = True
+        try:
+            for index in sorted(self.workers):
+                if self._stopping:
+                    return
+                self._restarting.add(index)
+                try:
+                    handle = self.workers[index]
+                    handle.proc.terminate()
+                    if not await self._wait_exited(handle, self.term_grace):
+                        handle.proc.kill()
+                        handle.proc.wait()
+                    if self.workers[index] is not handle:
+                        # Defense in depth: a respawn slipped in while we
+                        # awaited (should be impossible under the guard)
+                        # — the slot is already fresh, leave it alone.
+                        continue
+                    self.segment.reap(index)
+                    self._spawn(index, restarts=handle.restarts + 1)
+                finally:
+                    self._restarting.discard(index)
+                await self._wait_live(index)
+                if self.logger is not None:
+                    self.logger.info("cluster worker restarted", "worker", index)
+        finally:
+            self._rolling = False
 
     async def stop(self) -> None:
         """SIGTERM the fleet and wait out each worker's drain."""
@@ -265,17 +325,29 @@ async def run_supervisor(cfg: Any, logger: Any = None) -> None:
     sup = Supervisor(
         segment, gateway_spawn(name, int(cfg.cluster.workers)),
         heartbeat_timeout=cfg.cluster.heartbeat_timeout,
+        boot_timeout=cfg.cluster.boot_timeout,
         check_interval=cfg.cluster.check_interval,
         term_grace=cfg.overload.drain_deadline + 5.0,
         logger=logger)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     rolling: list["asyncio.Task[None]"] = []
+
+    def on_sighup() -> None:
+        # Rapid SIGHUPs must not stack restarts over the same slots:
+        # rolling_restart() itself coalesces (a second call while one is
+        # in progress is a no-op), so we only skip the task spawn — and
+        # drop finished tasks so the list stays bounded.
+        rolling[:] = [t for t in rolling if not t.done()]
+        if sup.rolling:
+            if logger is not None:
+                logger.warn("SIGHUP ignored: rolling restart in progress")
+            return
+        rolling.append(loop.create_task(sup.rolling_restart()))
+
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
-    loop.add_signal_handler(
-        signal.SIGHUP,
-        lambda: rolling.append(loop.create_task(sup.rolling_restart())))
+    loop.add_signal_handler(signal.SIGHUP, on_sighup)
     sup.start()
     if logger is not None:
         logger.info("cluster supervisor running", "workers", segment.workers,

@@ -6,12 +6,17 @@ a request that will be shed for fairness costs no OIDC round trip:
 1. an API key (``X-API-Key`` or a non-JWT ``Authorization: Bearer``)
    hashes to a stable opaque id (``key:<sha256-prefix>`` — raw keys
    must never become metric labels or log fields);
-2. a JWT bearer falls back to its **unverified** ``sub`` claim
-   (``sub:<subject>``). Unverified is safe here: the auth middleware
-   still rejects invalid tokens downstream, and a forged ``sub`` only
-   picks which fairness bucket the request is counted against — exactly
-   what choosing an API key does;
-3. everything else lands in the configurable anonymous tenant.
+2. a bearer token whose signature the auth middleware has already
+   **verified** (any earlier request with the same token) maps to its
+   ``sub`` claim (``sub:<subject>``) via ``TenantPolicy.record_verified``;
+3. an **unverified** bearer — JWT or opaque — buckets by a digest of
+   the full token (``key:<sha256-prefix>``), never by its claims: a
+   ``sub`` claim is attacker-chosen pre-auth, so honoring it unverified
+   would let anyone forge ``sub:<victim>`` and burn a specific victim
+   tenant's cluster-wide quota/fairness budget with requests that auth
+   later rejects. A forged token's digest, by contrast, lands in a
+   bucket only the forger occupies;
+4. everything else lands in the configurable anonymous tenant.
 
 ``TenantPolicy`` carries the weight table (``TENANT_WEIGHTS`` →
 ``tenant:weight`` pairs) and quota tiers (``TENANT_QUOTA_BASE`` × weight
@@ -21,14 +26,13 @@ lives in the OverloadController, which owns the ledger it protects.
 
 from __future__ import annotations
 
-import base64
 import hashlib
-import json
 import re
 from typing import Any
 
 _LABEL_SAFE = re.compile(r"[^A-Za-z0-9_.:@-]+")
 _MAX_TENANT_LEN = 64
+_VERIFIED_CACHE_CAP = 4096
 
 
 def _sanitize(raw: str) -> str:
@@ -37,28 +41,13 @@ def _sanitize(raw: str) -> str:
     return out or "invalid"
 
 
-def _jwt_subject(token: str) -> str | None:
-    """The ``sub`` claim of a JWT, decoded without verification (see
-    module docstring for why that is sufficient here)."""
-    parts = token.split(".")
-    if len(parts) != 3:
-        return None
-    payload = parts[1]
-    try:
-        decoded = base64.urlsafe_b64decode(payload + "=" * (-len(payload) % 4))
-        claims = json.loads(decoded)
-    except (ValueError, json.JSONDecodeError):
-        return None
-    sub = claims.get("sub") if isinstance(claims, dict) else None
-    return str(sub) if sub else None
-
-
 def _key_id(key: str) -> str:
     return "key:" + hashlib.sha256(key.encode("utf-8", "replace")).hexdigest()[:10]
 
 
 def derive_tenant(headers: Any, policy: "TenantPolicy") -> str:
-    """Tenant id for one request: API key → OIDC subject → anonymous."""
+    """Tenant id for one request: API key → verified-token subject →
+    token digest → anonymous."""
     api_key = headers.get("x-api-key")
     if api_key:
         return _key_id(api_key)
@@ -66,9 +55,9 @@ def derive_tenant(headers: Any, policy: "TenantPolicy") -> str:
     if auth.lower().startswith("bearer "):
         token = auth[7:].strip()
         if token:
-            sub = _jwt_subject(token)
+            sub = policy.verified_subject(token)
             if sub is not None:
-                return _sanitize("sub:" + sub)
+                return sub
             return _key_id(token)
     return policy.anonymous
 
@@ -94,6 +83,28 @@ class TenantPolicy:
                 continue
             if parsed > 0:
                 self.weights[_sanitize(tenant)] = parsed
+        # token digest -> sub bucket, populated by the auth middleware
+        # only AFTER signature verification (oldest-in eviction; the
+        # cache is an optimization — a miss just means the token buckets
+        # by digest until its next verified request).
+        self._verified: dict[str, str] = {}
+
+    def record_verified(self, token: str, sub: Any) -> None:
+        """Bind a signature-verified token to its ``sub`` bucket, so
+        subsequent requests carrying it derive a stable per-subject
+        tenant id even though derivation runs pre-auth."""
+        if not token or not sub:
+            return
+        digest = _key_id(token)
+        if digest not in self._verified:
+            while len(self._verified) >= _VERIFIED_CACHE_CAP:
+                self._verified.pop(next(iter(self._verified)))
+        self._verified[digest] = _sanitize("sub:" + str(sub))
+
+    def verified_subject(self, token: str) -> str | None:
+        """The ``sub`` bucket for a token the auth middleware has
+        verified before; None for tokens never seen verified."""
+        return self._verified.get(_key_id(token))
 
     def weight(self, tenant: str) -> float:
         return self.weights.get(tenant, self.default_weight)

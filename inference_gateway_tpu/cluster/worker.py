@@ -9,7 +9,9 @@ Each gateway worker owns exactly one slab in the shared segment. The
   detected without any RPC;
 - **verdict publishing** — serializes the local prober/breaker verdicts
   into the slab's seqlock blob, so peers can read-merge replica health
-  (``ClusterSegment.peer_ejected``) without a consensus protocol.
+  without a consensus protocol; on the same cadence it refreshes this
+  worker's cached ``PeerHealthView`` of everyone else's verdicts, so
+  the routing hot path never decodes peer blobs inline.
 
 The counter mirroring itself does NOT live here — the
 OverloadController mirrors its ledger into the slab synchronously at
@@ -29,7 +31,7 @@ import asyncio
 import os
 from typing import Any
 
-from inference_gateway_tpu.cluster.shm import ClusterSegment, WorkerSlab
+from inference_gateway_tpu.cluster.shm import ClusterSegment, PeerHealthView, WorkerSlab
 from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock, VirtualClock
 
 
@@ -37,11 +39,13 @@ class WorkerRuntime:
     """Heartbeat + verdict-publisher loop for one worker's slab."""
 
     def __init__(self, slab: WorkerSlab, *, prober: Any = None,
-                 breakers: Any = None, interval: float = 1.0,
+                 breakers: Any = None, peer_health: PeerHealthView | None = None,
+                 interval: float = 1.0,
                  clock: Clock | None = None, logger: Any = None) -> None:
         self.slab = slab
         self.prober = prober
         self.breakers = breakers
+        self.peer_health = peer_health
         self.interval = interval
         self.clock = clock or MonotonicClock()
         self.logger = logger
@@ -50,7 +54,9 @@ class WorkerRuntime:
     def publish_once(self) -> None:
         """One beat: stamp the heartbeat, then publish verdicts. Order
         matters — the heartbeat proves this loop alive; the blob is only
-        meaningful when its writer is."""
+        meaningful when its writer is. The cached peer-health view is
+        refreshed on the same cadence: the routing hot path reads the
+        merge as a set lookup, never decoding peer blobs inline."""
         self.slab.beat(self.clock.now())
         payload: dict[str, Any] = {"pid": os.getpid()}
         if self.prober is not None:
@@ -60,6 +66,8 @@ class WorkerRuntime:
                 f"{p}/{m}": state
                 for (p, m), state in self.breakers.snapshot().items()}
         self.slab.publish(payload)
+        if self.peer_health is not None:
+            self.peer_health.refresh()
 
     def start(self) -> None:
         self.publish_once()  # first beat before any interval elapses

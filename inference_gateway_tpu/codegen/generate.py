@@ -583,6 +583,7 @@ def check_config_defaults(spec: dict) -> list[str]:
         "CLUSTER_WORKERS": cfg.cluster.workers,
         "CLUSTER_HEARTBEAT_INTERVAL": cfg.cluster.heartbeat_interval,
         "CLUSTER_HEARTBEAT_TIMEOUT": cfg.cluster.heartbeat_timeout,
+        "CLUSTER_BOOT_TIMEOUT": cfg.cluster.boot_timeout,
         "CLUSTER_CHECK_INTERVAL": cfg.cluster.check_interval,
         "CLUSTER_TENANT_SLOTS": cfg.cluster.tenant_slots,
         "CLUSTER_SEGMENT_NAME": cfg.cluster.segment_name,

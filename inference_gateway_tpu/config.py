@@ -499,6 +499,7 @@ class ClusterConfig:
     workers: int = 1
     heartbeat_interval: float = 1.0
     heartbeat_timeout: float = 5.0
+    boot_timeout: float = 30.0
     check_interval: float = 0.5
     tenant_slots: int = 64
     segment_name: str = ""
@@ -511,6 +512,7 @@ class ClusterConfig:
             workers=_get_int(env, prefix + "WORKERS", 1),
             heartbeat_interval=_get_duration(env, prefix + "HEARTBEAT_INTERVAL", "1s"),
             heartbeat_timeout=_get_duration(env, prefix + "HEARTBEAT_TIMEOUT", "5s"),
+            boot_timeout=_get_duration(env, prefix + "BOOT_TIMEOUT", "30s"),
             check_interval=_get_duration(env, prefix + "CHECK_INTERVAL", "500ms"),
             tenant_slots=_get_int(env, prefix + "TENANT_SLOTS", 64),
             segment_name=_get_str(env, prefix + "SEGMENT_NAME"),

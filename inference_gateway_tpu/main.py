@@ -21,7 +21,7 @@ from inference_gateway_tpu.api.middlewares.auth import OIDCAuthenticator, oidc_a
 from inference_gateway_tpu.api.middlewares.logger import logger_middleware
 from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware, tracing_middleware
 from inference_gateway_tpu.api.routes import RouterImpl, Response
-from inference_gateway_tpu.cluster.shm import ClusterSegment, WorkerSlab
+from inference_gateway_tpu.cluster.shm import ClusterSegment, PeerHealthView, WorkerSlab
 from inference_gateway_tpu.cluster.tenancy import TenantPolicy
 from inference_gateway_tpu.cluster.worker import WorkerRuntime
 from inference_gateway_tpu.config import Config
@@ -173,11 +173,16 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     # nothing below changes: no segment, no mirror writes, no REUSEPORT.
     cluster_segment = None
     cluster_slab = None
+    peer_health = None
     if cfg.cluster.segment_name and cfg.cluster.worker_index >= 0:
         cluster_segment = ClusterSegment.attach(
             cfg.cluster.segment_name, workers=max(1, cfg.cluster.workers),
             tenant_slots=cfg.cluster.tenant_slots)
         cluster_slab = cluster_segment.slab(cfg.cluster.worker_index)
+        # Cached peer-verdict merge for the routing hot path — refreshed
+        # by the WorkerRuntime on the heartbeat interval, read per
+        # candidate as a set lookup (never a per-request blob decode).
+        peer_health = PeerHealthView(cluster_segment, cfg.cluster.worker_index)
         logger.info("cluster worker attached", "segment", cfg.cluster.segment_name,
                     "worker", cfg.cluster.worker_index,
                     "generation", cluster_slab.generation)
@@ -331,16 +336,17 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             otel=otel, logger=logger, clock=resilience.clock)
         resilience.migrator = migrator
 
-        def fleet_health(d, _h=health, _m=migrator, _seg=cluster_segment,
-                         _idx=cfg.cluster.worker_index):
+        def fleet_health(d, _h=health, _m=migrator, _peers=peer_health):
             if not _h(d) or _m.draining(d.provider, d.model):
                 return False
             # Cross-worker health merge (ISSUE 16): peers' published
             # probe verdicts can only REMOVE a candidate — one confused
             # worker can never readmit a replica the rest of the cluster
             # has condemned, and a worker with no local evidence still
-            # avoids a replica its peers know is dead.
-            if _seg is not None and _seg.peer_ejected(_idx, d.provider, d.model):
+            # avoids a replica its peers know is dead. The view is a
+            # heartbeat-interval cache: a set lookup here, not a
+            # per-candidate decode of every peer's blob.
+            if _peers is not None and _peers.ejected(d.provider, d.model):
                 return False
             return True
 
@@ -412,7 +418,11 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         authenticator = OIDCAuthenticator(
             cfg.auth.oidc_issuer, cfg.auth.oidc_client_id, client, logger=logger
         )
-    middlewares.append(oidc_auth_middleware(authenticator, logger))
+    # The auth middleware feeds the tenancy policy each verified token's
+    # subject, so the pre-auth tenant derivation can use sub buckets
+    # without ever trusting an unverified claim (forged subs bucket by
+    # token digest instead — they can never burn a victim's quota).
+    middlewares.append(oidc_auth_middleware(authenticator, logger, tenancy=tenancy))
     if mcp_client is not None and mcp_agent is not None:
         from inference_gateway_tpu.api.middlewares.mcp import mcp_middleware
 
@@ -446,6 +456,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         # prober/breaker verdicts for peers to read-merge.
         cluster_runtime = WorkerRuntime(
             cluster_slab, prober=prober, breakers=resilience.breakers,
+            peer_health=peer_health,
             interval=cfg.cluster.heartbeat_interval, clock=resilience.clock,
             logger=logger)
 

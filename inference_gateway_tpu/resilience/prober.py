@@ -273,8 +273,8 @@ class HealthProber:
     def verdicts(self) -> dict[str, bool]:
         """Probe verdicts keyed ``provider/model`` → ejected, the shape
         the cluster worker publishes into its shared-memory verdict blob
-        (``ClusterSegment.peer_ejected`` read-merges them across
-        workers so the fleet agrees on replica health)."""
+        (peers read-merge them through ``PeerHealthView`` so the fleet
+        agrees on replica health)."""
         with self._lock:
             return {f"{p}/{m}": bool(st["ejected"])
                     for (p, m), st in self._state.items()}

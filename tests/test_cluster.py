@@ -40,6 +40,7 @@ import pytest
 from inference_gateway_tpu.cluster.shm import (
     GATEWAY_COUNTERS,
     ClusterSegment,
+    PeerHealthView,
     tenant_slot,
 )
 from inference_gateway_tpu.cluster.supervisor import Supervisor, gateway_spawn
@@ -186,6 +187,42 @@ def test_peer_ejected_quorum_only_removes_candidates():
         seg.close(unlink=True)
 
 
+def test_peer_health_view_is_a_refreshed_cache():
+    """The routing hot path reads peer verdicts through PeerHealthView:
+    a set lookup against the last refresh() — blob decodes happen only
+    on the heartbeat-interval refresh, and the merged answer matches
+    the one-shot peer_ejected() quorum exactly."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=3)
+    try:
+        for i in range(3):
+            seg.begin_generation(i, i + 1)
+        view = PeerHealthView(seg, 0)
+        # Before any refresh the view is empty (nothing ejected).
+        assert view.ejected("tpu", "m") is False
+        seg.slab(1).publish({"probes": {"tpu/m": True, "tpu/ok": False}})
+        # Published but not yet refreshed: the cache still answers old.
+        assert view.ejected("tpu", "m") is False
+        view.refresh()
+        assert view.ejected("tpu", "m") is True
+        assert view.ejected("tpu", "ok") is False
+        assert view.ejected("tpu", "missing") is False
+        # Quorum flip: a healthy outvote readmits on the next refresh.
+        seg.slab(2).publish({"probes": {"tpu/m": False}})
+        seg.slab(0).publish({"probes": {"tpu/m": False}})  # own vote ignored
+        assert view.ejected("tpu", "m") is True  # cached until refresh
+        view.refresh()
+        # 1 eject vs 1 healthy peer is still "at least half" -> ejected;
+        # matches the one-shot merge bit for bit.
+        assert view.ejected("tpu", "m") == seg.peer_ejected(0, "tpu", "m")
+        # A reaped peer's votes vanish from the next refresh.
+        seg.reap(1)
+        view.refresh()
+        assert view.ejected("tpu", "m") is False
+    finally:
+        seg.close(unlink=True)
+
+
 def test_render_prometheus_and_status_merge():
     name = _name()
     seg = ClusterSegment.create(name, workers=2)
@@ -240,19 +277,82 @@ def test_derive_tenant_sources():
     assert t.startswith("key:") and "secret" not in t
     assert t == derive_tenant(_headers(x_api_key="sk-secret-1"), policy)
     assert t != derive_tenant(_headers(x_api_key="sk-secret-2"), policy)
-    # Bearer JWT: the (unverified) subject claim — it only picks a
-    # fairness bucket, authn stays the auth middleware's job.
-    assert derive_tenant(
-        _headers(authorization=f"Bearer {_jwt('team-a')}"), policy) == "sub:team-a"
+    # An UNVERIFIED bearer JWT buckets by token digest, NOT its claims
+    # — a forged sub must never pick a victim's fairness bucket.
+    unverified = derive_tenant(
+        _headers(authorization=f"Bearer {_jwt('team-a')}"), policy)
+    assert unverified.startswith("key:")
     # Opaque bearer tokens hash like keys.
     opaque = derive_tenant(_headers(authorization="Bearer not.a.jwt!"), policy)
     assert opaque.startswith("key:")
     # Nothing at all -> the configured anonymous bucket.
     assert derive_tenant(_headers(), policy) == "anonymous"
-    # Hostile subjects are sanitized into the label charset.
+
+
+def test_verified_bearer_maps_to_subject_forged_sub_cannot():
+    """The targeted-impersonation regression: only a token the auth
+    middleware has VERIFIED maps to its sub bucket. A forged token
+    carrying the same sub stays in its own digest bucket, so pre-auth
+    garbage can never drive load into a victim tenant's quota."""
+    policy = TenantPolicy(TenantConfig(enabled=True))
+    real = _jwt("team-a")
+    headers = _headers(authorization=f"Bearer {real}")
+    before = derive_tenant(headers, policy)
+    assert before.startswith("key:")
+    # The auth middleware verified the signature -> sub bucket sticks.
+    policy.record_verified(real, "team-a")
+    assert derive_tenant(headers, policy) == "sub:team-a"
+    # A DIFFERENT token forging the same sub is not the verified token:
+    # it buckets by its own digest, isolated from team-a's budget.
+    forged = _jwt("team-a") + "forged"
+    got = derive_tenant(_headers(authorization=f"Bearer {forged}"), policy)
+    assert got.startswith("key:") and got != "sub:team-a"
+    # Hostile verified subjects are sanitized into the label charset.
     hostile = _jwt("a b\nc{evil}")
+    policy.record_verified(hostile, "a b\nc{evil}")
     weird = derive_tenant(_headers(authorization=f"Bearer {hostile}"), policy)
+    assert weird.startswith("sub:")
     assert "\n" not in weird and "{" not in weird
+    # Empty subs are never recorded.
+    policy.record_verified("tok", None)
+    assert policy.verified_subject("tok") is None
+
+
+async def test_auth_middleware_feeds_verified_subjects_to_tenancy():
+    """The wiring behind the sub buckets: a token that passes the auth
+    middleware's signature verification is recorded into the tenant
+    policy; a rejected token never is."""
+    from inference_gateway_tpu.api.middlewares.auth import JWTError, oidc_auth_middleware
+    from inference_gateway_tpu.netio.server import Request, Response
+
+    policy = TenantPolicy(TenantConfig(enabled=True))
+    good, bad = _jwt("team-a"), _jwt("mallory-as-team-a")
+
+    class FakeAuthenticator:
+        async def verify(self, token):
+            if token == good:
+                return {"sub": "team-a"}
+            raise JWTError("signature verification failed")
+
+    mw = oidc_auth_middleware(FakeAuthenticator(), tenancy=policy)
+
+    async def handler(req):
+        return Response.json({})
+
+    def request(token):
+        return Request(method="POST", path="/v1/chat/completions", query={},
+                       headers=_headers(authorization=f"Bearer {token}"),
+                       body=b"")
+
+    resp = await mw(request(good), handler)
+    assert resp.status == 200
+    assert derive_tenant(_headers(authorization=f"Bearer {good}"),
+                         policy) == "sub:team-a"
+    resp = await mw(request(bad), handler)
+    assert resp.status == 401
+    assert policy.verified_subject(bad) is None
+    assert derive_tenant(_headers(authorization=f"Bearer {bad}"),
+                         policy).startswith("key:")
 
 
 def test_tenant_policy_weights_and_quota():
@@ -483,6 +583,104 @@ def test_supervisor_replaces_wedged_worker_via_heartbeat_staleness():
     finally:
         _stop_supervisor(sup)
         seg.close(unlink=True)
+
+
+def test_boot_grace_tolerates_slow_first_heartbeat():
+    """A worker whose first beat lands after heartbeat_timeout (slow
+    build_gateway / MCP init / listener bind) must NOT be crash-looped:
+    boots get their own (larger) deadline, and staleness only arms once
+    the first real beat has been observed."""
+    clock = VirtualClock()
+    name = _name()
+    seg = ClusterSegment.create(name, workers=1)
+
+    def spawn(index: int, generation: int):
+        # A process that stays alive but never attaches or beats — the
+        # slab holds only the supervisor's spawn stamp.
+        return subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(300)"])
+
+    sup = Supervisor(seg, spawn, heartbeat_timeout=1.0, boot_timeout=30.0,
+                     check_interval=0.05, clock=clock)
+    try:
+        sup.start()
+        # Way past the steady-state heartbeat timeout but inside the
+        # boot window: not stale (pre-fix this was judged wedged and
+        # respawned — a permanent crash loop for any slow boot).
+        clock.advance(10.0)
+        assert sup.check_once() == []
+        # The first beat arms staleness, measured from the beat.
+        seg.slab(0).beat(clock.now())
+        clock.advance(0.5)
+        assert sup.check_once() == []
+        clock.advance(1.0)
+        assert sup.check_once() == [0]  # genuinely stale -> replaced
+        # A replacement that never beats at all is still caught — at
+        # the boot deadline instead of the heartbeat one.
+        clock.advance(29.0)
+        assert sup.check_once() == []
+        clock.advance(2.0)
+        assert sup.check_once() == [0]
+    finally:
+        _stop_supervisor(sup)
+        seg.close(unlink=True)
+
+
+def test_rolling_restart_does_not_race_the_monitor():
+    """The orchestrated-restart race: with the monitor task running, a
+    rolling restart must be the ONLY thing respawning the slots it
+    cycles. Pre-fix, the SIGTERM'd exit woke check_once via SIGCHLD,
+    which reaped + respawned first; rolling_restart then zeroed the
+    LIVE replacement's slab and spawned a second, unsupervised process
+    writing the same single-writer slab."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    sup = Supervisor(seg, _idle_spawn(name, 2), heartbeat_timeout=0,
+                     check_interval=0.01, term_grace=15.0)
+
+    async def scenario():
+        sup.start()
+        monitor = asyncio.get_running_loop().create_task(sup.run())
+        assert await _await(
+            lambda: all(seg.heartbeat(i) > sup.workers[i].started
+                        for i in sup.workers))
+        old = {i: sup.workers[i].proc for i in sup.workers}
+        await sup.rolling_restart()
+        # The monitor never respawned anything itself -> no double
+        # spawn, no orphaned second writer: exactly one replacement per
+        # slot (initial generations 1,2; replacements 3,4).
+        assert sup.respawns == 0
+        assert sup._next_generation == 5
+        for i, proc in old.items():
+            assert proc.poll() is not None  # old worker fully gone
+            fresh = sup.workers[i]
+            assert fresh.proc.pid != proc.pid
+            assert fresh.proc.poll() is None  # exactly one live replacement
+            assert seg.generation(i) == fresh.generation
+        await sup.stop()
+        monitor.cancel()
+
+    asyncio.run(scenario())
+    seg.close(unlink=True)
+
+
+def test_overlapping_rolling_restarts_coalesce():
+    """Rapid SIGHUPs must not stack rolling restarts over the same
+    slots: a second invocation while one is in progress is a no-op."""
+    name = _name()
+    seg = ClusterSegment.create(name, workers=2)
+    sup = Supervisor(seg, _idle_spawn(name, 2), heartbeat_timeout=0,
+                     check_interval=0.05, term_grace=15.0)
+
+    async def scenario():
+        sup.start()
+        await asyncio.gather(sup.rolling_restart(), sup.rolling_restart())
+        assert sup._next_generation == 5  # each slot restarted exactly once
+        assert not sup.rolling
+        await sup.stop()
+
+    asyncio.run(scenario())
+    seg.close(unlink=True)
 
 
 _LEAK_CHILD = textwrap.dedent("""
