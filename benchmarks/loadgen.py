@@ -1,0 +1,194 @@
+"""External SSE load-generator rig (ISSUE 18).
+
+`bench_relay_saturation_cluster`'s round-4 caveat was that the load
+generator and the fake upstream shared the one parent interpreter, so
+on a small host the parent saturated before any gateway worker did and
+the fleet scaling curve flattened into a client-bound plateau. This
+module moves the client side out of the parent: each LoadGen client is
+a REAL subprocess with its own interpreter, event loop, and scheduler
+slice, opening `streams_per_client` SSE streams against the target and
+counting `data:` frames locally.
+
+Coordination is a line protocol over each child's stdin/stdout:
+
+    child  -> "READY <established>"    every stream delivered a first
+                                       chunk (or the 30 s barrier expired)
+    parent -> "MARK\\n"                child samples its frame counter
+    child  -> "SAMPLE <total> <t_mono>"
+    parent -> "STOP\\n"                child cancels streams and exits
+
+Two MARKs bracket the measured window. The sustained rate is the summed
+per-client chunk delta over the MEAN per-client elapsed time — each
+child timestamps its own samples with its local monotonic clock, so
+parent scheduling jitter between the MARK writes cannot bias the rate.
+
+Standalone use against any SSE endpoint:
+
+    python benchmarks/loadgen.py http://127.0.0.1:8080/v1/chat/completions \
+        --streams 128 --clients 4 --warmup 0.7 --window 1.5
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[1]
+
+DEFAULT_BODY = json.dumps({
+    "model": "ollama/m", "stream": True,
+    "messages": [{"role": "user", "content": "x"}],
+})
+
+
+class LoadGen:
+    """Parent-side handle on a fleet of client subprocesses."""
+
+    def __init__(self, url: str, body: str = DEFAULT_BODY, *,
+                 clients: int = 4, streams_per_client: int = 8,
+                 ready_timeout: float = 60.0) -> None:
+        self.url = url
+        self.body = body
+        self.clients = clients
+        self.streams_per_client = streams_per_client
+        self.ready_timeout = ready_timeout
+        self._procs: list[asyncio.subprocess.Process] = []
+
+    @property
+    def streams(self) -> int:
+        return self.clients * self.streams_per_client
+
+    async def start(self) -> int:
+        """Spawn the clients and wait for every READY line; returns the
+        number of streams that actually delivered a first chunk."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(_REPO_ROOT) + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        for _ in range(self.clients):
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, str(Path(__file__).resolve()), "--client",
+                self.url, str(self.streams_per_client), self.body,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE, env=env)
+            self._procs.append(proc)
+        established = 0
+        for proc in self._procs:
+            line = await asyncio.wait_for(
+                proc.stdout.readline(), self.ready_timeout)
+            parts = line.split()
+            if len(parts) != 2 or parts[0] != b"READY":
+                raise RuntimeError(f"loadgen client said {line!r}, expected READY")
+            established += int(parts[1])
+        return established
+
+    async def mark(self) -> list[tuple[int, float]]:
+        """One (total_chunks, t_monotonic) sample per client."""
+        for proc in self._procs:
+            proc.stdin.write(b"MARK\n")
+            await proc.stdin.drain()
+        samples = []
+        for proc in self._procs:
+            line = await asyncio.wait_for(proc.stdout.readline(), 10.0)
+            tag, total, t = line.split()
+            if tag != b"SAMPLE":
+                raise RuntimeError(f"loadgen client said {line!r}, expected SAMPLE")
+            samples.append((int(total), float(t)))
+        return samples
+
+    async def measure(self, warmup: float, window: float) -> dict:
+        """Warm up, then bracket `window` seconds with MARK samples."""
+        await asyncio.sleep(warmup)
+        before = await self.mark()
+        await asyncio.sleep(window)
+        after = await self.mark()
+        chunks = sum(a - b for (a, _), (b, _) in zip(after, before))
+        elapsed = sum(ta - tb for (_, ta), (_, tb) in zip(after, before)) / len(after)
+        return {
+            "chunks": chunks,
+            "elapsed_s": round(elapsed, 4),
+            "chunks_per_sec": round(chunks / elapsed) if elapsed else 0,
+        }
+
+    async def stop(self) -> None:
+        for proc in self._procs:
+            try:
+                proc.stdin.write(b"STOP\n")
+                await proc.stdin.drain()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        for proc in self._procs:
+            try:
+                await asyncio.wait_for(proc.wait(), 10.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+        self._procs.clear()
+
+
+async def _client_main(url: str, streams: int, body: str) -> None:
+    """Child process: open the streams, count frames, obey stdin."""
+    sys.path.insert(0, str(_REPO_ROOT))
+    from inference_gateway_tpu.netio.client import HTTPClient
+
+    payload = body.encode()
+    counts = [0] * streams
+
+    async def one(i: int) -> None:
+        client = HTTPClient()
+        resp = await client.post(url, payload, stream=True)
+        async for line in resp.iter_lines():
+            if line.startswith(b"data:"):
+                counts[i] += 1
+
+    tasks = [asyncio.create_task(one(i)) for i in range(streams)]
+    # Same establishment barrier as the in-process bench: the parent's
+    # window opens only once every stream is delivering.
+    deadline = time.monotonic() + 30.0
+    while not all(counts) and time.monotonic() < deadline:
+        await asyncio.sleep(0.02)
+    print(f"READY {sum(1 for c in counts if c)}", flush=True)
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+    while True:
+        line = (await reader.readline()).strip()
+        if line == b"MARK":
+            print(f"SAMPLE {sum(counts)} {time.monotonic():.6f}", flush=True)
+        else:  # STOP or parent died (EOF)
+            break
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def _standalone(argv: list[str]) -> None:
+    def opt(name: str, default: str) -> str:
+        return argv[argv.index(name) + 1] if name in argv else default
+
+    url = argv[0]
+    gen = LoadGen(url, opt("--body", DEFAULT_BODY),
+                  clients=int(opt("--clients", "4")),
+                  streams_per_client=max(1, int(opt("--streams", "32"))
+                                         // int(opt("--clients", "4"))))
+    established = await gen.start()
+    res = await gen.measure(float(opt("--warmup", "0.7")),
+                            float(opt("--window", "1.5")))
+    await gen.stop()
+    print(json.dumps({"url": url, "streams": gen.streams,
+                      "established": established, **res}))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 5 and sys.argv[1] == "--client":
+        asyncio.run(_client_main(
+            sys.argv[2], int(sys.argv[3]), sys.argv[4]))
+    elif len(sys.argv) >= 2:
+        asyncio.run(_standalone(sys.argv[1:]))
+    else:
+        print(__doc__)

@@ -74,11 +74,18 @@ def extract_tool_calls(message: dict[str, Any]) -> list[str]:
     ]
 
 
-def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=None):
+def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=None,
+                         journeys=None, slo=None):
     """``slow_log`` (otel/profiling.SlowRequestLog) makes this middleware
     the gateway-edge forensics feeder: it already measures TTFC, total
     duration, and token rate for every inference request, so breaches are
-    judged here — independent of whether the access log is enabled."""
+    judged here — independent of whether the access log is enabled.
+
+    ``journeys`` (otel/journey.JourneyRecorder) and ``slo``
+    (otel/slo.SloTracker) ride the same measurements (ISSUE 18): the
+    admitted/first_byte/finished journey events and the
+    availability/TTFT/TPOT SLI observations reuse the timestamps this
+    middleware already takes — no extra clock reads on the hot path."""
 
     async def middleware(req: Request, nxt: Handler) -> Response:
         if req.method != "POST" or req.path not in INFERENCE_PATHS:
@@ -92,9 +99,21 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
             event["model"] = model
             if team:
                 event["team"] = team
+        span = req.ctx.get("span")
+        trace_id = span.trace_id if span is not None else None
+        tenant = (req.ctx.get("tenant")
+                  or (event.get("tenant") if event is not None else None)
+                  or team or None)
+        # The pool key for SLO purposes is the requested deployment class
+        # — which replica actually served is journey detail, not an SLI
+        # scope (a tenant's SLO should not fork per failover hop).
+        pool = f"{provider}/{model}" if provider and model else None
+        if journeys is not None:
+            journeys.record(trace_id, "admitted", path=req.path,
+                            provider=provider or None, model=model or None,
+                            tenant=tenant)
         start = time.perf_counter()
         resp = await nxt(req)
-        span = req.ctx.get("span")
         if span is not None:
             span.set_attribute("gen_ai.provider.name", provider)
             span.set_attribute("gen_ai.request.model", model)
@@ -127,6 +146,8 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
                 t_first: float | None = None
                 t_last: float | None = None
                 n_gaps = 0
+                completed = False
+                client_closed = False
                 try:
                     async for chunk in inner:
                         now = time.perf_counter()
@@ -135,6 +156,10 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
                                 t_first = now
                                 otel.record_time_to_first_chunk(
                                     source, team, provider, model, now - start)
+                                if journeys is not None:
+                                    journeys.record(
+                                        trace_id, "first_byte",
+                                        ttfc_ms=round((now - start) * 1000, 3))
                             elif t_last is not None and not chunk.startswith(b"data: [DONE]"):
                                 # Skip the FIRST gap: for OpenAI-style
                                 # streams chunk 1 is the role preamble,
@@ -161,6 +186,13 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
                             t_last = now
                             ring.append(chunk)
                         yield chunk
+                    completed = True
+                except GeneratorExit:
+                    # The CLIENT walked away mid-stream — the gateway
+                    # delivered everything it was asked for, so this is
+                    # not an availability breach.
+                    client_closed = True
+                    raise
                 finally:
                     if event is not None and t_first is not None:
                         event["ttfc_ms"] = round((t_first - start) * 1000, 3)
@@ -227,6 +259,29 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
                             "duration_ms": round((time.perf_counter() - start) * 1000, 3),
                             "tokens_per_sec": rate,
                         })
+                    ok = (completed or client_closed) and resp.status < 500
+                    if journeys is not None:
+                        # The terminal journey event carries the billing
+                        # evidence: once-only by construction — a relay
+                        # that dies with its worker never reaches this
+                        # finally, and the continuation stream that
+                        # finishes the work bills exactly once, here.
+                        journeys.record(
+                            trace_id, "finished", status=resp.status, ok=ok,
+                            input_tokens=usage[0] if usage else None,
+                            output_tokens=usage[1] if usage else None,
+                            duration_ms=round(
+                                (time.perf_counter() - start) * 1000, 3))
+                    if slo is not None:
+                        tpot = None
+                        if (usage and usage[1] > 1 and t_first is not None
+                                and t_last is not None and t_last > t_first):
+                            tpot = (t_last - t_first) / (usage[1] - 1)
+                        slo.observe(
+                            tenant=tenant, pool=pool, ok=ok,
+                            ttft=(t_first - start) if t_first is not None
+                            else None,
+                            tpot=tpot)
 
             resp.chunks = observed()
             return resp
@@ -257,6 +312,62 @@ def telemetry_middleware(otel, logger=None, source: str = "gateway", slow_log=No
                 "output_tokens": usage[1] if usage else None,
                 "duration_ms": round((time.perf_counter() - start) * 1000, 3),
             })
+        if journeys is not None:
+            journeys.record(
+                trace_id, "finished", status=resp.status,
+                ok=resp.status < 500,
+                input_tokens=usage[0] if usage else None,
+                output_tokens=usage[1] if usage else None,
+                duration_ms=round((time.perf_counter() - start) * 1000, 3))
+        if slo is not None:
+            slo.observe(tenant=tenant, pool=pool, ok=resp.status < 500)
+        return resp
+
+    return middleware
+
+
+def _traceparent_trace_id(header: str | None) -> str | None:
+    """The 32-hex trace id out of a W3C traceparent header, or None —
+    the only parsing a shed request gets (it never reaches the tracer)."""
+    if not header:
+        return None
+    parts = header.split("-")
+    if len(parts) >= 2 and len(parts[1]) == 32:
+        try:
+            int(parts[1], 16)
+        except ValueError:
+            return None
+        return parts[1]
+    return None
+
+
+def journey_shed_middleware(journeys, slo=None):
+    """Shed-visibility shim (ISSUE 18): admission rejects OUTSIDE the
+    tracing/telemetry middlewares (a shed request costs no span), so a
+    journey's ``shed`` event is recorded here — between the access log
+    and admission — keyed by the CLIENT's inbound traceparent. A caller
+    that propagates one trace id across a retry therefore sees its
+    rejections and its eventual service as one journey.
+
+    429s (the tenant's own quota) charge no availability budget; 503
+    sheds are gateway-caused unavailability and do."""
+
+    async def middleware(req: Request, nxt: Handler) -> Response:
+        resp = await nxt(req)
+        if req.method != "POST" or req.path not in INFERENCE_PATHS:
+            return resp
+        event = req.ctx.get("wide_event")
+        shed_reason = event.get("shed") if event is not None else None
+        if shed_reason is None and (event is not None
+                                    or resp.status not in (429, 503)):
+            return resp
+        trace_id = _traceparent_trace_id(req.headers.get("traceparent"))
+        tenant = (req.ctx.get("tenant")
+                  or (event.get("tenant") if event is not None else None))
+        journeys.record(trace_id, "shed", status=resp.status,
+                        reason=shed_reason, tenant=tenant)
+        if slo is not None and tenant and resp.status != 429:
+            slo.observe(tenant=tenant, ok=False)
         return resp
 
     return middleware

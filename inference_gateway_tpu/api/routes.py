@@ -118,6 +118,7 @@ class RouterImpl:
         resilience: Resilience | None = None,
         overload=None,
         fleet_urls: dict[str, set[str]] | None = None,
+        journeys=None,
     ) -> None:
         self.cfg = cfg
         self.registry = registry
@@ -138,6 +139,13 @@ class RouterImpl:
         # take — sourced from the operator's own pools file, so the hop
         # can never be steered to an arbitrary host.
         self.fleet_urls = fleet_urls or {}
+        # Journey recorder (ISSUE 18): routed/spliced lifecycle events
+        # are recorded here, where the serving candidate is known.
+        self.journeys = journeys
+
+    def _trace_id(self, req: Request) -> str | None:
+        span = req.ctx.get("span")
+        return span.trace_id if span is not None else None
 
     # -- wiring --------------------------------------------------------
     def build_router(self) -> Router:
@@ -337,10 +345,24 @@ class RouterImpl:
                     request_for(cand), ctx, timeout=b.timeout())
 
             continuation = self._make_continuation(candidates, request_for, ctx)
+            trace_id = self._trace_id(req)
+            if self.journeys is not None and isinstance(
+                    body.get("continuation"), dict):
+                # The CLIENT re-issued with a generated-so-far prefix
+                # (PR 9 contract) — its previous stream died with a
+                # worker. Under a propagated traceparent this splice
+                # lands in the SAME journey the dead worker's shm slots
+                # still hold, so the cross-worker chain reads whole.
+                cont = body["continuation"]
+                self.journeys.record(
+                    trace_id, "spliced",
+                    continuation_id=cont.get("id"),
+                    prefix_chars=len(cont.get("text") or ""))
             try:
                 stream, served = await self.resilience.execute_streaming(
                     candidates, call, budget=budget, alias=alias,
-                    event=event, continuation=continuation)
+                    event=event, continuation=continuation,
+                    trace_id=trace_id)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
             except BudgetExceededError:
@@ -352,6 +374,10 @@ class RouterImpl:
             if event is not None:
                 event["served_provider"] = served.provider
                 event["served_model"] = served.model
+            if self.journeys is not None:
+                self.journeys.record(
+                    trace_id, "routed", alias=alias or None,
+                    provider=served.provider, model=served.model)
             resp = StreamingResponse.sse(stream)
             if alias:
                 resp.headers.set("X-Selected-Provider", served.provider)
@@ -380,6 +406,10 @@ class RouterImpl:
         if event is not None:
             event["served_provider"] = served.provider
             event["served_model"] = served.model
+        if self.journeys is not None:
+            self.journeys.record(
+                self._trace_id(req), "routed", alias=alias or None,
+                provider=served.provider, model=served.model)
         resp = Response.json(result)
         if alias:
             resp.headers.set("X-Selected-Provider", served.provider)
@@ -558,10 +588,12 @@ class RouterImpl:
             # underlying chat-chunk stream, BEFORE the Responses-event
             # translation consumes it, so the splice logic is shared.
             continuation = self._make_continuation(candidates, chat_req_for, ctx)
+            trace_id = self._trace_id(req)
             try:
                 stream, _served = await self.resilience.execute_streaming(
                     candidates, call, budget=budget, alias=alias,
-                    event=event, continuation=continuation)
+                    event=event, continuation=continuation,
+                    trace_id=trace_id)
             except UpstreamUnavailableError as e:
                 return error_json(str(e), 503)
             except BudgetExceededError:
@@ -570,6 +602,10 @@ class RouterImpl:
                 return error_json(e.message, e.status_code)
             except HTTPClientError as e:
                 return error_json(str(e), 502)
+            if self.journeys is not None:
+                self.journeys.record(
+                    trace_id, "routed", alias=alias or None,
+                    provider=_served.provider, model=_served.model)
             return StreamingResponse.sse(stream_response_events(stream, body))
 
         async def call(cand: _Candidate, b) -> Any:

@@ -25,6 +25,15 @@ check), so no locks exist anywhere in the segment:
   skips it. ``reap()`` zeroes the generation FIRST, then the cells, so
   a crashed worker's phantom in-flight tickets, quota holds, and gauge
   contributions vanish from every aggregate in one store.
+- **journey slots** (ISSUE 18) — a ring of individually seqlocked JSON
+  records per slab where the worker publishes stream-journey lifecycles
+  keyed by trace id. Unlike every other region, journey slots are
+  EXCLUDED from ``reap()``/``begin_generation()`` zeroing and readers
+  scan them on dead slots too: a journey must outlive the worker that
+  recorded it, or killing a worker mid-stream would erase exactly the
+  evidence (`admitted`, `routed`, `first_byte` hops) the post-mortem
+  needs. A respawned worker simply overwrites slots as its own ring
+  advances.
 """
 
 from __future__ import annotations
@@ -36,12 +45,13 @@ from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Sequence
 
 _MAGIC = 0x49475443  # "IGTC"
-_VERSION = 1
+_VERSION = 2  # v2: per-slab journey slot region (ISSUE 18)
 
 # magic u32, version u32, workers u32, counters u32, tenant_slots u32,
-# blob_cap u32 — attach() validates every field against the caller's
-# schema so two builds can never silently disagree about the layout.
-_HEADER = struct.Struct("<IIIIII")
+# blob_cap u32, journey_slots u32, journey_slot_bytes u32 — attach()
+# validates every field against the caller's schema so two builds can
+# never silently disagree about the layout.
+_HEADER = struct.Struct("<IIIIIIII")
 # Per-slab head: generation u64, pid u64, heartbeat f64 (CLOCK_MONOTONIC
 # seconds — system-wide on Linux, so the supervisor and workers share
 # the timebase without wall-clock jumps faking liveness).
@@ -64,6 +74,12 @@ GATEWAY_COUNTERS: tuple[str, ...] = (
 
 DEFAULT_TENANT_SLOTS = 64
 DEFAULT_BLOB_CAP = 16384
+# Journey ring defaults: slots bound how many concurrent/recent stream
+# journeys a worker retains cluster-visibly; slot bytes bound one
+# journey's serialized event chain (the recorder drops middle events
+# before ever overflowing a slot).
+DEFAULT_JOURNEY_SLOTS = 64
+DEFAULT_JOURNEY_SLOT_BYTES = 4096
 
 
 def tenant_slot(tenant: str, slots: int) -> int:
@@ -82,18 +98,26 @@ class ClusterSegment:
 
     def __init__(self, shm: shared_memory.SharedMemory, workers: int,
                  counters: tuple[str, ...], tenant_slots: int, blob_cap: int,
-                 owner: bool) -> None:
+                 owner: bool, journey_slots: int = DEFAULT_JOURNEY_SLOTS,
+                 journey_slot_bytes: int = DEFAULT_JOURNEY_SLOT_BYTES) -> None:
         self._shm = shm
         self.workers = workers
         self.counters = counters
         self.tenant_slots = tenant_slots
         self.blob_cap = blob_cap
+        self.journey_slots = journey_slots
+        self.journey_slot_bytes = journey_slot_bytes
         self._owner = owner
         self._index = {name: i for i, name in enumerate(counters)}
         self._counters_off = _SLAB_HEAD.size
         self._tenants_off = self._counters_off + 8 * len(counters)
         self._blob_off = self._tenants_off + 8 * tenant_slots
-        self.slab_size = _align(self._blob_off + _BLOB_HEAD.size + blob_cap)
+        # Journey region AFTER the verdict blob; its offset doubles as
+        # the reap/begin_generation zeroing bound (journeys survive).
+        self._journey_off = _align(self._blob_off + _BLOB_HEAD.size + blob_cap, 8)
+        self._journey_stride = _align(_BLOB_HEAD.size + journey_slot_bytes, 8)
+        self.slab_size = _align(
+            self._journey_off + journey_slots * self._journey_stride)
         self._base = _align(_HEADER.size)
 
     # -- lifecycle -------------------------------------------------------
@@ -101,22 +125,31 @@ class ClusterSegment:
     def create(cls, name: str, workers: int,
                counters: Sequence[str] = GATEWAY_COUNTERS,
                tenant_slots: int = DEFAULT_TENANT_SLOTS,
-               blob_cap: int = DEFAULT_BLOB_CAP) -> "ClusterSegment":
+               blob_cap: int = DEFAULT_BLOB_CAP,
+               journey_slots: int = DEFAULT_JOURNEY_SLOTS,
+               journey_slot_bytes: int = DEFAULT_JOURNEY_SLOT_BYTES) -> "ClusterSegment":
         counters = tuple(counters)
-        probe = cls(None, workers, counters, tenant_slots, blob_cap, owner=True)  # type: ignore[arg-type]
+        probe = cls(None, workers, counters, tenant_slots, blob_cap, owner=True,  # type: ignore[arg-type]
+                    journey_slots=journey_slots,
+                    journey_slot_bytes=journey_slot_bytes)
         size = probe._base + workers * probe.slab_size
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
-        seg = cls(shm, workers, counters, tenant_slots, blob_cap, owner=True)
+        seg = cls(shm, workers, counters, tenant_slots, blob_cap, owner=True,
+                  journey_slots=journey_slots,
+                  journey_slot_bytes=journey_slot_bytes)
         shm.buf[:size] = b"\x00" * size
         _HEADER.pack_into(shm.buf, 0, _MAGIC, _VERSION, workers,
-                          len(counters), tenant_slots, blob_cap)
+                          len(counters), tenant_slots, blob_cap,
+                          journey_slots, journey_slot_bytes)
         return seg
 
     @classmethod
     def attach(cls, name: str, workers: int,
                counters: Sequence[str] = GATEWAY_COUNTERS,
                tenant_slots: int = DEFAULT_TENANT_SLOTS,
-               blob_cap: int = DEFAULT_BLOB_CAP) -> "ClusterSegment":
+               blob_cap: int = DEFAULT_BLOB_CAP,
+               journey_slots: int = DEFAULT_JOURNEY_SLOTS,
+               journey_slot_bytes: int = DEFAULT_JOURNEY_SLOT_BYTES) -> "ClusterSegment":
         counters = tuple(counters)
         shm = shared_memory.SharedMemory(name=name, create=False)
         # CPython's per-process resource tracker registers every attach
@@ -130,15 +163,18 @@ class ClusterSegment:
                                         "shared_memory")
         except Exception:
             pass
-        magic, version, w, c, t, b = _HEADER.unpack_from(shm.buf, 0)
-        if (magic, version, w, c, t, b) != (
-                _MAGIC, _VERSION, workers, len(counters), tenant_slots, blob_cap):
+        magic, version, w, c, t, b, js, jb = _HEADER.unpack_from(shm.buf, 0)
+        if (magic, version, w, c, t, b, js, jb) != (
+                _MAGIC, _VERSION, workers, len(counters), tenant_slots,
+                blob_cap, journey_slots, journey_slot_bytes):
             shm.close()
             raise ValueError(
                 f"cluster segment {name!r} layout mismatch: "
-                f"header={(magic, version, w, c, t, b)} expected="
-                f"{(_MAGIC, _VERSION, workers, len(counters), tenant_slots, blob_cap)}")
-        return cls(shm, workers, counters, tenant_slots, blob_cap, owner=False)
+                f"header={(magic, version, w, c, t, b, js, jb)} expected="
+                f"{(_MAGIC, _VERSION, workers, len(counters), tenant_slots, blob_cap, journey_slots, journey_slot_bytes)}")
+        return cls(shm, workers, counters, tenant_slots, blob_cap, owner=False,
+                   journey_slots=journey_slots,
+                   journey_slot_bytes=journey_slot_bytes)
 
     def close(self, unlink: bool = False) -> None:
         self._shm.close()
@@ -165,12 +201,14 @@ class ClusterSegment:
     # -- epoch management (supervisor-side) ------------------------------
     def begin_generation(self, i: int, generation: int, pid: int = 0,
                          now: float = 0.0) -> None:
-        """Zero the slab and stamp a fresh epoch. Called by the
-        supervisor BEFORE the worker is spawned (the slab has exactly
-        one writer at any instant: the supervisor while the slot is
-        dead, the worker while it is alive)."""
+        """Zero the slab (journey region excepted — journeys must
+        outlive their worker's death AND its replacement's boot) and
+        stamp a fresh epoch. Called by the supervisor BEFORE the worker
+        is spawned (the slab has exactly one writer at any instant: the
+        supervisor while the slot is dead, the worker while it is
+        alive)."""
         off = self._slab(i)
-        self._shm.buf[off:off + self.slab_size] = b"\x00" * self.slab_size
+        self._shm.buf[off:off + self._journey_off] = b"\x00" * self._journey_off
         _SLAB_HEAD.pack_into(self._shm.buf, off, generation, pid, now)
 
     def set_pid(self, i: int, pid: int) -> None:
@@ -182,13 +220,16 @@ class ClusterSegment:
         (readers stop counting the slab in the same store), then every
         cell is cleared. Returns the reclaimed counter values — the
         in-flight tickets and quota holds the crash would otherwise
-        have leaked forever (ISSUE 16 ticket-leak satellite)."""
+        have leaked forever (ISSUE 16 ticket-leak satellite). The
+        journey region is deliberately NOT cleared: a crashed worker's
+        stream journeys are exactly what the surviving fleet must still
+        answer ``/debug/journey`` from (ISSUE 18)."""
         off = self._slab(i)
         reclaimed = {name: self._read_counter(i, idx)
                      for name, idx in self._index.items()}
         struct.pack_into("<Q", self._shm.buf, off, 0)  # generation = 0
-        self._shm.buf[off + 8:off + self.slab_size] = \
-            b"\x00" * (self.slab_size - 8)
+        self._shm.buf[off + 8:off + self._journey_off] = \
+            b"\x00" * (self._journey_off - 8)
         return reclaimed
 
     # -- raw field access ------------------------------------------------
@@ -277,6 +318,70 @@ class ClusterSegment:
             if blob is not None:
                 out[i] = blob
         return out
+
+    # -- journey slots (seqlock, reap-surviving; ISSUE 18) ---------------
+    def _journey_slot_off(self, i: int, slot: int) -> int:
+        return (self._slab(i) + self._journey_off
+                + (slot % max(1, self.journey_slots)) * self._journey_stride)
+
+    def write_journey(self, i: int, slot: int, payload: dict[str, Any]) -> None:
+        """Publish one journey record into a slot of worker ``i``'s
+        ring. Single-writer (the owning worker), seqlocked exactly like
+        the verdict blob. An over-cap record degrades to a stub that
+        still carries the trace id — a lookup then reports the journey
+        existed but overflowed, instead of silently losing it."""
+        data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+        if len(data) > self.journey_slot_bytes:
+            stub = {"trace_id": payload.get("trace_id"), "overflow": True}
+            data = json.dumps(stub, separators=(",", ":")).encode("utf-8")
+            if len(data) > self.journey_slot_bytes:
+                data = b"{}"
+        off = self._journey_slot_off(i, slot)
+        seq, _n = _BLOB_HEAD.unpack_from(self._shm.buf, off)
+        _BLOB_HEAD.pack_into(self._shm.buf, off, seq + 1, len(data))  # odd: writing
+        start = off + _BLOB_HEAD.size
+        self._shm.buf[start:start + len(data)] = data
+        _BLOB_HEAD.pack_into(self._shm.buf, off, seq + 2, len(data))  # even: stable
+
+    def read_journey(self, i: int, slot: int) -> dict[str, Any] | None:
+        off = self._journey_slot_off(i, slot)
+        for _attempt in range(8):
+            seq0, n = _BLOB_HEAD.unpack_from(self._shm.buf, off)
+            if seq0 % 2 == 1:
+                continue  # mid-write: retry
+            if n == 0:
+                return None
+            start = off + _BLOB_HEAD.size
+            data = bytes(self._shm.buf[start:start + min(n, self.journey_slot_bytes)])
+            seq1, _ = _BLOB_HEAD.unpack_from(self._shm.buf, off)
+            if seq1 != seq0:
+                continue  # torn: a write landed mid-copy
+            try:
+                parsed = json.loads(data.decode("utf-8"))
+            except ValueError:
+                continue
+            return parsed if isinstance(parsed, dict) else None
+        return None
+
+    def journey_records(self) -> list[dict[str, Any]]:
+        """Every journey record in the segment — ALL worker slots, live
+        or dead (survival across the originating worker's death is the
+        point). Each record is annotated with the slab it came from."""
+        out: list[dict[str, Any]] = []
+        for i in range(self.workers):
+            for slot in range(self.journey_slots):
+                rec = self.read_journey(i, slot)
+                if rec is not None:
+                    rec.setdefault("worker", i)
+                    out.append(rec)
+        return out
+
+    def find_journeys(self, trace_id: str) -> list[dict[str, Any]]:
+        """All published journey records for one trace id, across every
+        worker slab (a stream that crossed a worker kill has one record
+        per worker that touched it)."""
+        return [rec for rec in self.journey_records()
+                if rec.get("trace_id") == trace_id]
 
     # -- health read-merge -----------------------------------------------
     def peer_ejected(self, self_index: int, provider: str, model: str) -> bool:
@@ -443,6 +548,9 @@ class WorkerSlab:
     def publish(self, payload: dict[str, Any]) -> None:
         self._seg.write_blob(self.index, payload)
 
+    def journey_write(self, slot: int, payload: dict[str, Any]) -> None:
+        self._seg.write_journey(self.index, slot, payload)
+
 
 def _hammer_main(argv: list[str]) -> int:
     """Child entry for ``tests/race_harness.hammer_shm_ledger``:
@@ -471,10 +579,39 @@ def _hammer_main(argv: list[str]) -> int:
     return 0
 
 
+def _journey_hammer_main(argv: list[str]) -> int:
+    """Child entry for ``tests/race_harness.hammer_shm_journeys``:
+    ``python -m inference_gateway_tpu.cluster.shm --hammer-journey
+    <name> <workers> <index> <iters>``. Spins seqlock journey-slot
+    writes with a self-checking payload (variable length so a torn read
+    would mix two lengths and fail JSON or the embedded checksum)."""
+    name, workers, index, iters = argv[0], int(argv[1]), int(argv[2]), int(argv[3])
+    seg = ClusterSegment.attach(name, workers=workers,
+                                counters=("held", "ops"), tenant_slots=8,
+                                blob_cap=1024, journey_slots=4,
+                                journey_slot_bytes=512)
+    try:
+        slab = seg.slab(index)
+        for j in range(iters):
+            pad = "ab" * (j % 120 + 1)
+            slab.journey_write(j % 4, {
+                "trace_id": f"t-{index}-{j % 4}", "w": index, "n": j,
+                "pad": pad, "check": len(pad) + j,
+            })
+        slab.journey_write(0, {"trace_id": f"t-{index}-0", "w": index,
+                               "n": iters, "pad": "", "check": iters,
+                               "done": True})
+    finally:
+        seg.close()
+    return 0
+
+
 if __name__ == "__main__":  # pragma: no cover - subprocess entry
     import sys
 
     if len(sys.argv) >= 2 and sys.argv[1] == "--hammer":
         raise SystemExit(_hammer_main(sys.argv[2:]))
-    raise SystemExit("usage: python -m inference_gateway_tpu.cluster.shm --hammer "
-                     "<name> <workers> <index> <iters>")
+    if len(sys.argv) >= 2 and sys.argv[1] == "--hammer-journey":
+        raise SystemExit(_journey_hammer_main(sys.argv[2:]))
+    raise SystemExit("usage: python -m inference_gateway_tpu.cluster.shm "
+                     "--hammer|--hammer-journey <name> <workers> <index> <iters>")

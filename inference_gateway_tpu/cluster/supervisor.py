@@ -321,7 +321,9 @@ async def run_supervisor(cfg: Any, logger: Any = None) -> None:
     name = f"ig-cluster-{os.getpid()}"
     segment = ClusterSegment.create(
         name, workers=int(cfg.cluster.workers),
-        tenant_slots=int(cfg.cluster.tenant_slots))
+        tenant_slots=int(cfg.cluster.tenant_slots),
+        journey_slots=int(cfg.telemetry.journey_slots),
+        journey_slot_bytes=int(cfg.telemetry.journey_slot_bytes))
     sup = Supervisor(
         segment, gateway_spawn(name, int(cfg.cluster.workers)),
         heartbeat_timeout=cfg.cluster.heartbeat_timeout,

@@ -40,12 +40,15 @@ class WorkerRuntime:
 
     def __init__(self, slab: WorkerSlab, *, prober: Any = None,
                  breakers: Any = None, peer_health: PeerHealthView | None = None,
+                 slo: Any = None, migrator: Any = None,
                  interval: float = 1.0,
                  clock: Clock | None = None, logger: Any = None) -> None:
         self.slab = slab
         self.prober = prober
         self.breakers = breakers
         self.peer_health = peer_health
+        self.slo = slo
+        self.migrator = migrator
         self.interval = interval
         self.clock = clock or MonotonicClock()
         self.logger = logger
@@ -65,6 +68,17 @@ class WorkerRuntime:
             payload["breakers"] = {
                 f"{p}/{m}": state
                 for (p, m), state in self.breakers.snapshot().items()}
+        if self.slo is not None:
+            # SLO window counts ride the heartbeat blob (ISSUE 18): any
+            # worker can merge every peer's counts at scrape time, so
+            # burn rates read identically fleet-wide.
+            payload["slo"] = self.slo.publish_payload(self.clock.now())
+        if self.migrator is not None:
+            # Drain ledger for the fleet pane — which worker considers
+            # which deployment draining, and for how long. Compact (only
+            # draining entries): the blob is shared with probe/breaker
+            # verdicts and the SLO counts.
+            payload["migration"] = self.migrator.drain_ledger()
         self.slab.publish(payload)
         if self.peer_health is not None:
             self.peer_health.refresh()

@@ -482,6 +482,10 @@ def check_config_defaults(spec: dict) -> list[str]:
         "TELEMETRY_ACCOUNTING_ENABLE": cfg.telemetry.accounting_enable,
         "TELEMETRY_ACCOUNTING_WINDOW": cfg.telemetry.accounting_window,
         "TELEMETRY_ACCOUNTING_CHIP": cfg.telemetry.accounting_chip,
+        "TELEMETRY_JOURNEY_ENABLE": cfg.telemetry.journey_enable,
+        "TELEMETRY_JOURNEY_SLOTS": cfg.telemetry.journey_slots,
+        "TELEMETRY_JOURNEY_SLOT_BYTES": cfg.telemetry.journey_slot_bytes,
+        "TELEMETRY_JOURNEY_EVENTS": cfg.telemetry.journey_events,
         "MCP_ENABLE": cfg.mcp.enable,
         "MCP_EXPOSE": cfg.mcp.expose,
         "MCP_SERVERS": cfg.mcp.servers,
@@ -594,6 +598,13 @@ def check_config_defaults(spec: dict) -> list[str]:
         "TENANT_DEFAULT_WEIGHT": cfg.tenant.default_weight,
         "TENANT_WEIGHTS": cfg.tenant.weights,
         "TENANT_QUOTA_BASE": cfg.tenant.quota_base,
+        "SLO_ENABLED": cfg.slo.enabled,
+        "SLO_AVAILABILITY_TARGET": cfg.slo.availability_target,
+        "SLO_TTFT_THRESHOLD": cfg.slo.ttft_threshold,
+        "SLO_TTFT_TARGET": cfg.slo.ttft_target,
+        "SLO_TPOT_THRESHOLD": cfg.slo.tpot_threshold,
+        "SLO_TPOT_TARGET": cfg.slo.tpot_target,
+        "SLO_MAX_TENANT_SERIES": cfg.slo.max_tenant_series,
     }
     problems = []
     seen = set()

@@ -87,6 +87,13 @@ class TelemetryConfig:
     accounting_enable: bool = True
     accounting_window: float = 10.0
     accounting_chip: str = ""
+    # Stream journeys (ISSUE 18): per-worker lifecycle rings published
+    # into the cluster segment. On by default — the <5% p99 overhead
+    # gate (bench_fleet_observability_overhead) is the contract.
+    journey_enable: bool = True
+    journey_slots: int = 64
+    journey_slot_bytes: int = 4096
+    journey_events: int = 32
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "TELEMETRY_") -> "TelemetryConfig":
@@ -115,6 +122,10 @@ class TelemetryConfig:
             accounting_enable=_get_bool(env, prefix + "ACCOUNTING_ENABLE", True),
             accounting_window=_get_duration(env, prefix + "ACCOUNTING_WINDOW", "10s"),
             accounting_chip=_get_str(env, prefix + "ACCOUNTING_CHIP", ""),
+            journey_enable=_get_bool(env, prefix + "JOURNEY_ENABLE", True),
+            journey_slots=_get_int(env, prefix + "JOURNEY_SLOTS", 64),
+            journey_slot_bytes=_get_int(env, prefix + "JOURNEY_SLOT_BYTES", 4096),
+            journey_events=_get_int(env, prefix + "JOURNEY_EVENTS", 32),
         )
 
 
@@ -548,6 +559,37 @@ class TenantConfig:
 
 
 @dataclass
+class SLOConfig:
+    """SLO_* — per-tenant / per-pool SLO accounting (ISSUE 18):
+    sliding-window availability/TTFT/TPOT SLIs with multi-window (5m/1h)
+    burn-rate gauges. ``*_target`` is the good-fraction objective
+    (0.999 = three nines); ``*_threshold`` is the latency bound a
+    request must beat to count good against the corresponding latency
+    SLO. ``max_tenant_series`` bounds distinct tenant label values —
+    the long tail folds into stable hashed ``overflow-N`` buckets."""
+
+    enabled: bool = True
+    availability_target: float = 0.999
+    ttft_threshold: float = 2.0
+    ttft_target: float = 0.99
+    tpot_threshold: float = 0.25
+    tpot_target: float = 0.99
+    max_tenant_series: int = 64
+
+    @classmethod
+    def load(cls, env: Mapping[str, str], prefix: str = "SLO_") -> "SLOConfig":
+        return cls(
+            enabled=_get_bool(env, prefix + "ENABLED", True),
+            availability_target=_get_float(env, prefix + "AVAILABILITY_TARGET", 0.999),
+            ttft_threshold=_get_duration(env, prefix + "TTFT_THRESHOLD", "2s"),
+            ttft_target=_get_float(env, prefix + "TTFT_TARGET", 0.99),
+            tpot_threshold=_get_duration(env, prefix + "TPOT_THRESHOLD", "250ms"),
+            tpot_target=_get_float(env, prefix + "TPOT_TARGET", 0.99),
+            max_tenant_series=_get_int(env, prefix + "MAX_TENANT_SERIES", 64),
+        )
+
+
+@dataclass
 class Config:
     """Top-level gateway configuration (config.go:20-43)."""
 
@@ -569,6 +611,7 @@ class Config:
     structured: StructuredConfig = field(default_factory=StructuredConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     tenant: TenantConfig = field(default_factory=TenantConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     providers: dict[str, ProviderConfig] = field(default_factory=dict)
 
     @classmethod
@@ -596,6 +639,7 @@ class Config:
             structured=StructuredConfig.load(env),
             cluster=ClusterConfig.load(env),
             tenant=TenantConfig.load(env),
+            slo=SLOConfig.load(env),
         )
         if not env.get("RESILIENCE_REQUEST_BUDGET"):
             # Follow the operator's upstream timeout unless the budget is

@@ -194,3 +194,14 @@ class FleetMigrator:
                 for (p, m), u in sorted(self._urls.items())
             ],
         }
+
+    def drain_ledger(self) -> dict[str, float]:
+        """Only the DRAINING deployments, ``provider/model →
+        draining_for_s`` — the compact form each worker publishes in its
+        heartbeat blob (ISSUE 18). Routing drain state is per-worker (a
+        drain POST lands on ONE SO_REUSEPORT worker), so /debug/fleet
+        merging every worker's ledger is what tells the operator whether
+        a drain actually took fleet-wide."""
+        now = self.clock.now()
+        return {f"{p}/{m}": round(now - t, 3)
+                for (p, m), t in sorted(self._draining.items())}

@@ -19,7 +19,11 @@ from typing import Any
 
 from inference_gateway_tpu.api.middlewares.auth import OIDCAuthenticator, oidc_auth_middleware
 from inference_gateway_tpu.api.middlewares.logger import logger_middleware
-from inference_gateway_tpu.api.middlewares.telemetry import telemetry_middleware, tracing_middleware
+from inference_gateway_tpu.api.middlewares.telemetry import (
+    journey_shed_middleware,
+    telemetry_middleware,
+    tracing_middleware,
+)
 from inference_gateway_tpu.api.routes import RouterImpl, Response
 from inference_gateway_tpu.cluster.shm import ClusterSegment, PeerHealthView, WorkerSlab
 from inference_gateway_tpu.cluster.tenancy import TenantPolicy
@@ -29,6 +33,8 @@ from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio.client import ClientConfig, HTTPClient
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Router
 from inference_gateway_tpu.otel import OpenTelemetry
+from inference_gateway_tpu.otel.journey import JourneyRecorder
+from inference_gateway_tpu.otel.slo import SloTracker
 from inference_gateway_tpu.otel.profiling import (
     EventLoopWatchdog,
     SamplingProfiler,
@@ -65,6 +71,8 @@ class Gateway:
     cluster_segment: ClusterSegment | None = None
     cluster_slab: WorkerSlab | None = None
     cluster_runtime: WorkerRuntime | None = None
+    journeys: JourneyRecorder | None = None
+    slo: SloTracker | None = None
     port: int = 0
     metrics_port: int = 0
     _tasks: list[asyncio.Task] = field(default_factory=list)
@@ -177,7 +185,9 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     if cfg.cluster.segment_name and cfg.cluster.worker_index >= 0:
         cluster_segment = ClusterSegment.attach(
             cfg.cluster.segment_name, workers=max(1, cfg.cluster.workers),
-            tenant_slots=cfg.cluster.tenant_slots)
+            tenant_slots=cfg.cluster.tenant_slots,
+            journey_slots=cfg.telemetry.journey_slots,
+            journey_slot_bytes=cfg.telemetry.journey_slot_bytes)
         cluster_slab = cluster_segment.slab(cfg.cluster.worker_index)
         # Cached peer-verdict merge for the routing hot path — refreshed
         # by the WorkerRuntime on the heartbeat interval, read per
@@ -206,7 +216,26 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
             logger=logger,
         )
 
+        def merged_slo_counts():
+            """Cluster-merged SLO window counts at scrape time (ISSUE
+            18): publish OUR freshest counts first, then sum every live
+            worker's published counts — so the burn-rate gauges read
+            identically from any worker, modulo one heartbeat."""
+            if slo_tracker is None:
+                return None
+            if cluster_runtime is not None:
+                cluster_runtime.publish_once()
+            if cluster_segment is not None:
+                payloads = [blob.get("slo")
+                            for blob in cluster_segment.blobs().values()]
+                return SloTracker.merge_payloads([p for p in payloads if p])
+            return None
+
         async def prometheus_handler(req: Request) -> Response:
+            if slo_tracker is not None:
+                # Refresh the slo.* gauges from the fleet merge (local
+                # windows when single-process) just before exposition.
+                slo_tracker.export(otel, merged_slo_counts())
             body = otel.expose_prometheus()
             if cluster_segment is not None:
                 # Per-worker metric merge (ISSUE 16): whichever worker
@@ -260,6 +289,31 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     # ordering) and every handler (failover/retry/deadline budgets).
     resilience = Resilience(cfg.resilience, otel=otel, logger=logger)
 
+    # Fleet observability plane (ISSUE 18): the journey recorder mirrors
+    # stream lifecycles into this worker's reap-surviving shm journey
+    # slots (clustered) so any worker answers /debug/journey — including
+    # for hops served by workers that have since died; the SLO tracker
+    # keeps per-tenant/per-pool sliding-window SLIs whose counts ride
+    # the heartbeat blob for cluster-identical burn rates.
+    journeys = None
+    slo_tracker = None
+    if otel is not None and cfg.telemetry.journey_enable:
+        journeys = JourneyRecorder(
+            slab=cluster_slab, worker=max(0, cfg.cluster.worker_index),
+            clock=resilience.clock, max_journeys=cfg.telemetry.journey_slots,
+            max_events=cfg.telemetry.journey_events,
+            slot_bytes=cfg.telemetry.journey_slot_bytes, otel=otel)
+        resilience.journeys = journeys
+    if otel is not None and cfg.slo.enabled:
+        slo_tracker = SloTracker(
+            availability_target=cfg.slo.availability_target,
+            ttft_threshold=cfg.slo.ttft_threshold,
+            ttft_target=cfg.slo.ttft_target,
+            tpot_threshold=cfg.slo.tpot_threshold,
+            tpot_target=cfg.slo.tpot_target,
+            max_tenant_series=cfg.slo.max_tenant_series,
+            clock=resilience.clock)
+
     # Overload protection (ISSUE 2): one admission ledger per gateway —
     # the admission middleware, the health handler (readiness), and
     # shutdown (graceful drain) all coordinate through it. Clustered
@@ -311,6 +365,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
                 targets, client, interval=cfg.resilience.probe_interval,
                 timeout=cfg.resilience.probe_timeout,
                 eject_after=cfg.resilience.probe_failures,
+                collect_status=True,
                 otel=otel, logger=logger)
             resilience.prober = prober
 
@@ -384,6 +439,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         cfg, registry, client, logger=logger, otel=otel,
         mcp_client=mcp_client, mcp_agent=mcp_agent, selector=selector,
         resilience=resilience, overload=overload, fleet_urls=fleet_urls,
+        journeys=journeys,
     )
 
     # Middleware order matters (main.go:238-254): the wide-event access
@@ -403,6 +459,11 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
     if watchdog is not None:
         # Stall wide events ride the access-log sink when it exists.
         watchdog.access_log = access_log
+    if journeys is not None:
+        # Outside admission (ISSUE 18): sheds short-circuit before any
+        # span exists, so their journey events are recorded here, keyed
+        # by the client's inbound traceparent.
+        middlewares.append(journey_shed_middleware(journeys, slo=slo_tracker))
     middlewares.append(admission_middleware(overload, logger, tenancy=tenancy))
     if otel is not None and cfg.telemetry.tracing_enable:
         middlewares.append(tracing_middleware(otel.tracer))
@@ -412,7 +473,9 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         # feeder: it measures TTFC/duration/rate for every inference
         # request regardless of whether the access log is on, so the
         # TELEMETRY_SLOW_REQUEST_* thresholds work standalone.
-        middlewares.append(telemetry_middleware(otel, logger, slow_log=slow_log))
+        middlewares.append(telemetry_middleware(otel, logger, slow_log=slow_log,
+                                                journeys=journeys,
+                                                slo=slo_tracker))
     authenticator = None
     if cfg.auth.enable:
         authenticator = OIDCAuthenticator(
@@ -456,7 +519,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         # prober/breaker verdicts for peers to read-merge.
         cluster_runtime = WorkerRuntime(
             cluster_slab, prober=prober, breakers=resilience.breakers,
-            peer_health=peer_health,
+            peer_health=peer_health, slo=slo_tracker, migrator=migrator,
             interval=cfg.cluster.heartbeat_interval, clock=resilience.clock,
             logger=logger)
 
@@ -467,7 +530,7 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
         prober=prober, migrator=migrator, access_log=access_log,
         profiler=profiler, watchdog=watchdog, slow_log=slow_log,
         cluster_segment=cluster_segment, cluster_slab=cluster_slab,
-        cluster_runtime=cluster_runtime,
+        cluster_runtime=cluster_runtime, journeys=journeys, slo=slo_tracker,
     )
     # Uptime reads through the resilience clock (graftlint
     # clock-discipline): stamp the start on the same timebase.
@@ -515,9 +578,78 @@ def build_gateway(cfg: Config | None = None, env: dict[str, str] | None = None,
                 # identical from whichever worker answered the scrape.
                 status["cluster"] = cluster_segment.status(resilience.clock.now())
                 status["cluster"]["self_worker"] = cfg.cluster.worker_index
+            if journeys is not None:
+                status["journeys"] = journeys.snapshot()
+            if slo_tracker is not None:
+                # Cluster-merged burn rates (ISSUE 18) — same numbers
+                # /metrics exposes, in snapshot form.
+                status["slo"] = slo_tracker.snapshot(merged_slo_counts())
             return Response.json(status)
 
         metrics_router.get("/debug/status", debug_status_handler)
+
+        if journeys is not None:
+            # /debug/journey?trace_id= (ISSUE 18 tentpole b): the merged
+            # cross-worker journey for one trace — answered from ANY
+            # worker because the shm journey slots survive worker death
+            # and respawn; the admit→route→kill→splice→finish chain of a
+            # stream whose original worker was SIGKILLed reads back whole
+            # from any survivor.
+            async def debug_journey_handler(req: Request) -> Response:
+                trace_id = req.query_get("trace_id")
+                if not trace_id:
+                    return Response.json(
+                        {"error": "trace_id query param required"}, status=400)
+                rec = journeys.lookup(trace_id)
+                if rec is None:
+                    return Response.json(
+                        {"error": f"no journey recorded for trace {trace_id}",
+                         "trace_id": trace_id}, status=404)
+                return Response.json(rec)
+
+            metrics_router.get("/debug/journey", debug_journey_handler)
+
+        # /debug/fleet (ISSUE 18 tentpole a): the cluster-merged
+        # operator pane — every worker's slab (heartbeats, admission
+        # cells, published probe/breaker verdicts, migration ledgers),
+        # every pool replica's cached /debug/status + load report (off
+        # the prober's poll cadence — no new request-path traffic), the
+        # routing/drain state, SLO burn rates, and recent journeys — one
+        # GET, any worker, same answer.
+        async def debug_fleet_handler(req: Request) -> Response:
+            now = resilience.clock.now()
+            fleet: dict[str, Any] = {
+                "app": APPLICATION_NAME,
+                "version": VERSION,
+                "environment": cfg.environment,
+            }
+            if cluster_segment is not None:
+                fleet["cluster"] = cluster_segment.status(now)
+                fleet["cluster"]["self_worker"] = cfg.cluster.worker_index
+                # Per-worker published payloads: probe/breaker verdicts,
+                # migration ledgers — whatever each worker last
+                # heartbeat-published.
+                fleet["workers"] = {
+                    str(i): blob
+                    for i, blob in sorted(cluster_segment.blobs().items())}
+            else:
+                fleet["cluster"] = None
+            fleet["admission"] = overload.snapshot()
+            if prober is not None:
+                # Replica pane: verdicts + load reports + the cached
+                # ?brief=1 debug-status of every pool replica.
+                fleet["replicas"] = prober.snapshot()
+            if selector is not None and hasattr(selector, "snapshot"):
+                fleet["routing"] = selector.snapshot()
+            if migrator is not None:
+                fleet["migration"] = migrator.snapshot()
+            if slo_tracker is not None:
+                fleet["slo"] = slo_tracker.snapshot(merged_slo_counts())
+            if journeys is not None:
+                fleet["journeys"] = journeys.snapshot()
+            return Response.json(fleet)
+
+        metrics_router.get("/debug/fleet", debug_fleet_handler)
 
         # /debug/profile (ISSUE 4): flamegraph-ready collapsed stacks —
         # on-demand capture (?seconds=N&hz=M) or the continuous ring

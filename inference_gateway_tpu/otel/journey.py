@@ -1,0 +1,186 @@
+"""Cross-worker stream journeys (ISSUE 18 tentpole b).
+
+A *journey* is the lifecycle of one edge request keyed by its trace id:
+``admitted`` at a worker, ``routed`` to a pool replica (affinity hit or
+spill), ``first_byte``, mid-stream ``recovered``/``migrated`` hops,
+``spliced`` when a client re-issues with a continuation prefix, and
+``finished``/``shed`` with billing. PRs 3/4 made each of those events
+observable *somewhere* (spans, wide events, counters) — this module
+makes the whole chain answerable from ONE query, from ANY worker,
+including after the worker that served a hop died.
+
+The recorder keeps a bounded ring of journeys in process memory and —
+when the gateway runs clustered — mirrors every update into its
+worker's seqlocked journey slots in the shared-memory segment
+(``ClusterSegment.write_journey``). Those slots survive ``reap()`` and
+respawn by design, so ``lookup()`` merges the slabs of live AND dead
+workers: a stream admitted on worker 0, killed with it, and spliced to
+completion on worker 1 reads back as one chain under one trace id.
+
+Hot-path cost is one dict append plus one JSON dump of a single journey
+per event (the <5% p99 overhead gate in
+``bench_fleet_observability_overhead`` pins it); timestamps come from
+the injected clock, monotonic and system-wide on Linux, so cross-worker
+event ordering by ``t`` is coherent on one host.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
+
+#: Event vocabulary (docs/observability.md "Journey events"). Kept as a
+#: tuple so the metric label stays bounded and lintable.
+JOURNEY_EVENTS: tuple[str, ...] = (
+    "admitted",      # passed admission control at a worker
+    "shed",          # rejected by admission (429/503), keyed by inbound traceparent
+    "routed",        # establishment walk picked a replica
+    "first_byte",    # first upstream byte relayed
+    "recovered",     # mid-stream failover (pre/post first byte)
+    "migrated",      # planned migration evidence (sidecar record fetched)
+    "spliced",       # client re-issued with a continuation prefix
+    "finished",      # stream/response completed (carries billing)
+)
+
+
+class JourneyRecorder:
+    """Bounded per-worker journey ring, optionally shm-published.
+
+    Single-event-loop discipline like the rest of the gateway edge: all
+    mutation happens on the serving loop, so there are no locks. The
+    shm slot a journey occupies is assigned round-robin at first event;
+    a wrapped ring evicts the oldest journey locally AND lets the slot
+    be overwritten in the segment.
+    """
+
+    def __init__(self, *, slab: Any = None, worker: int = 0,
+                 clock: Clock | None = None, max_journeys: int = 64,
+                 max_events: int = 32, slot_bytes: int = 4096,
+                 enabled: bool = True, otel: Any = None) -> None:
+        self.enabled = enabled
+        self.slab = slab
+        self.worker = worker
+        self.clock = clock or MonotonicClock()
+        self.max_journeys = max(1, int(max_journeys))
+        self.max_events = max(4, int(max_events))
+        self.slot_bytes = int(slot_bytes)
+        self.otel = otel
+        self._records: dict[str, dict[str, Any]] = {}
+        self._slots: dict[str, int] = {}
+        self._by_slot: dict[int, str] = {}
+        self._next = 0
+        self.recorded = 0   # events recorded
+        self.evicted = 0    # journeys evicted by ring wrap
+
+    # -- recording (hot path) --------------------------------------------
+    def record(self, trace_id: str | None, event: str, **fields: Any) -> None:
+        """Append one lifecycle event to the trace's journey and publish
+        the updated record. None/empty trace ids are ignored — a journey
+        without a key could never be looked up."""
+        if not self.enabled or not trace_id:
+            return
+        rec = self._records.get(trace_id)
+        if rec is None:
+            slot = self._next % self.max_journeys
+            self._next += 1
+            old = self._by_slot.pop(slot, None)
+            if old is not None:
+                self._records.pop(old, None)
+                self._slots.pop(old, None)
+                self.evicted += 1
+            rec = {"trace_id": trace_id, "worker": self.worker, "events": []}
+            self._records[trace_id] = rec
+            self._slots[trace_id] = slot
+            self._by_slot[slot] = trace_id
+        ev: dict[str, Any] = {"event": event, "t": round(self.clock.now(), 6)}
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        events = rec["events"]
+        if len(events) >= self.max_events:
+            # Keep the first event (the admit that anchors the chain);
+            # drop the oldest middle one.
+            events.pop(1)
+            rec["truncated"] = True
+        events.append(ev)
+        self.recorded += 1
+        if self.otel is not None:
+            self.otel.record_journey_event(event)
+        self._publish(rec)
+
+    def _publish(self, rec: dict[str, Any]) -> None:
+        if self.slab is None:
+            return
+        # Fit the slot: drop middle events until the serialized record
+        # fits the per-slot byte budget (the segment's own overflow stub
+        # is the backstop, never the plan).
+        while (len(json.dumps(rec, separators=(",", ":")).encode("utf-8"))
+               > self.slot_bytes - 16 and len(rec["events"]) > 2):
+            rec["events"].pop(1)
+            rec["truncated"] = True
+        try:
+            self.slab.journey_write(self._slots[rec["trace_id"]], rec)
+        except Exception:
+            pass  # a full/odd segment must never fail the request path
+
+    # -- lookup (any worker, any time) -----------------------------------
+    def lookup(self, trace_id: str) -> dict[str, Any] | None:
+        """The merged journey for one trace id: this worker's live
+        record plus every record published in the segment — including
+        slots of workers that have since died. Events are flattened,
+        annotated with the worker that recorded them, and ordered by
+        the shared monotonic timebase."""
+        recs: list[dict[str, Any]] = []
+        if self.slab is not None:
+            try:
+                recs = self.slab.segment.find_journeys(trace_id)
+            except Exception:
+                recs = []
+        local = self._records.get(trace_id)
+        if local is not None:
+            recs = [r for r in recs if r.get("worker") != self.worker]
+            recs.append(dict(local, worker=self.worker))
+        if not recs:
+            return None
+        events: list[dict[str, Any]] = []
+        for r in recs:
+            for ev in r.get("events", ()):
+                if isinstance(ev, dict):
+                    e = dict(ev)
+                    e.setdefault("worker", r.get("worker"))
+                    events.append(e)
+        events.sort(key=lambda e: e.get("t", 0.0))
+        out: dict[str, Any] = {
+            "trace_id": trace_id,
+            "workers": sorted({r.get("worker") for r in recs
+                               if r.get("worker") is not None}),
+            "events": events,
+        }
+        if any(r.get("truncated") for r in recs):
+            out["truncated"] = True
+        if any(r.get("overflow") for r in recs):
+            out["overflow"] = True
+        return out
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """The /debug/status + /debug/fleet journey section."""
+        recent = []
+        for trace_id, rec in list(self._records.items())[-8:]:
+            events = rec.get("events", ())
+            recent.append({
+                "trace_id": trace_id, "events": len(events),
+                "last": events[-1]["event"] if events else None,
+            })
+        return {
+            "enabled": self.enabled,
+            "worker": self.worker,
+            "ring_size": self.max_journeys,
+            "active": len(self._records),
+            "events_recorded": self.recorded,
+            "journeys_evicted": self.evicted,
+            "published": self.slab is not None,
+            "recent": recent,
+        }

@@ -164,10 +164,15 @@ class OpenTelemetry:
             "Requests rejected by per-tenant quota or fairness shedding",
             ("tenant", "reason"), unit="{request}",
         )
+        # ``source`` (PR 6 gauge convention) says whose view the value
+        # is: "worker" in single-process mode, "cluster" when the value
+        # is the shm-slab merge — quotas are cluster-wide, so the gauge
+        # must be too (ISSUE 18 satellite fix).
         self.tenant_in_flight_gauge = r.gauge(
             "inference_gateway.tenant.in_flight",
-            "In-flight requests per tenant on this worker",
-            ("tenant",),
+            "In-flight requests per tenant (source=cluster: live-slab "
+            "merge; source=worker: this process only)",
+            ("tenant", "source"),
         )
         # Token-level streaming instruments (ISSUE 3): the per-token
         # latency visibility the ROADMAP north star is judged against —
@@ -391,6 +396,42 @@ class OpenTelemetry:
             "schemas repeat across requests like prompt prefixes",
             ("gen_ai_request_model", "result"), unit="{lookup}",
         )
+        # Fleet observability plane (ISSUE 18): SLO burn rates per
+        # tenant and per pool (cluster-merged at scrape time — the same
+        # series from any worker), and journey lifecycle event counts.
+        # Cardinality is bounded by construction: slo/window/event label
+        # values are closed vocabularies, tenant keys fold into hashed
+        # overflow buckets past SLO_MAX_TENANT_SERIES, and NO instrument
+        # ever carries a trace id (journeys are /debug/journey's job —
+        # the metric-lint cardinality rule pins this).
+        self.slo_burn_rate_gauge = r.gauge(
+            "inference_gateway.slo.burn_rate",
+            "Error-budget burn rate per tenant SLO and window (1.0 = "
+            "consuming the budget exactly as fast as the window allows)",
+            ("slo", "window", "tenant"), ttl=EFFICIENCY_GAUGE_TTL,
+        )
+        self.slo_budget_gauge = r.gauge(
+            "inference_gateway.slo.error_budget_remaining",
+            "Error budget remaining per tenant SLO and window "
+            "(1 - burn_rate; negative = overspent)",
+            ("slo", "window", "tenant"), ttl=EFFICIENCY_GAUGE_TTL,
+        )
+        self.slo_pool_burn_rate_gauge = r.gauge(
+            "inference_gateway.slo.pool_burn_rate",
+            "Error-budget burn rate per pool SLO and window",
+            ("slo", "window", "pool"), ttl=EFFICIENCY_GAUGE_TTL,
+        )
+        self.slo_pool_budget_gauge = r.gauge(
+            "inference_gateway.slo.pool_error_budget_remaining",
+            "Error budget remaining per pool SLO and window",
+            ("slo", "window", "pool"), ttl=EFFICIENCY_GAUGE_TTL,
+        )
+        self.journey_event_counter = r.counter(
+            "inference_gateway.journey.events",
+            "Stream-journey lifecycle events recorded (admitted/routed/"
+            "first_byte/recovered/migrated/spliced/finished/shed)",
+            ("event",), unit="{event}",
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -472,14 +513,33 @@ class OpenTelemetry:
     def record_tenant_shed(self, tenant: str, reason: str) -> None:
         self.tenant_shed_counter.add(1, {"tenant": tenant, "reason": reason})
 
-    def set_tenant_in_flight(self, tenant: str, value: int) -> None:
-        self.tenant_in_flight_gauge.set(value, {"tenant": tenant})
+    def set_tenant_in_flight(self, tenant: str, value: int,
+                             source: str = "worker") -> None:
+        self.tenant_in_flight_gauge.set(value, {"tenant": tenant,
+                                                "source": source})
 
-    def remove_tenant_gauge(self, tenant: str) -> None:
+    def remove_tenant_gauge(self, tenant: str, source: str = "worker") -> None:
         """A tenant back at zero in-flight leaves the exposition: tenant
         ids are unbounded (hashed API keys), so idle series must be
         dropped or the gauge cardinality only ever grows."""
-        self.tenant_in_flight_gauge.remove({"tenant": tenant})
+        self.tenant_in_flight_gauge.remove({"tenant": tenant,
+                                            "source": source})
+
+    # -- fleet observability (ISSUE 18) ----------------------------------
+    def set_slo_burn_rate(self, slo: str, window: str, tenant: str,
+                          burn: float, remaining: float) -> None:
+        labels = {"slo": slo, "window": window, "tenant": tenant}
+        self.slo_burn_rate_gauge.set(burn, labels)
+        self.slo_budget_gauge.set(remaining, labels)
+
+    def set_pool_slo_burn_rate(self, slo: str, window: str, pool: str,
+                               burn: float, remaining: float) -> None:
+        labels = {"slo": slo, "window": window, "pool": pool}
+        self.slo_pool_burn_rate_gauge.set(burn, labels)
+        self.slo_pool_budget_gauge.set(remaining, labels)
+
+    def record_journey_event(self, event: str) -> None:
+        self.journey_event_counter.add(1, {"event": event})
 
     # -- token-level streaming metrics (ISSUE 3) -------------------------
     def record_time_to_first_chunk(self, source: str, team: str, provider: str,
@@ -860,6 +920,15 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def remove_tenant_gauge(self, *a, **k) -> None:
+        pass
+
+    def set_slo_burn_rate(self, *a, **k) -> None:
+        pass
+
+    def set_pool_slo_burn_rate(self, *a, **k) -> None:
+        pass
+
+    def record_journey_event(self, *a, **k) -> None:
         pass
 
     def record_time_to_first_chunk(self, *a, **k) -> None:

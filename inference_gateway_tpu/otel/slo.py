@@ -1,0 +1,286 @@
+"""Per-tenant / per-pool SLO burn-rate accounting (ISSUE 18 tentpole c).
+
+Three SLIs per scope, tracked in sliding windows against ``SLO_*``
+targets:
+
+- **availability** — a request is *good* when it completes without a
+  gateway/upstream error (HTTP < 500 and no relay abort);
+- **ttft** — good when time-to-first-token lands under
+  ``SLO_TTFT_THRESHOLD``;
+- **tpot** — good when the stream's mean inter-token latency lands
+  under ``SLO_TPOT_THRESHOLD``.
+
+Each (scope, SLI) keeps bucketed good/bad counts over the long window;
+the 5m and 1h rates are sums over bucket suffixes, so memory per series
+is a few hundred ints and observation cost is O(1). Burn rate is the
+standard SRE ratio: ``bad_fraction / (1 - target)`` — 1.0 means the
+error budget is being consumed exactly at the rate that exhausts it at
+the window's end, >1 alerts. ``error budget remaining`` is
+``1 - burn_rate`` (negative = overspent).
+
+Tenant ids are unbounded (hashed API keys), so distinct tenant *series*
+are bounded by ``SLO_MAX_TENANT_SERIES``: the first N distinct tenants
+keep their own key, the long tail folds into stable hashed buckets
+(``overflow-<slot>``) — the same sha256 slotting the cluster quota
+cells use, so a tenant maps to the same bucket on every worker.
+
+Cluster merge (the acceptance criterion: burn rates read identically
+from any worker's /metrics): each worker publishes its window *counts*
+in its heartbeat blob; at scrape time the serving worker re-publishes
+its own counts, then merges every live worker's published counts and
+computes rates from the sums. All workers therefore expose the same
+series modulo one heartbeat of staleness.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from inference_gateway_tpu.cluster.shm import tenant_slot
+from inference_gateway_tpu.resilience.clock import Clock, MonotonicClock
+
+#: The SLI names (metric label values; bounded by construction).
+SLO_NAMES: tuple[str, ...] = ("availability", "ttft", "tpot")
+
+#: Multi-window burn rates per Google SRE workbook: a fast window for
+#: paging, a slow one for ticketing. Fixed — window choice is alerting
+#: policy, not deployment config.
+WINDOWS: tuple[tuple[str, float], ...] = (("5m", 300.0), ("1h", 3600.0))
+
+_LONG_HORIZON = 3600.0
+_BUCKETS = 240  # 15s buckets over the 1h horizon
+
+# Compact wire keys for the heartbeat-blob payload (blob space is shared
+# with probe/breaker verdicts).
+_WIRE = {"availability": "a", "ttft": "f", "tpot": "p"}
+_UNWIRE = {v: k for k, v in _WIRE.items()}
+
+
+class _Sli:
+    """Bucketed good/bad counts over the long horizon."""
+
+    __slots__ = ("width", "n", "good", "bad", "stamp")
+
+    def __init__(self, horizon: float = _LONG_HORIZON, buckets: int = _BUCKETS) -> None:
+        self.width = horizon / buckets
+        self.n = buckets
+        self.good = [0] * buckets
+        self.bad = [0] * buckets
+        self.stamp = [-1] * buckets  # absolute bucket index last written
+
+    def add(self, now: float, ok: bool) -> None:
+        idx = int(now // self.width)
+        i = idx % self.n
+        if self.stamp[i] != idx:
+            self.stamp[i] = idx
+            self.good[i] = 0
+            self.bad[i] = 0
+        if ok:
+            self.good[i] += 1
+        else:
+            self.bad[i] += 1
+
+    def counts(self, now: float, horizon: float) -> tuple[int, int]:
+        """(good, bad) over the trailing ``horizon`` seconds."""
+        idx = int(now // self.width)
+        k = min(self.n, max(1, int(horizon / self.width)))
+        g = b = 0
+        for d in range(k):
+            j = idx - d
+            i = j % self.n
+            if self.stamp[i] == j:
+                g += self.good[i]
+                b += self.bad[i]
+        return g, b
+
+
+def burn_rate(good: int, bad: int, target: float) -> float:
+    """bad_fraction / error_budget; 0.0 on an empty window (no traffic
+    consumes no budget)."""
+    total = good + bad
+    if total <= 0:
+        return 0.0
+    budget = max(1e-9, 1.0 - target)
+    return (bad / total) / budget
+
+
+class SloTracker:
+    """Sliding-window SLI state for one worker, cluster-mergeable."""
+
+    def __init__(self, *, availability_target: float = 0.999,
+                 ttft_threshold: float = 2.0, ttft_target: float = 0.99,
+                 tpot_threshold: float = 0.25, tpot_target: float = 0.99,
+                 max_tenant_series: int = 64, clock: Clock | None = None,
+                 enabled: bool = True) -> None:
+        self.enabled = enabled
+        self.clock = clock or MonotonicClock()
+        self.targets = {"availability": availability_target,
+                        "ttft": ttft_target, "tpot": tpot_target}
+        self.ttft_threshold = ttft_threshold
+        self.tpot_threshold = tpot_threshold
+        self.max_tenant_series = max(1, int(max_tenant_series))
+        # scope kind -> key -> sli name -> _Sli
+        self._scopes: dict[str, dict[str, dict[str, _Sli]]] = {
+            "tenant": {}, "pool": {}}
+        self.observations = 0
+
+    # -- keying ----------------------------------------------------------
+    def tenant_key(self, tenant: str) -> str:
+        """The metric-label key for a tenant id: itself while the series
+        budget lasts, a stable hashed bucket past it."""
+        tenants = self._scopes["tenant"]
+        if tenant in tenants or len(tenants) < self.max_tenant_series:
+            return tenant
+        return f"overflow-{tenant_slot(tenant, self.max_tenant_series)}"
+
+    def _slis(self, kind: str, key: str) -> dict[str, _Sli]:
+        scope = self._scopes[kind]
+        slis = scope.get(key)
+        if slis is None:
+            slis = {name: _Sli() for name in SLO_NAMES}
+            scope[key] = slis
+        return slis
+
+    # -- observation (hot path) ------------------------------------------
+    def observe(self, *, tenant: str | None = None, pool: str | None = None,
+                ok: bool = True, ttft: float | None = None,
+                tpot: float | None = None, now: float | None = None) -> None:
+        """Record one finished request against every SLI it evidences:
+        availability always, ttft/tpot only when the stream produced a
+        measurement (a failed request is charged to availability, not
+        silently to the latency SLOs it never got to attempt)."""
+        if not self.enabled:
+            return
+        t = self.clock.now() if now is None else now
+        targets = []
+        if tenant:
+            targets.append(self._slis("tenant", self.tenant_key(tenant)))
+        if pool:
+            targets.append(self._slis("pool", pool))
+        if not targets:
+            return
+        self.observations += 1
+        for slis in targets:
+            slis["availability"].add(t, ok)
+            if ttft is not None:
+                slis["ttft"].add(t, ttft <= self.ttft_threshold)
+            if tpot is not None:
+                slis["tpot"].add(t, tpot <= self.tpot_threshold)
+
+    # -- cluster merge ---------------------------------------------------
+    def publish_payload(self, now: float | None = None) -> dict[str, Any]:
+        """This worker's window counts, compact, for the heartbeat
+        blob: ``{kind: {key: {sli: {window: [good, bad]}}}}``."""
+        t = self.clock.now() if now is None else now
+        out: dict[str, Any] = {}
+        for kind, scope in self._scopes.items():
+            entries: dict[str, Any] = {}
+            for key, slis in scope.items():
+                entry: dict[str, Any] = {}
+                for name, sli in slis.items():
+                    wins = {}
+                    for label, horizon in WINDOWS:
+                        g, b = sli.counts(t, horizon)
+                        if g or b:
+                            wins[label] = [g, b]
+                    if wins:
+                        entry[_WIRE[name]] = wins
+                if entry:
+                    entries[key] = entry
+            if entries:
+                out[kind] = entries
+        return out
+
+    @staticmethod
+    def merge_payloads(payloads: list[dict[str, Any]]) -> dict[str, Any]:
+        """Sum several workers' published counts into one cluster view:
+        ``{kind: {key: {sli: {window: [good, bad]}}}}`` (wire keys
+        expanded)."""
+        merged: dict[str, Any] = {}
+        for payload in payloads:
+            if not isinstance(payload, dict):
+                continue
+            for kind, entries in payload.items():
+                if not isinstance(entries, dict):
+                    continue
+                mk = merged.setdefault(kind, {})
+                for key, entry in entries.items():
+                    if not isinstance(entry, dict):
+                        continue
+                    me = mk.setdefault(key, {})
+                    for wire, wins in entry.items():
+                        name = _UNWIRE.get(wire, wire)
+                        if name not in SLO_NAMES or not isinstance(wins, dict):
+                            continue
+                        mw = me.setdefault(name, {})
+                        for label, gb in wins.items():
+                            if (not isinstance(gb, (list, tuple))
+                                    or len(gb) != 2):
+                                continue
+                            cur = mw.setdefault(label, [0, 0])
+                            cur[0] += int(gb[0])
+                            cur[1] += int(gb[1])
+        return merged
+
+    # -- rates -----------------------------------------------------------
+    def rates(self, merged: dict[str, Any] | None = None,
+              now: float | None = None) -> dict[str, Any]:
+        """Burn-rate/budget rows per scope:
+        ``{kind: {key: {sli: {window: {...}}}}}``. With ``merged``
+        (cluster counts from ``merge_payloads``) rates come from the
+        fleet sums; without, from this worker's local windows."""
+        counts = merged if merged is not None else self.merge_payloads(
+            [self.publish_payload(now)])
+        out: dict[str, Any] = {}
+        for kind, entries in counts.items():
+            ok = out.setdefault(kind, {})
+            for key, entry in entries.items():
+                oe = ok.setdefault(key, {})
+                for name, wins in entry.items():
+                    target = self.targets.get(name, 0.99)
+                    ow = oe.setdefault(name, {})
+                    for label, (g, b) in wins.items():
+                        rate = burn_rate(g, b, target)
+                        ow[label] = {
+                            "good": g, "bad": b,
+                            "burn_rate": round(rate, 4),
+                            "budget_remaining": round(1.0 - rate, 4),
+                        }
+        return out
+
+    def export(self, otel: Any, merged: dict[str, Any] | None = None,
+               now: float | None = None) -> None:
+        """Refresh the ``inference_gateway.slo.*`` gauges from (cluster
+        or local) rates — called at scrape time so the exposition is as
+        fresh as the merge."""
+        if otel is None or not self.enabled:
+            return
+        rows = self.rates(merged, now)
+        for key, slis in rows.get("tenant", {}).items():
+            for name, wins in slis.items():
+                for label, row in wins.items():
+                    otel.set_slo_burn_rate(name, label, key,
+                                           row["burn_rate"],
+                                           row["budget_remaining"])
+        for key, slis in rows.get("pool", {}).items():
+            for name, wins in slis.items():
+                for label, row in wins.items():
+                    otel.set_pool_slo_burn_rate(name, label, key,
+                                                row["burn_rate"],
+                                                row["budget_remaining"])
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self, merged: dict[str, Any] | None = None,
+                 now: float | None = None) -> dict[str, Any]:
+        """The /debug/status + /debug/fleet SLO section."""
+        return {
+            "enabled": self.enabled,
+            "targets": dict(self.targets),
+            "ttft_threshold_s": self.ttft_threshold,
+            "tpot_threshold_s": self.tpot_threshold,
+            "windows": [label for label, _ in WINDOWS],
+            "max_tenant_series": self.max_tenant_series,
+            "observations": self.observations,
+            "merged": merged is not None,
+            "rates": self.rates(merged, now),
+        }

@@ -86,6 +86,11 @@ class Resilience:
         # as streams_migrated{reason} and NOT charged to the dead
         # replica's breaker (a replica taken out on purpose is not ill).
         self.migrator: Any = None
+        # Journey recorder (ISSUE 18): wired by the gateway assembly when
+        # telemetry is on. Stream recovery/migration hops record journey
+        # events here, keyed by the trace id the route handler threads
+        # through execute_streaming.
+        self.journeys: Any = None
         self.retry_policy = RetryPolicy(
             max_attempts=getattr(cfg, "retry_max_attempts", 3) if self.enabled else 1,
             base_backoff=getattr(cfg, "retry_base_backoff", 0.1),
@@ -372,6 +377,7 @@ class Resilience:
         alias: str = "",
         event: dict[str, Any] | None = None,
         continuation: Any = None,
+        trace_id: str | None = None,
     ) -> tuple[AsyncIterator[bytes], Any]:
         """``execute`` for SSE relays: streamed requests are retryable
         until the first relayed byte — and, with a ``continuation``,
@@ -462,12 +468,24 @@ class Resilience:
                             phase = pending_phase or "pre_first_byte"
                             self._record_stream_recovered(
                                 alias, pending_from, cand.provider, phase)
+                            if self.journeys is not None:
+                                self.journeys.record(
+                                    trace_id, "recovered", phase=phase,
+                                    from_provider=pending_from,
+                                    to_provider=cand.provider,
+                                    to_model=cand.model, hop=hops)
                             if pending_migration and phase == "post_first_byte":
                                 # The splice completed a PLANNED move
                                 # (drain/restart): count the migration.
                                 self._record_stream_migrated(
                                     alias, pending_from, cand.provider,
                                     pending_migration)
+                                if self.journeys is not None:
+                                    self.journeys.record(
+                                        trace_id, "migrated",
+                                        reason=pending_migration,
+                                        from_provider=pending_from,
+                                        to_provider=cand.provider)
                                 if event is not None:
                                     event["stream_migrated"] = pending_migration
                             if event is not None:

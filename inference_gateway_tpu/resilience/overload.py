@@ -251,12 +251,24 @@ class OverloadController:
             seg = self._shared.segment
             self._shared.tenant_add(tenant_slot(tenant, seg.tenant_slots), delta)
         if self.otel is not None:
-            if n > 0:
-                self.otel.set_tenant_in_flight(tenant, n)
+            # Clustered, the gauge reports what admission actually
+            # checks: the CLUSTER-merged occupancy (all live workers'
+            # tenant cells, read after our own mirror write), labelled
+            # source="cluster" per the PR 6 gauge convention — a
+            # worker-local count under a fleet quota misread as "tenant
+            # nowhere near its cap" on every dashboard (ISSUE 18
+            # satellite). Single-process keeps source="worker".
+            if self._shared is not None:
+                value = self._tenant_occupancy(tenant)
+                source = "cluster"
+            else:
+                value, source = n, "worker"
+            if value > 0:
+                self.otel.set_tenant_in_flight(tenant, value, source=source)
             else:
                 # Tenant ids are unbounded (hashed keys): idle series
                 # leave the exposition or cardinality only ever grows.
-                self.otel.remove_tenant_gauge(tenant)
+                self.otel.remove_tenant_gauge(tenant, source=source)
 
     def _over_fair_share(self, st: _ClassState, tenant: str) -> bool:
         """Fairness-weighted shedding, consulted only once the class is
@@ -606,6 +618,10 @@ def admission_middleware(overload: OverloadController, logger: Any = None,
         tenant: str | None = None
         if tenancy is not None and tenancy.enabled:
             tenant = derive_tenant(req.headers, tenancy)
+            # Downstream attribution (SLO SLIs, journey events) reads the
+            # request context — the wide event only exists when the
+            # access log is on, and tenant SLOs must not depend on it.
+            req.ctx["tenant"] = tenant
             event = req.ctx.get("wide_event")
             if event is not None:
                 # The tenant label on the wide-event access log — set

@@ -49,6 +49,14 @@ def probe_url(base_url: str) -> str:
     return service_origin(base_url) + "/health"
 
 
+def status_url(probe_u: str) -> str:
+    """The replica's bounded debug-status endpoint, derived from its
+    probe URL (ISSUE 18): same origin, ``?brief=1`` so the sidecar
+    answers with the small operator subset, not its full forensics."""
+    base = probe_u[: -len("/health")] if probe_u.endswith("/health") else probe_u
+    return base + "/debug/status?brief=1"
+
+
 @dataclass(frozen=True)
 class ProbeTarget:
     provider: str
@@ -62,12 +70,14 @@ class HealthProber:
     def __init__(self, targets: Iterable[ProbeTarget], client: Any = None, *,
                  clock: Clock | None = None, interval: float = 5.0,
                  timeout: float = 2.0, eject_after: int = 3,
+                 collect_status: bool = False,
                  otel: Any = None, logger: Any = None) -> None:
         self.client = client
         self.clock = clock or MonotonicClock()
         self.interval = interval
         self.timeout = timeout
         self.eject_after = max(1, int(eject_after))
+        self.collect_status = collect_status
         self.otel = otel
         self.logger = logger
         self._lock = threading.Lock()
@@ -82,6 +92,7 @@ class HealthProber:
                 "url": t.url, "failures": 0, "ejected": False,
                 "ejections": 0, "readmissions": 0, "last_ok": None,
                 "last_checked": None, "status": None, "load": None,
+                "replica": None,
             }
         self._task: asyncio.Task | None = None
 
@@ -155,6 +166,7 @@ class HealthProber:
         ok = False
         status: str | None = None
         load: dict[str, Any] | None = None
+        replica: dict[str, Any] | None = None
         try:
             resp = await self.clock.wait_for(
                 self.client.get(url, timeout=self.timeout), self.timeout)
@@ -171,15 +183,46 @@ class HealthProber:
             status, load = self._parse_body(resp)
         except Exception:
             ok = False
+        if self.collect_status and ok:
+            # Replica debug-status ride-along (ISSUE 18): one extra GET
+            # per distinct URL on the SAME probe cadence feeds the
+            # /debug/fleet pane — never the request path. Only replicas
+            # whose /health body parsed (our sidecar) are asked; foreign
+            # providers answering 404 to /health have no /debug/status
+            # to poll and keep a single-GET round.
+            replica = await self._fetch_replica_status(url) if status else None
         for t in targets:
-            self.record(t.provider, t.model, ok, status=status, load=load)
+            self.record(t.provider, t.model, ok, status=status, load=load,
+                        replica=replica)
+
+    # /debug/status?brief=1 fields retained in the fleet pane — a bounded
+    # operator subset, never the replica's full forensic dump.
+    _REPLICA_FIELDS = ("model", "state", "uptime_seconds", "active_requests",
+                       "queue_depth", "preemptions", "engine_restarts",
+                       "streams_migrated_out", "streams_migrated_in")
+
+    async def _fetch_replica_status(self, probe_u: str) -> dict[str, Any] | None:
+        try:
+            resp = await self.clock.wait_for(
+                self.client.get(status_url(probe_u), timeout=self.timeout),
+                self.timeout)
+            if getattr(resp, "status", 599) != 200:
+                return None
+            body = resp.json()
+        except Exception:
+            return None
+        if not isinstance(body, dict):
+            return None
+        return {k: body[k] for k in self._REPLICA_FIELDS if k in body} or None
 
     def record(self, provider: str, model: str, ok: bool, *,
                status: str | None = None,
-               load: dict[str, Any] | None = None) -> None:
+               load: dict[str, Any] | None = None,
+               replica: dict[str, Any] | None = None) -> None:
         """Apply one probe outcome (thread-safe; the transition decision
         happens under the lock, telemetry outside it). ``status``/``load``
-        carry the parsed /health body when the target reported one."""
+        carry the parsed /health body when the target reported one;
+        ``replica`` the bounded /debug/status subset when collected."""
         key = (provider, model)
         ejected_now = readmitted_now = False
         with self._lock:
@@ -188,6 +231,11 @@ class HealthProber:
                 return
             st["last_ok"] = ok
             st["last_checked"] = self.clock.now()
+            if replica is not None:
+                # Like status: keep the last successful report across
+                # transient fetch failures — "what the replica last said
+                # about itself" beats None.
+                st["replica"] = replica
             if ok or status is not None:
                 # A fresh verdict replaces the old one; an UNREACHABLE
                 # probe (no body at all) keeps the last self-report —
@@ -295,6 +343,7 @@ class HealthProber:
                     "last_ok": st["last_ok"],
                     "status": st["status"],
                     "load": dict(st["load"]) if st["load"] else None,
+                    "replica": dict(st["replica"]) if st["replica"] else None,
                     "seconds_since_probe": (round(now - st["last_checked"], 3)
                                             if st["last_checked"] is not None else None),
                 })

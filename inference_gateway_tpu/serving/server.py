@@ -824,7 +824,21 @@ class SidecarServer:
     async def debug_status(self, req: Request) -> Response:
         """GET /debug/status — one JSON snapshot of the sidecar's
         introspection state: engine occupancy, timeline summary, the
-        slow-request log, and profiler/watchdog health."""
+        slow-request log, and profiler/watchdog health. ``?brief=1``
+        answers with just the bounded operator subset the gateway's
+        health prober caches for /debug/fleet (ISSUE 18) — cheap enough
+        to ride every probe round."""
+        if req.query_get("brief"):
+            return Response.json({
+                "model": self.model_name,
+                "uptime_seconds": round(self._clock.now() - self._started, 3),
+                "active_requests": self.scheduler.active_requests(),
+                "queue_depth": self.scheduler.queue_depth,
+                "state": self.state,
+                "preemptions": self.scheduler.preemptions,
+                "engine_restarts": self.restarts,
+                "streams_migrated_out": self.migrated_out,
+            })
         status: dict[str, Any] = {
             "model": self.model_name,
             "uptime_seconds": round(self._clock.now() - self._started, 3),

@@ -605,3 +605,145 @@ def hammer_shm_ledger(workers: int = 4, iters: int = 2000,
                 p.kill()
         seg.close(unlink=True)
     return errors
+
+
+def hammer_shm_journeys(workers: int = 4, iters: int = 3000,
+                        reader_threads: int = 3) -> list[str]:
+    """Multi-PROCESS hammer for the seqlocked journey slots (ISSUE 18).
+
+    N child processes (``python -m inference_gateway_tpu.cluster.shm
+    --hammer-journey``) rewrite their 4 journey slots ``iters`` times
+    with variable-length self-checking payloads (``check == len(pad) +
+    n``) while parent reader threads spin ``read_journey`` /
+    ``journey_records`` / ``find_journeys`` mid-storm. A torn read —
+    bytes from two different writes — either breaks JSON (the seqlock
+    retry loop hides transient tears; 8 straight tears return None,
+    which is legal) or, the dangerous case, DECODES but mixes payloads:
+    the embedded checksum and the worker echo catch exactly that.
+
+    At quiesce: every slot holds its writer's LAST payload (slot 0 the
+    ``done`` stamp), lookups find the expected trace ids, and — the
+    survival contract the chaos e2e depends on — ``reap()`` +
+    ``begin_generation()`` leave every journey record readable.
+    """
+    import os
+    import subprocess
+    import sys
+    import uuid
+
+    from inference_gateway_tpu.cluster.shm import ClusterSegment
+
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    def check_record(rec: dict) -> None:
+        """Integrity of one decoded journey payload; rec may legally be
+        a worker's stub/done record (empty pad)."""
+        if rec.get("check") != len(rec.get("pad", "")) + rec.get("n", -1):
+            fail(f"torn journey payload (checksum): {rec!r}")
+        w = rec.get("w")
+        if not isinstance(w, int) or not 0 <= w < workers:
+            fail(f"torn journey payload (worker echo): {rec!r}")
+        elif not str(rec.get("trace_id", "")).startswith(f"t-{w}-"):
+            fail(f"journey trace id from another slab: {rec!r}")
+
+    name = f"ig-jhammer-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    seg = ClusterSegment.create(name, workers=workers,
+                                counters=("held", "ops"), tenant_slots=8,
+                                blob_cap=1024, journey_slots=4,
+                                journey_slot_bytes=512)
+    procs: list["subprocess.Popen[bytes]"] = []
+    stop_readers = threading.Event()
+    try:
+        for i in range(workers):
+            seg.begin_generation(i, i + 1)
+        for i in range(workers):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "inference_gateway_tpu.cluster.shm",
+                 "--hammer-journey", name, str(workers), str(i), str(iters)],
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+        def reader(tid: int) -> None:
+            n = 0
+            while not stop_readers.is_set():
+                try:
+                    if n % 3 == 0:
+                        for rec in seg.journey_records():
+                            check_record(rec)
+                            if rec["worker"] != rec["w"]:
+                                fail(f"record annotated with wrong slab: {rec!r}")
+                    elif n % 3 == 1:
+                        rec = seg.read_journey(n % workers, (n // workers) % 4)
+                        if rec is not None:
+                            check_record(rec)
+                    else:
+                        for rec in seg.find_journeys(f"t-{tid % workers}-1"):
+                            check_record(rec)
+                    n += 1
+                except Exception as e:
+                    fail(f"reader: {e!r}")
+                    return
+
+        readers = [threading.Thread(target=reader, args=(t,),
+                                    name=f"jshm-r{t}", daemon=True)
+                   for t in range(reader_threads)]
+        for t in readers:
+            t.start()
+        for i, p in enumerate(procs):
+            try:
+                rc = p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                fail(f"worker {i} hung")
+                continue
+            if rc != 0:
+                fail(f"worker {i} exited {rc}")
+        stop_readers.set()
+        for t in readers:
+            t.join(timeout=30)
+            if t.is_alive():
+                fail(f"{t.name} did not finish")
+        if errors:
+            return errors
+
+        # Quiesce: each worker's slot 0 holds the done stamp; slots 1-3
+        # hold the LAST write for that slot (check still consistent).
+        for i in range(workers):
+            done = seg.read_journey(i, 0)
+            if not done or not done.get("done") or done.get("n") != iters:
+                fail(f"worker {i} slot 0 final record wrong: {done!r}")
+            for slot in range(1, 4):
+                rec = seg.read_journey(i, slot)
+                if rec is None:
+                    fail(f"worker {i} slot {slot} empty at quiesce")
+                else:
+                    check_record(rec)
+            found = seg.find_journeys(f"t-{i}-1")
+            if len(found) != 1 or found[0].get("w") != i:
+                fail(f"find_journeys(t-{i}-1) -> {found!r}")
+
+        # THE survival contract: reap + a fresh generation must leave
+        # the dead worker's journey ring readable (the chaos e2e reads a
+        # SIGKILLed worker's half of a journey through exactly this).
+        seg.reap(0)
+        if seg.read_journey(0, 0) is None:
+            fail("journey slot lost to reap()")
+        seg.begin_generation(0, workers + 1)
+        rec = seg.read_journey(0, 1)
+        if rec is None:
+            fail("journey slot lost to begin_generation()")
+        else:
+            check_record(rec)
+        if not seg.find_journeys("t-0-2"):
+            fail("find_journeys lost the dead worker's records")
+    finally:
+        stop_readers.set()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        seg.close(unlink=True)
+    return errors

@@ -1003,6 +1003,164 @@ async def test_sigkill_mid_stream_completes_byte_identical_via_continuation(
     assert await _await(lambda: _fleet_ready(seg, 2), timeout=120)
 
 
+async def test_journey_survives_originating_worker_death(cluster_stack):
+    """THE fleet-observability acceptance (ISSUE 18): SIGKILL the worker
+    that admitted + relayed a stream's first bytes, splice it to
+    completion on the survivor under the same propagated traceparent,
+    then ask ANY worker for ``/debug/journey?trace_id=`` — the full
+    admit → route → first_byte → (kill) → splice → finish chain reads
+    back as ONE journey spanning both workers, with exactly one
+    ``finished`` event carrying the billing (once-only by construction:
+    the dead relay never reached its finally)."""
+    seg, sup, port, metrics_port, sidecar, _log = cluster_stack
+    trace = uuid.uuid4().hex  # fresh 32-hex id: this test's own journey
+    headers = Headers()
+    headers.set("Content-Type", "application/json")
+    headers.set("traceparent", f"00-{trace}-1234567890abcdef-01")
+    url = f"http://127.0.0.1:{port}/v1/chat/completions"
+    body = json.dumps(_chat_body(max_tokens=96)).encode()
+
+    client = HTTPClient()
+    resp = await client.post(url, body, headers=headers, stream=True)
+    assert resp.status == 200
+    buf, got, contents, killed, victim = b"", b"", [], None, None
+    try:
+        async for block in resp.iter_raw():
+            buf += block
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                raw += b"\n\n"
+                got += raw
+                payload = raw.strip()[5:].strip()
+                if payload != b"[DONE]":
+                    ev = json.loads(payload)
+                    delta = ((ev.get("choices") or [{}])[0].get("delta") or {})
+                    if delta.get("content"):
+                        contents.append(delta["content"])
+            if len(contents) >= 2 and killed is None:
+                for i in seg.live():
+                    if seg.worker_counter(i, "in_flight_streaming") > 0:
+                        victim, killed = i, seg.pid(i)
+                        os.kill(killed, signal.SIGKILL)
+                        break
+                assert killed is not None, "no worker holds the stream ticket"
+    except (HTTPClientError, OSError, ConnectionError, asyncio.IncompleteReadError):
+        pass
+    assert killed is not None, "stream finished before the kill landed"
+    assert b"[DONE]" not in got, "stream finished before the kill landed"
+    assert await _await(lambda: seg.counter_total("in_flight_streaming") == 0,
+                        timeout=30)
+
+    # Continuation splice on the survivor, SAME traceparent.
+    kept = _parse_frames(got)
+    cid, created = kept[0][1]["id"], kept[0][1]["created"]
+    prefix = "".join(contents)
+    cont_body = _chat_body(max_tokens=96,
+                           continuation={"text": prefix, "id": cid,
+                                         "created": created})
+    client = HTTPClient()
+    resp = await client.post(url, json.dumps(cont_body).encode(),
+                             headers=headers, stream=True)
+    assert resp.status == 200
+    continued = b""
+    async for block in resp.iter_raw():
+        continued += block
+    usage = next(ev["usage"] for _r, ev in _parse_frames(continued)
+                 if ev and ev.get("usage"))
+
+    # The journey answers from whichever worker the query lands on —
+    # the victim is dead, its shm journey slots are not (reap() leaves
+    # the journey region alone). Poll: the survivor's terminal journey
+    # event lands in its finally, which may still be running when the
+    # stream's last byte reaches the client.
+    rec = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        client = HTTPClient()
+        got_resp = await client.get(
+            f"http://127.0.0.1:{metrics_port}/debug/journey?trace_id={trace}")
+        if got_resp.status == 200:
+            rec = got_resp.json()
+            names = [e["event"] for e in rec["events"]]
+            if names.count("finished") == 1:
+                break
+        await asyncio.sleep(0.1)
+    assert rec is not None, "journey never became queryable"
+
+    assert rec["trace_id"] == trace
+    assert victim in rec["workers"] and len(rec["workers"]) == 2
+    names = [e["event"] for e in rec["events"]]
+    assert names.count("finished") == 1, names  # once-only billing
+    victim_events = [e["event"] for e in rec["events"]
+                     if e["worker"] == victim]
+    surv_events = [e["event"] for e in rec["events"] if e["worker"] != victim]
+    # The dead worker's half of the chain, read from its corpse's slots.
+    assert victim_events[0] == "admitted"
+    assert "routed" in victim_events and "first_byte" in victim_events
+    assert "finished" not in victim_events  # died before its finally
+    # The survivor's half: admitted again, splice evidence, completion.
+    assert surv_events[0] == "admitted"
+    assert "spliced" in surv_events and "routed" in surv_events
+    assert surv_events[-1] == "finished"
+    fin = next(e for e in rec["events"] if e["event"] == "finished")
+    assert fin["ok"] is True and fin["status"] == 200
+    assert fin["output_tokens"] == usage["completion_tokens"]
+    spliced = next(e for e in rec["events"] if e["event"] == "spliced")
+    assert spliced["continuation_id"] == cid
+    assert spliced["prefix_chars"] == len(prefix)
+    # Chain ordering holds across processes (shared monotonic timebase).
+    assert names[0] == "admitted" and names[-1] == "finished"
+
+    # The fleet heals for whoever runs next.
+    assert await _await(lambda: _fleet_ready(seg, 2), timeout=120)
+
+
+async def test_slo_burn_rate_moves_and_reads_identically_fleet_wide(
+        cluster_stack):
+    """SLO acceptance (ISSUE 18): inject availability faults for one
+    keyed tenant (a provider whose upstream is a closed port), then
+    scrape ``/metrics`` repeatedly — the SO_REUSEPORT group hands each
+    fresh connection to an arbitrary worker, yet every scrape reports
+    the SAME cluster-merged burn rate, because each worker self-publishes
+    then merges every live peer's window counts at scrape time."""
+    seg, _sup, port, metrics_port, _sidecar, _log = cluster_stack
+    assert await _await(lambda: _fleet_ready(seg, 2), timeout=120)
+    url = f"http://127.0.0.1:{port}/v1/chat/completions"
+    headers = Headers()
+    headers.set("Content-Type", "application/json")
+    headers.set("X-API-Key", "sk-slo-burn-e2e")
+    good = dict(_chat_body(max_tokens=4), stream=False)
+    bad = dict(good, model="ollama/llama3")  # OLLAMA_API_URL -> port 1
+    statuses = []
+    for payload in (good, bad, bad, bad):
+        client = HTTPClient()
+        resp = await client.post(url, json.dumps(payload).encode(),
+                                 headers=headers)
+        statuses.append(resp.status)
+    assert statuses[0] == 200 and all(s >= 500 for s in statuses[1:]), statuses
+
+    # Let one heartbeat pass so every live blob carries the counts, then
+    # scrape with fresh connections: whoever answers, same exposition.
+    await asyncio.sleep(0.5)
+    import re
+    pat = re.compile(
+        r'inference_gateway_slo_burn_rate\{slo="availability",'
+        r'window="5m",tenant="(key:[^"]+)"\} ([0-9.e+-]+)')
+    seen = []
+    for _ in range(4):
+        client = HTTPClient()
+        resp = await client.get(f"http://127.0.0.1:{metrics_port}/metrics")
+        assert resp.status == 200
+        matches = pat.findall(resp.body.decode())
+        assert matches, "no availability burn-rate series for the keyed tenant"
+        seen.append(sorted(matches))
+    # Moves under faults: 3 bad of 4 -> burn far above 1 (budget-burning).
+    tenant, value = seen[0][0]
+    assert float(value) > 1.0, seen[0]
+    # Identical from any worker.
+    assert all(s == seen[0] for s in seen[1:]), seen
+
+
 async def test_tenant_labels_ride_the_edge_in_cluster_mode(cluster_stack):
     """TENANT_ENABLED=true in the workers: per-tenant occupancy lands
     in the shared tenant cells and the wide-event access log carries

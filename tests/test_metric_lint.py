@@ -322,3 +322,101 @@ def test_noop_fleet_recorders_record_nothing():
     assert noop.affinity_spill_counter.values() == {}
     assert noop.streams_migrated_counter.values() == {}
     assert noop.deployment_load_gauge.values() == {}
+
+
+def test_no_instrument_carries_a_trace_or_journey_label():
+    """Cardinality lint (ISSUE 18): journeys are keyed by trace id —
+    an UNBOUNDED value space. The journey/SLO observability plane must
+    never leak that key into a metric label: a trace-labeled series is
+    a memory leak and a scrape bomb. Per-request identity belongs in
+    ``/debug/journey``, spans, and wide events — never the exposition."""
+    banned = ("trace", "journey_id", "request_id", "span", "completion_id")
+    for inst in _instruments():
+        for label in inst.label_names:
+            assert not any(tok in label.lower() for tok in banned), (
+                f"{inst.name}: label {label!r} smells like per-request "
+                "identity — unbounded cardinality in the exposition")
+
+
+def test_slo_and_journey_instruments_registered_with_expected_shapes():
+    """ISSUE 18: the fleet-observability surface must expose exactly
+    the advertised names — the acceptance criteria key on them. Every
+    label is bounded by construction: slo/window/event are fixed
+    vocabularies, tenant folds into SLO_MAX_TENANT_SERIES buckets,
+    pool comes from the operator's own config."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    for name in ("inference_gateway.slo.burn_rate",
+                 "inference_gateway.slo.error_budget_remaining"):
+        inst = by_name[name]
+        assert isinstance(inst, Gauge)
+        assert inst.label_names == ("slo", "window", "tenant")
+        assert inst.ttl > 0  # evicted tenant series age out
+    for name in ("inference_gateway.slo.pool_burn_rate",
+                 "inference_gateway.slo.pool_error_budget_remaining"):
+        inst = by_name[name]
+        assert isinstance(inst, Gauge)
+        assert inst.label_names == ("slo", "window", "pool")
+        assert inst.ttl > 0
+    events = by_name["inference_gateway.journey.events"]
+    assert isinstance(events, Counter)
+    assert events.label_names == ("event",)  # bounded JOURNEY_EVENTS vocab
+    assert events.unit == "{event}"
+    # The tenant in-flight gauge grew a source label (worker vs cluster)
+    # so the cluster-merged value is distinguishable from a single
+    # worker's local view.
+    tenant_gauge = by_name["inference_gateway.tenant.in_flight"]
+    assert isinstance(tenant_gauge, Gauge)
+    assert tenant_gauge.label_names == ("tenant", "source")
+    # Wiring smoke: both sides of each pair land under the same labels.
+    otel.set_slo_burn_rate("availability", "5m", "t1", 2.0, -1.0)
+    assert otel.slo_burn_rate_gauge.values()[("availability", "5m", "t1")] == 2.0
+    assert otel.slo_budget_gauge.values()[("availability", "5m", "t1")] == -1.0
+    otel.record_journey_event("admitted")
+    assert events.values()[("admitted",)] == 1
+
+
+def test_journey_event_label_values_are_the_bounded_vocabulary():
+    """The journey event counter's label values come from the
+    JOURNEY_EVENTS tuple — the lintable bound the cardinality rule
+    relies on. Every recorder call site uses a literal from it."""
+    from inference_gateway_tpu.otel.journey import JOURNEY_EVENTS
+
+    assert set(JOURNEY_EVENTS) == {
+        "admitted", "shed", "routed", "first_byte", "recovered",
+        "migrated", "spliced", "finished"}
+
+
+def test_slo_tenant_series_are_bounded_by_overflow_folding():
+    """SLO_MAX_TENANT_SERIES caps the distinct tenant label values: the
+    long tail folds into stable overflow buckets, so the series count
+    never exceeds max named + max overflow buckets however many tenants
+    hit the gateway."""
+    from inference_gateway_tpu.otel.slo import SloTracker
+    from inference_gateway_tpu.resilience.clock import VirtualClock
+
+    slo = SloTracker(max_tenant_series=8, clock=VirtualClock())
+    for i in range(100):
+        slo.observe(tenant=f"key:{i:04d}", ok=(i % 3 != 0))
+    keys = set(slo._scopes["tenant"])
+    assert len(keys) <= 16  # 8 named + at most 8 overflow buckets
+    overflow = {k for k in keys if k.startswith("overflow-")}
+    assert overflow, "overflow folding never engaged"
+    # Folding is stable: the same tenant lands in the same bucket.
+    assert slo.tenant_key("key:0099") == slo.tenant_key("key:0099")
+
+
+def test_noop_slo_and_journey_recorders_record_nothing():
+    """NoopTelemetry drift guard for the ISSUE 18 recorders."""
+    noop = NoopTelemetry()
+    noop.set_slo_burn_rate("availability", "5m", "t", 1.0, 0.0)
+    noop.set_pool_slo_burn_rate("ttft", "1h", "tpu/m", 1.0, 0.0)
+    noop.record_journey_event("admitted")
+    noop.set_tenant_in_flight("t", 3, source="cluster")
+    noop.remove_tenant_gauge("t", source="cluster")
+    assert noop.slo_burn_rate_gauge.values() == {}
+    assert noop.slo_budget_gauge.values() == {}
+    assert noop.slo_pool_burn_rate_gauge.values() == {}
+    assert noop.slo_pool_budget_gauge.values() == {}
+    assert noop.journey_event_counter.values() == {}
+    assert noop.tenant_in_flight_gauge.values() == {}

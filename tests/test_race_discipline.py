@@ -26,6 +26,7 @@ from tests.race_harness import (
     hammer_registry,
     hammer_scheduler_preempt,
     hammer_shm_ledger,
+    hammer_shm_journeys,
     instrument,
     start_instrumented,
 )
@@ -178,4 +179,16 @@ def test_shm_ledger_survives_multiprocess_hammer_and_reap():
     conservation at quiesce, no torn blob ever observed, and reaping a
     worker reclaims exactly its residue."""
     errors = hammer_shm_ledger(workers=4, iters=2000)
+    assert errors == [], errors
+
+
+def test_shm_journey_slots_survive_multiprocess_hammer_and_reap():
+    """The seqlocked journey slots (ISSUE 18): four child processes
+    rewrite their journey rings with variable-length self-checking
+    payloads while parent threads read/merge/search mid-storm — no
+    decoded record is ever torn (checksum + worker echo), every slot
+    holds its writer's last payload at quiesce, and reap + respawn
+    leave the dead worker's journeys readable (the chaos e2e's
+    survival contract)."""
+    errors = hammer_shm_journeys(workers=4, iters=3000)
     assert errors == [], errors
