@@ -267,19 +267,36 @@ async def _ttft_load(engine, n_streams: int, max_tokens: int = 8) -> dict:
     # aggregates land in the round's TPU_MEASURED artifact — stale
     # rounds can then be spotted by the missing `measured: true`.
     roofline = None
+    compile_ledger = None
+    hbm = None
     try:
         from inference_gateway_tpu.netio.client import HTTPClient
 
-        resp = await HTTPClient().get(f"http://127.0.0.1:{port}/debug/roofline")
+        client = HTTPClient()
+        resp = await client.get(f"http://127.0.0.1:{port}/debug/roofline")
         roofline = json.loads(resp.body)
+        # Device observatory capture (ISSUE 19): the compile ledger
+        # proves the load ran recompile-free (`recompiles: 0` after a
+        # warmed engine served real traffic) and /debug/hbm lands the
+        # measured live/peak bytes in the artifact — on CPU both are
+        # framed honest (`measured: false`), so a stale "live" round is
+        # spottable the same way as a missing mfu_measured.
+        resp = await client.get(f"http://127.0.0.1:{port}/debug/compile")
+        compile_ledger = json.loads(resp.body)
+        compile_ledger.pop("records", None)  # bounded artifact: summary + events
+        resp = await client.get(f"http://127.0.0.1:{port}/debug/hbm")
+        hbm = json.loads(resp.body)
     except Exception as e:
-        roofline = {"error": f"{type(e).__name__}: {e}"}
+        err = {"error": f"{type(e).__name__}: {e}"}
+        roofline = roofline or err
+        compile_ledger = compile_ledger or err
+        hbm = hbm or err
     await server.shutdown()
     ttfts = sorted(r[0] for r in results if isinstance(r, tuple) and np.isfinite(r[0]))
     errors = n_streams - len(ttfts)
     if not ttfts:
         return {"error": "no stream produced a first token", "failed_streams": errors,
-                "roofline": roofline}
+                "roofline": roofline, "compile_ledger": compile_ledger, "hbm": hbm}
     pick = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)]
     return {
         "n_streams": n_streams,
@@ -288,6 +305,8 @@ async def _ttft_load(engine, n_streams: int, max_tokens: int = 8) -> dict:
         "ttft_max_ms": round(ttfts[-1] * 1e3, 1),
         "failed_streams": errors,
         "roofline": roofline,
+        "compile_ledger": compile_ledger,
+        "hbm": hbm,
     }
 
 

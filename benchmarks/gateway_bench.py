@@ -906,6 +906,60 @@ async def bench_accounting_overhead(n: int = 60, max_tokens: int = 24) -> dict:
     }
 
 
+async def bench_device_observatory_overhead(n: int = 60, max_tokens: int = 24) -> dict:
+    """p99 streamed-request latency through the real sidecar with the
+    device observatory on vs off — the ISSUE 19 acceptance gate: the
+    compile-ledger wrappers + per-seam transfer audit must stay inside
+    the noise (<5% p99) or they could not survive as an always-on
+    default. Accounting is off in both variants so the delta isolates
+    the observatory."""
+    from inference_gateway_tpu.serving.engine import Engine, EngineConfig
+    from inference_gateway_tpu.serving.server import SidecarServer
+
+    async def run_variant(device_on: bool) -> list[float]:
+        engine = Engine(EngineConfig(model="test-tiny", max_slots=4, max_seq_len=128,
+                                     dtype="float32", max_prefill_batch=2,
+                                     use_mesh=False))
+        sidecar = SidecarServer(engine, served_model_name="test-tiny",
+                                accounting_enable=False,
+                                device_enable=device_on)
+        port = await sidecar.start("127.0.0.1", 0)
+        client = HTTPClient()
+        body = json.dumps({
+            "model": "test-tiny", "stream": True, "max_tokens": max_tokens,
+            "messages": [{"role": "user", "content": "overhead probe"}]}).encode()
+
+        async def one() -> float:
+            t0 = time.perf_counter()
+            resp = await client.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                                     body, stream=True)
+            async for _ in resp.iter_raw():
+                pass
+            return time.perf_counter() - t0
+
+        for _ in range(5):
+            await one()
+        lats = sorted([await one() for _ in range(n)])
+        await sidecar.shutdown()
+        return lats
+
+    off = await run_variant(False)
+    on = await run_variant(True)
+
+    def p(lats: list[float], q: float) -> float:
+        return round(lats[min(len(lats) - 1, int(len(lats) * q))] * 1000, 3)
+
+    delta = round(p(on, 0.99) - p(off, 0.99), 3)
+    return {
+        "bench": "device_observatory_overhead",
+        "p50_off_ms": p(off, 0.50), "p50_on_ms": p(on, 0.50),
+        "p99_off_ms": p(off, 0.99), "p99_on_ms": p(on, 0.99),
+        "p99_delta_ms": delta,
+        "p99_delta_pct": round(delta / p(off, 0.99) * 100, 2) if p(off, 0.99) else None,
+        "ops": n,
+    }
+
+
 async def bench_preemption_overhead(n: int = 60, max_tokens: int = 24) -> dict:
     """p99 streamed-request latency through the real sidecar with
     KV-pressure preemption armed-but-idle vs disabled — the ISSUE 7
@@ -1318,6 +1372,7 @@ async def main() -> None:
         await bench_fleet_observability_overhead(),
         await bench_compute_efficiency(),
         await bench_accounting_overhead(),
+        await bench_device_observatory_overhead(),
         await bench_preemption_overhead(),
         await bench_structured_overhead(),
         await bench_affinity_routing(),

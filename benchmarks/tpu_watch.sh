@@ -43,6 +43,18 @@ print("PROBE_OK", d[0].platform, len(d))
       if ! grep -q '"roofline"' benchmarks/TPU_MEASURED_r06.json 2>/dev/null; then
         echo "[watch $(date -u +%H:%M:%S)] WARNING: live artifact has no /debug/roofline capture" >> "$LOG"
       fi
+      # ISSUE 19: a live window must also carry the device observatory
+      # evidence — the compile ledger (recompile-free steady state) and
+      # the measured /debug/hbm pane. Their absence means the "live"
+      # round never exercised the observatory.
+      if ! grep -q '"compile_ledger"' benchmarks/TPU_MEASURED_r06.json 2>/dev/null; then
+        echo "[watch $(date -u +%H:%M:%S)] WARNING: live artifact has no /debug/compile ledger capture" >> "$LOG"
+      fi
+      if ! grep -q '"hbm"' benchmarks/TPU_MEASURED_r06.json 2>/dev/null; then
+        echo "[watch $(date -u +%H:%M:%S)] WARNING: live artifact has no /debug/hbm capture" >> "$LOG"
+      elif ! grep -q '"measured": true' benchmarks/TPU_MEASURED_r06.json 2>/dev/null; then
+        echo "[watch $(date -u +%H:%M:%S)] WARNING: live artifact's hbm/roofline panes are not device-measured" >> "$LOG"
+      fi
       exit 0
     fi
     echo "[watch $(date -u +%H:%M:%S)] bench did not produce a live number; keep watching" >> "$LOG"

@@ -40,7 +40,7 @@ class WorkerRuntime:
 
     def __init__(self, slab: WorkerSlab, *, prober: Any = None,
                  breakers: Any = None, peer_health: PeerHealthView | None = None,
-                 slo: Any = None, migrator: Any = None,
+                 slo: Any = None, migrator: Any = None, device: Any = None,
                  interval: float = 1.0,
                  clock: Clock | None = None, logger: Any = None) -> None:
         self.slab = slab
@@ -49,6 +49,12 @@ class WorkerRuntime:
         self.peer_health = peer_health
         self.slo = slo
         self.migrator = migrator
+        # Optional device-summary provider (ISSUE 19): a zero-arg
+        # callable returning DeviceObservatory.fleet_summary() for
+        # workers that own an engine. Gateway-only workers (no local
+        # accelerator) leave it unset; their fleet device view comes
+        # from the prober's cached replica status instead.
+        self.device = device
         self.interval = interval
         self.clock = clock or MonotonicClock()
         self.logger = logger
@@ -79,6 +85,12 @@ class WorkerRuntime:
             # draining entries): the blob is shared with probe/breaker
             # verdicts and the SLO counts.
             payload["migration"] = self.migrator.drain_ledger()
+        if self.device is not None:
+            # Compact device-observatory summary (ISSUE 19): compile /
+            # recompile counts, the h2d-chain invariant, HBM liveness —
+            # peers and /debug/fleet read every engine's device health
+            # from the slab without probing it.
+            payload["device"] = self.device()
         self.slab.publish(payload)
         if self.peer_health is not None:
             self.peer_health.refresh()

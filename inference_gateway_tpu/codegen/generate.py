@@ -482,6 +482,9 @@ def check_config_defaults(spec: dict) -> list[str]:
         "TELEMETRY_ACCOUNTING_ENABLE": cfg.telemetry.accounting_enable,
         "TELEMETRY_ACCOUNTING_WINDOW": cfg.telemetry.accounting_window,
         "TELEMETRY_ACCOUNTING_CHIP": cfg.telemetry.accounting_chip,
+        "TELEMETRY_DEVICE_ENABLE": cfg.telemetry.device_enable,
+        "TELEMETRY_DEVICE_COST_ANALYSIS": cfg.telemetry.device_cost_analysis,
+        "TELEMETRY_DEVICE_LEDGER_SIZE": cfg.telemetry.device_ledger_size,
         "TELEMETRY_JOURNEY_ENABLE": cfg.telemetry.journey_enable,
         "TELEMETRY_JOURNEY_SLOTS": cfg.telemetry.journey_slots,
         "TELEMETRY_JOURNEY_SLOT_BYTES": cfg.telemetry.journey_slot_bytes,

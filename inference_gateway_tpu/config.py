@@ -94,6 +94,13 @@ class TelemetryConfig:
     journey_slots: int = 64
     journey_slot_bytes: int = 4096
     journey_events: int = 32
+    # Device observatory (ISSUE 19): compile/recompile ledger, live HBM
+    # accounting, and the h2d/d2h transfer audit. On by default — off
+    # removes the jit wrappers entirely (zero overhead), cost_analysis
+    # gates the per-compile XLA lowering pass only.
+    device_enable: bool = True
+    device_cost_analysis: bool = True
+    device_ledger_size: int = 256
 
     @classmethod
     def load(cls, env: Mapping[str, str], prefix: str = "TELEMETRY_") -> "TelemetryConfig":
@@ -126,6 +133,9 @@ class TelemetryConfig:
             journey_slots=_get_int(env, prefix + "JOURNEY_SLOTS", 64),
             journey_slot_bytes=_get_int(env, prefix + "JOURNEY_SLOT_BYTES", 4096),
             journey_events=_get_int(env, prefix + "JOURNEY_EVENTS", 32),
+            device_enable=_get_bool(env, prefix + "DEVICE_ENABLE", True),
+            device_cost_analysis=_get_bool(env, prefix + "DEVICE_COST_ANALYSIS", True),
+            device_ledger_size=_get_int(env, prefix + "DEVICE_LEDGER_SIZE", 256),
         )
 
 

@@ -46,6 +46,11 @@ HOST_GAP_MS_BOUNDARIES = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 # generic-JSON grammars over large vocabularies.
 SCHEMA_COMPILE_BOUNDARIES = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                              0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+# XLA program compiles (ISSUE 19): tiny test models trace in tens of
+# milliseconds; flagship-scale programs through a remote-TPU tunnel run
+# tens to hundreds of seconds.
+COMPILE_DURATION_BOUNDARIES = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
+                               30.0, 60.0, 120.0, 300.0)
 # Compute-efficiency gauges (ISSUE 6) refresh only while the engine
 # steps; a TTL lets an idle engine's window values age out of the
 # exposition instead of freezing at the last busy reading. Must exceed
@@ -432,6 +437,57 @@ class OpenTelemetry:
             "first_byte/recovered/migrated/spliced/finished/shed)",
             ("event",), unit="{event}",
         )
+        # Device observatory (ISSUE 19): XLA compile ledger, steady-state
+        # recompile detection, live HBM accounting, and the always-on
+        # host<->device transfer audit. Label vocabularies are closed
+        # (program = the engine's jitted entry points; direction/path =
+        # the submit/fetch seams), so cardinality is bounded by code.
+        self.engine_compile_duration = r.histogram(
+            "engine.compile_duration",
+            "XLA compile wall time per jitted engine program (warmup AND "
+            "steady-state; recompiles also count on engine.recompiles)",
+            ("gen_ai_request_model", "program"), COMPILE_DURATION_BOUNDARIES,
+            unit="s",
+        )
+        self.engine_recompile_counter = r.counter(
+            "engine.recompiles",
+            "Steady-state XLA recompiles (any compile after engine warmup "
+            "completed) — the silent TPU throughput killer; the triggering "
+            "shape-signature diff rides the wide event",
+            ("gen_ai_request_model", "program"), unit="{compile}",
+        )
+        self.engine_transfer_counter = r.counter(
+            "engine.transfers",
+            "Host<->device transfers staged at the engine submit/fetch "
+            "seams, by direction (h2d/d2h) and path (prefill/decode/fresh/"
+            "chain/chunk/mixed/spec). The PR 14 invariant live: "
+            "{direction=h2d,path=chain} must read 0 on any worker",
+            ("gen_ai_request_model", "direction", "path"), unit="{transfer}",
+        )
+        self.engine_transfer_bytes_counter = r.counter(
+            "engine.transfer_bytes",
+            "Best-effort bytes of the host arrays staged per transfer "
+            "(small scalars and RNG keys are not itemized)",
+            ("gen_ai_request_model", "direction", "path"), unit="By",
+        )
+        self.engine_hbm_live_gauge = r.gauge(
+            "engine.hbm.live_bytes",
+            "Device bytes in use from device.memory_stats() — only set "
+            "when the backend measures it (absent off-TPU, never fabricated)",
+            ("gen_ai_request_model",), ttl=EFFICIENCY_GAUGE_TTL,
+        )
+        self.engine_hbm_peak_gauge = r.gauge(
+            "engine.hbm.peak_bytes",
+            "Peak device bytes in use from device.memory_stats() — only "
+            "set when the backend measures it",
+            ("gen_ai_request_model",), ttl=EFFICIENCY_GAUGE_TTL,
+        )
+        self.engine_hbm_plan_gauge = r.gauge(
+            "engine.hbm.plan_bytes",
+            "Analytic device-byte plan (weights at serving dtype + KV pool "
+            "reservation) computed from the live engine's config",
+            ("gen_ai_request_model",),
+        )
         self.tracer = Tracer(
             APPLICATION_NAME, otlp_endpoint=tracing_otlp_endpoint,
             enabled=tracing_enable, logger=logger,
@@ -727,6 +783,47 @@ class OpenTelemetry:
                 if key and key[0] == model:
                     gauge.remove(dict(zip(gauge.label_names, key)))
 
+    # -- device observatory (ISSUE 19) -----------------------------------
+    def record_compile(self, model: str, program: str, seconds: float,
+                       recompile: bool = False) -> None:
+        """One XLA compile from the engine's compile ledger; steady-state
+        recompiles additionally count on engine.recompiles (the alert
+        series — warmup compiles are expected, these are not)."""
+        self.engine_compile_duration.record(
+            seconds, {"gen_ai_request_model": model, "program": program})
+        if recompile:
+            self.engine_recompile_counter.add(
+                1, {"gen_ai_request_model": model, "program": program})
+
+    def record_transfer(self, model: str, direction: str, path: str,
+                        count: int, nbytes: int) -> None:
+        """Transfer-audit seam; count=0 pre-seeds a series at an explicit
+        zero (the h2d/chain invariant must be scrapeable, not absent)."""
+        labels = {"gen_ai_request_model": model, "direction": direction,
+                  "path": path}
+        self.engine_transfer_counter.add(count, labels)
+        self.engine_transfer_bytes_counter.add(nbytes, labels)
+
+    def set_hbm_bytes(self, model: str, *, plan: int | None = None,
+                      live: int | None = None, peak: int | None = None) -> None:
+        """HBM gauges: live/peak only when the backend measured them —
+        an off-TPU host sets the plan gauge alone, and the absent
+        live/peak series are the honest 'not measured' (never 0, never
+        the plan echoed back)."""
+        labels = {"gen_ai_request_model": model}
+        if plan is not None:
+            self.engine_hbm_plan_gauge.set(plan, labels)
+        if live is not None:
+            self.engine_hbm_live_gauge.set(live, labels)
+        if peak is not None:
+            self.engine_hbm_peak_gauge.set(peak, labels)
+
+    def remove_hbm_gauges(self, model: str) -> None:
+        labels = {"gen_ai_request_model": model}
+        for gauge in (self.engine_hbm_live_gauge, self.engine_hbm_peak_gauge,
+                      self.engine_hbm_plan_gauge):
+            gauge.remove(labels)
+
     def expose_prometheus(self) -> str:
         return self.registry.expose()
 
@@ -768,6 +865,13 @@ class OpenTelemetry:
             "engine.mfu": self.engine_mfu_gauge,
             "engine.goodput_mfu": self.engine_goodput_mfu_gauge,
             "engine.hbm_bandwidth_util": self.engine_hbm_util_gauge,
+            # Device observatory (ISSUE 19): a standalone sidecar pushes
+            # its HBM accounting so the gateway-side exposition carries
+            # every worker's device story. Note the live/peak series only
+            # arrive from hosts that measured them.
+            "engine.hbm.live_bytes": self.engine_hbm_live_gauge,
+            "engine.hbm.peak_bytes": self.engine_hbm_peak_gauge,
+            "engine.hbm.plan_bytes": self.engine_hbm_plan_gauge,
         }
 
         for rm in payload.get("resourceMetrics") or []:
@@ -1022,4 +1126,16 @@ class NoopTelemetry(OpenTelemetry):
         pass
 
     def record_schema_compile(self, *a, **k) -> None:
+        pass
+
+    def record_compile(self, *a, **k) -> None:
+        pass
+
+    def record_transfer(self, *a, **k) -> None:
+        pass
+
+    def set_hbm_bytes(self, *a, **k) -> None:
+        pass
+
+    def remove_hbm_gauges(self, *a, **k) -> None:
         pass

@@ -199,7 +199,11 @@ class HealthProber:
     # operator subset, never the replica's full forensic dump.
     _REPLICA_FIELDS = ("model", "state", "uptime_seconds", "active_requests",
                        "queue_depth", "preemptions", "engine_restarts",
-                       "streams_migrated_out", "streams_migrated_in")
+                       "streams_migrated_out", "streams_migrated_in",
+                       # Device observatory summary (ISSUE 19): compile /
+                       # recompile counts, the h2d-chain invariant, and HBM
+                       # liveness — bounded by construction (fleet_summary).
+                       "device")
 
     async def _fetch_replica_status(self, probe_u: str) -> dict[str, Any] | None:
         try:

@@ -424,6 +424,12 @@ class Engine:
         self.paged = config.attention == "paged"
         self.allocator = None
         self.prefix_cache = None
+        # Device observatory (ISSUE 19): attach() shadows the jitted
+        # entry points with compile-ledger wrappers and this attribute
+        # feeds the transfer audit. None = observability off — every
+        # seam pays exactly one attribute check (same discipline as the
+        # scheduler's timeline/accounting observers).
+        self.observatory = None
         if self.paged:
             from inference_gateway_tpu.serving.kv_cache import (
                 PagedCacheConfig,
@@ -1097,6 +1103,18 @@ class Engine:
         logprobs = compute_logprobs(logits, toks)
         return toks, logprobs, cache
 
+    def _audit_transfer(self, direction: str, path: str, *arrays) -> None:
+        """Transfer-audit seam (ISSUE 19): count one host↔device staging
+        event with the summed nbytes of the host arrays involved.
+        Best-effort byte accounting (small scalars and the RNG key are
+        not itemized); the COUNT is the invariant the audit defends —
+        the early-exit chained submit never calls this, so
+        engine.transfers{direction="h2d",path="chain"} stays zero."""
+        obs = self.observatory
+        if obs is not None:
+            obs.record_transfer(direction, path, sum(
+                int(getattr(a, "nbytes", 0)) for a in arrays if a is not None))
+
     def mixed_step_submit(self, rows: "list[MixedRow]") -> "MixedStepHandle":
         """Dispatch one ragged mixed step WITHOUT waiting (ISSUE 12).
 
@@ -1167,12 +1185,16 @@ class Engine:
                 self.metrics["decode_tokens"] += n_decode_tokens
             self.metrics["prefill_tokens"] += n_prefill
             both = jnp.stack([toks.astype(jnp.float32), logprobs])
+        self._audit_transfer("h2d", "mixed", tokens, positions, write_idx,
+                             self.allocator.page_table(), q_starts, q_lens,
+                             kv_lens, temps, top_ps, seeds, use_seed, mstates)
         return MixedStepHandle(both, list(rows))
 
     def mixed_step_fetch(self, handle: "MixedStepHandle"):
         """Block until a mixed step's sampled tokens are on host.
         Returns (tokens, logprobs) as numpy (max_slots,), row == slot."""
         both = np.asarray(handle.toks_lp)
+        self._audit_transfer("d2h", "mixed", both)
         return both[0].astype(np.int32), both[1]
 
     def _prefill_one_ragged(self, prompt: list[int], slot: int, temp: float, top_p: float,
@@ -1235,6 +1257,7 @@ class Engine:
         """Block until a submitted prefill's first tokens are on host."""
         toks = np.asarray(handle.toks)
         logprobs = np.asarray(handle.logprobs)
+        self._audit_transfer("d2h", "prefill", toks, logprobs)
         return [PrefillResult(slot, int(toks[i]), float(logprobs[i]))
                 for i, slot in enumerate(handle.slots)]
 
@@ -1498,6 +1521,8 @@ class Engine:
             scattered = self._scatter_admission(
                 slot_arr, toks, lengths, t_arr, p_arr, seed_arr, use_seed,
                 mstates=nstates, stop_rows=pad_stop, budgets=pad_bud)
+        self._audit_transfer("h2d", "prefill", tokens, positions, lengths,
+                             slot_arr, t_arr, p_arr, seed_arr, use_seed, ms_arr)
         return PrefillHandle(toks[: len(slots)], logprobs[: len(slots)],
                              list(slots), scattered=scattered)
 
@@ -1588,7 +1613,11 @@ class Engine:
             active = int((lengths > 0).sum())
             self.metrics["decode_tokens"] += active
             self.metrics["decode_steps"] += 1
-        return np.asarray(toks), np.asarray(logprobs)
+        self._audit_transfer("h2d", "decode", tokens, positions, lengths,
+                             temps, top_ps)
+        toks_np, logprobs_np = np.asarray(toks), np.asarray(logprobs)
+        self._audit_transfer("d2h", "decode", toks_np, logprobs_np)
+        return toks_np, logprobs_np
 
     def _prefill_one_chunked(self, prompt: list[int], slot: int, temp: float, top_p: float,
                              seed: int | None = None, grammar=None) -> PrefillResult:
@@ -1881,10 +1910,19 @@ class Engine:
         if self._early_exit:
             with self._lock:
                 if chain:
+                    # Host-free by construction (everything is device
+                    # resident) — the audit records NOTHING here, which
+                    # is exactly how engine.transfers{h2d,chain} stays a
+                    # scrapeable zero (ISSUE 19 invariant; the series is
+                    # pre-seeded to 0 at attach).
                     return self._chain_submit_locked(n)
-                return self._fresh_submit_ee_locked(
+                handle = self._fresh_submit_ee_locked(
                     tokens, positions, active, temps, top_ps, n, seeds,
                     use_seed, mstates, stop_tables, budgets)
+            self._audit_transfer("h2d", "fresh", tokens, positions, active,
+                                 temps, top_ps, seeds, use_seed, mstates,
+                                 stop_tables, budgets)
+            return handle
         masked, mnext, mbits, mbias = self._mask_args()
         with self._lock:
             if chain:
@@ -1932,6 +1970,17 @@ class Engine:
             self.metrics["decode_steps"] += n
             # Tokens + logprobs fused into one buffer → one readback.
             both = jnp.concatenate([toks.astype(jnp.float32), logprobs], axis=0)
+        if chain:
+            # The legacy (non-early-exit) chain still assembles write
+            # indices and re-uploads the page table host-side on paged
+            # engines — the audit records that honestly; only the
+            # early-exit chain is h2d-free.
+            if self.paged:
+                self._audit_transfer("h2d", "chain", write_idx,
+                                     self.allocator.page_table())
+        else:
+            self._audit_transfer("h2d", "fresh", tokens, positions, temps,
+                                 top_ps, seeds, use_seed, mstates)
         return _DecodeChunkHandle(both, n)
 
     # -- speculative decoding (serving/speculative.py) ------------------
@@ -2109,6 +2158,9 @@ class Engine:
             both = np.asarray(jnp.concatenate(
                 [out.astype(jnp.float32), logprobs,
                  counts.astype(jnp.float32)[:, None]], axis=1))
+        self._audit_transfer("h2d", "spec", catchup, catchup_len, catchup_pos,
+                             temps, top_ps, write_idx, seeds, use_seed, mstates)
+        self._audit_transfer("d2h", "spec", both)
         out_np = both[:, :K + 1].astype(np.int32)
         logp_np = both[:, K + 1:2 * (K + 1)]
         counts_np = both[:, -1].astype(np.int32)
@@ -2238,6 +2290,9 @@ class Engine:
             both = np.asarray(jnp.concatenate(
                 [out.astype(jnp.float32), logprobs,
                  counts.astype(jnp.float32)[:, None]], axis=1))
+        self._audit_transfer("h2d", "spec", pending, positions, draft_tokens,
+                             temps, top_ps, write_idx, seeds, use_seed, mstates)
+        self._audit_transfer("d2h", "spec", both)
         out_np = both[:, :K + 1].astype(np.int32)
         logp_np = both[:, K + 1:2 * (K + 1)]
         counts_np = both[:, -1].astype(np.int32)
@@ -2255,6 +2310,7 @@ class Engine:
         """Block until a submitted chunk's results are on the host.
         Returns (tokens, logprobs) as numpy (n_steps, S)."""
         both = np.asarray(handle.toks_lp)
+        self._audit_transfer("d2h", "chunk", both)
         n = handle.n_steps
         return both[:n].astype(np.int32), both[n:]
 
@@ -2365,7 +2421,16 @@ class Engine:
         return 1.0 - self.allocator.free_page_count() / total
 
     def warmup(self) -> float:
-        """Compile the decode program and the smallest prefill bucket."""
+        """Compile the decode program and the smallest prefill bucket.
+
+        Brackets the compile ledger (ISSUE 19) when an observatory is
+        attached: compiles inside warmup are expected; any compile after
+        the bracket closes is a steady-state recompile. Bracketing here
+        (not in serve()) means a supervised engine restart's warmup is
+        classified correctly too."""
+        obs = self.observatory
+        if obs is not None:
+            obs.warmup_begin()
         t0 = time.perf_counter()
         S = self.config.max_slots
         self.decode(
@@ -2388,4 +2453,6 @@ class Engine:
             self.mixed_step_fetch(self.mixed_step_submit([MixedRow(
                 slot=0, token_ids=[1, 2, 3], start=0, kind="prefill")]))
             self.release_slot(0)
+        if obs is not None:
+            obs.mark_warmup_complete()
         return time.perf_counter() - t0

@@ -62,6 +62,9 @@ class PageAllocator:
         self.cfg = cfg
         self.num_pages = cfg.resolve_num_pages()
         self._free: list[int] = list(range(self.num_pages))
+        # Pool high-water mark (ISSUE 19): most pages ever simultaneously
+        # out of the free list — the /debug/hbm KV pane's sizing signal.
+        self.pages_high_water = 0
         self._refs: dict[int, int] = {}
         self._slot_pages: dict[int, list[int]] = {}
         # Dense page table handed to jit; row per slot, padded with
@@ -108,6 +111,9 @@ class PageAllocator:
             self._refs[page] = 1
             self._table[slot, len(pages)] = page
             pages.append(page)
+        in_use = self.num_pages - len(self._free)
+        if in_use > self.pages_high_water:
+            self.pages_high_water = in_use
 
     def release(self, slot: int) -> None:
         pages = self._slot_pages.pop(slot, [])

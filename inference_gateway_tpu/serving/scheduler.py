@@ -283,6 +283,13 @@ class Scheduler:
         # step (flops/bytes/roofline merged into the timeline record)
         # and attributes wasted work. Same None-is-free discipline.
         self.accounting = None
+        # Optional device observatory (ISSUE 19,
+        # otel/device_observatory.DeviceObservatory): when a step's wall
+        # time includes an XLA recompile, the timeline record says so —
+        # a 2-second decode step with recompiled=1 is a shape-stability
+        # incident, not load. Same None-is-free discipline.
+        self.observatory = None
+        self._recompiles_seen = 0
         # Timeline failure damping (ISSUE 6 satellite): a broken record
         # path must not logger.error once per engine step forever —
         # consecutive failures are rate-limited and the timeline is
@@ -1435,6 +1442,21 @@ class Scheduler:
                     kind, duration, batch=batch, n_steps=n_steps, tokens=tokens,
                     work_tokens=work_tokens, context_tokens=context_tokens,
                     sq_tokens=sq_tokens, pair_tokens=pair_tokens)
+            if self.observatory is not None:
+                # Recompile-stall attribution (ISSUE 19): a ledger delta
+                # since the last record means THIS step paid the compile
+                # wall time. Enrich the timeline record and say so — the
+                # p99 spike and its cause land in the same row.
+                seen = self.observatory.ledger.recompile_count()
+                if seen != self._recompiles_seen:
+                    delta = seen - self._recompiles_seen
+                    self._recompiles_seen = seen
+                    cost = dict(cost) if cost else {}
+                    cost["recompiled"] = delta
+                    self.logger.warn(
+                        "engine step stalled on steady-state recompile",
+                        "kind", kind, "recompiles", delta,
+                        "step_ms", round(duration * 1e3, 1))
             if self.timeline is not None:
                 gap = self._pending_host_gap_ms if kind == "decode" else None
                 self._pending_host_gap_ms = None
@@ -1452,6 +1474,7 @@ class Scheduler:
                     e, "consecutive", n)
                 self.timeline = None
                 self.accounting = None
+                self.observatory = None
             elif n == 1 or n % self._TIMELINE_LOG_EVERY == 0:
                 self.logger.error("timeline record failed", e, "consecutive", n)
 

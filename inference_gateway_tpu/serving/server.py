@@ -31,6 +31,7 @@ from typing import Any
 from inference_gateway_tpu.logger import Logger, new_logger
 from inference_gateway_tpu.netio import sse
 from inference_gateway_tpu.netio.server import HTTPServer, Request, Response, Router, StreamingResponse
+from inference_gateway_tpu.otel.device_observatory import DeviceObservatory
 from inference_gateway_tpu.otel.perf_accounting import (
     PerfAccounting,
     StepCostModel,
@@ -118,6 +119,10 @@ class SidecarServer:
                  accounting_enable: bool = True,
                  accounting_window: float = 10.0,
                  accounting_chip: str | None = None,
+                 observatory: DeviceObservatory | None = None,
+                 device_enable: bool = True,
+                 device_cost_analysis: bool = True,
+                 device_ledger_size: int = 256,
                  preempt_max: int = 3, preempt_high_water: float = 0.0,
                  engine_watchdog=None, engine_factory=None, clock=None,
                  migrate_streams: bool = True, admin_enabled: bool = True):
@@ -239,6 +244,31 @@ class SidecarServer:
         self.accounting = accounting
         if self.scheduler.accounting is None:
             self.scheduler.accounting = accounting
+        # Device observatory (ISSUE 19): compile/recompile ledger over
+        # every jitted entry point, XLA-grounded rooflines, live HBM
+        # accounting, and the always-on transfer audit
+        # (TELEMETRY_DEVICE_ENABLE; on by default). The standalone
+        # sidecar builds and attaches it in serve() BEFORE warmup so
+        # boot compiles land in the ledger; built here, it observes
+        # everything from construction on. Failure degrades to "no
+        # observatory" — never blocks serving.
+        if observatory is None and device_enable:
+            try:
+                observatory = DeviceObservatory(
+                    otel=otel, model=self.model_name, logger=self.logger,
+                    ledger_size=device_ledger_size,
+                    cost_analysis=device_cost_analysis)
+            except Exception as e:
+                self.logger.warn("device observatory disabled", "error", str(e))
+        if observatory is not None and getattr(engine, "observatory", None) is not observatory:
+            try:
+                observatory.attach(engine)
+            except Exception as e:
+                self.logger.warn("device observatory attach failed", "error", str(e))
+                observatory = None
+        self.observatory = observatory
+        if self.scheduler.observatory is None:
+            self.scheduler.observatory = observatory
         # Streaming fast path (SERVING_EMIT_COALESCE_MS): tokens sampled
         # within this window (seconds; in practice: the same decode step)
         # merge into ONE SSE frame. 0 (the default) keeps the one-frame-
@@ -275,6 +305,8 @@ class SidecarServer:
         r.get("/metrics", self.metrics)
         r.get("/debug/timeline", self.debug_timeline)
         r.get("/debug/roofline", self.debug_roofline)
+        r.get("/debug/compile", self.debug_compile)
+        r.get("/debug/hbm", self.debug_hbm)
         r.get("/debug/status", self.debug_status)
         r.get("/debug/profile", self.debug_profile)
         r.get("/debug/jax_trace", self.debug_jax_trace)
@@ -325,6 +357,7 @@ class SidecarServer:
             # 6) follow the same current-state semantics.
             self.otel.remove_engine_gauges(self.model_name)
             self.otel.remove_efficiency_gauges(self.model_name)
+            self.otel.remove_hbm_gauges(self.model_name)
 
     def depth_probe(self) -> int:
         """Engine saturation signal for a co-hosted gateway's
@@ -463,6 +496,13 @@ class SidecarServer:
 
         def _build() -> Engine:
             eng = factory()
+            # Re-attach the observatory BEFORE warmup (ISSUE 19): the
+            # wrappers are instance attributes, so the replacement engine
+            # needs its own set, and warmup() brackets the ledger itself
+            # — the rebuilt engine's boot compiles classify as warmup,
+            # never as steady-state recompiles.
+            if self.observatory is not None:
+                self.observatory.attach(eng)
             # Warm before the swap (same contract as serve() at boot):
             # the replacement must not meet its first request cold — a
             # post-restart compile longer than the watchdog deadline
@@ -493,6 +533,7 @@ class SidecarServer:
                           clock=self._clock)
         sched.timeline = self.timeline
         sched.accounting = self.accounting
+        sched.observatory = self.observatory
         sched.on_preempt = self._on_preempt
         # Counter continuity: /metrics "preemptions" is cumulative for
         # the PROCESS — a scheduler swap must not make it go backwards
@@ -560,6 +601,11 @@ class SidecarServer:
                 queue_depth=sched.queue_depth,
                 spec_tokens_per_slot_round=spec_rate,
             )
+        if self.observatory is not None:
+            # engine.hbm.{plan,live,peak}_bytes ride the same cadence
+            # (ISSUE 19); off-TPU only the plan gauge exists — absent
+            # live/peak series are the honest "not measured".
+            self.observatory.sample_hbm_gauges()
         return gauges
 
     @staticmethod
@@ -611,6 +657,23 @@ class SidecarServer:
                     "name": name,
                     "gauge": {"dataPoints": [{"asDouble": val, "attributes": attrs}]},
                 })
+        if self.observatory is not None:
+            # HBM accounting rides the push too (ISSUE 19): the gateway
+            # ingest maps engine.hbm.* onto last-value gauges. live/peak
+            # appear only when the backend actually measured them.
+            hbm = self.observatory.hbm_snapshot()
+            points = [("engine.hbm.plan_bytes",
+                       (hbm.get("plan") or {}).get("plan_bytes"))]
+            if hbm.get("measured"):
+                points.append(("engine.hbm.live_bytes", hbm.get("live_bytes")))
+                points.append(("engine.hbm.peak_bytes", hbm.get("peak_bytes")))
+            for name, val in points:
+                if val:
+                    metrics.append({
+                        "name": name,
+                        "gauge": {"dataPoints": [{"asDouble": float(val),
+                                                  "attributes": attrs}]},
+                    })
         if not metrics:
             return None
         return {
@@ -754,6 +817,23 @@ class SidecarServer:
             m["hbm_bandwidth_util"] = eff["hbm_bandwidth_util"]
             m["wasted_tokens"] = sum(eff["wasted_tokens"].values())
             m["compute_efficiency"] = eff
+        if self.observatory is not None:
+            # Device observatory flat numerics (ISSUE 19): the transfer
+            # table plus the chained-submit invariant as its own scalar
+            # — engine.transfers{direction="h2d",path="chain"} staying 0
+            # on a live scrape is the production proof of the host-free
+            # decode chain.
+            m["compiles"] = self.observatory.ledger.compiles
+            m["recompiles"] = self.observatory.ledger.recompile_count()
+            m["transfers"] = self.observatory.transfers.snapshot()
+            m["h2d_chain_transfers"] = self.observatory.transfers.count("h2d", "chain")
+            hbm = self.observatory.hbm_snapshot()
+            plan_bytes = (hbm.get("plan") or {}).get("plan_bytes")
+            if plan_bytes:
+                m["hbm_plan_bytes"] = plan_bytes
+            if hbm.get("measured"):
+                m["hbm_live_bytes"] = hbm.get("live_bytes")
+                m["hbm_peak_bytes"] = hbm.get("peak_bytes")
         return m
 
     async def metrics(self, req: Request) -> Response:
@@ -774,6 +854,12 @@ class SidecarServer:
         if isinstance(structured_stats, dict):
             for k, v in structured_stats.items():
                 flat[f"structured_{k}"] = v
+        transfers = flat.pop("transfers", None)
+        if isinstance(transfers, dict):
+            # h2d/chain -> tpu_sidecar_transfers_h2d_chain (ISSUE 19):
+            # the invariant series must be scrapeable in text format too.
+            for key, slot in transfers.items():
+                flat[f"transfers_{key.replace('/', '_')}"] = slot["count"]
         lines = []
         for key, val in sorted(flat.items()):
             if not isinstance(val, (int, float)):
@@ -819,7 +905,61 @@ class SidecarServer:
         entries = self.timeline.tail(None) if self.timeline is not None else []
         report = roofline_report(self.accounting, entries)
         report["model"] = self.model_name
+        if self.observatory is not None:
+            # XLA grounding (ISSUE 19): the compiler's own cost model
+            # for the largest program of each kind, next to the analytic
+            # per-step numbers. analytic_vs_xla > 1 is static-shape
+            # padding the per-token analytic model does not charge for —
+            # or analytic-model drift, which this pane exists to catch.
+            xla = self.observatory.ledger.per_kind_xla()
+            if xla:
+                analytic_by_kind: dict[str, list[float]] = {}
+                for rec in entries:
+                    if "flops" in rec:
+                        analytic_by_kind.setdefault(rec["kind"], []).append(rec["flops"])
+                for kind, info in xla.items():
+                    vals = analytic_by_kind.get(kind)
+                    if vals:
+                        mean = sum(vals) / len(vals)
+                        info["analytic_flops_mean"] = round(mean, 1)
+                        info["analytic_vs_xla"] = (round(info["flops"] / mean, 2)
+                                                   if mean > 0 else None)
+                report["xla"] = xla
+                report["xla_note"] = (
+                    "cost_analysis() prices the full static-shape program; "
+                    "analytic_vs_xla compares it to the mean analytic "
+                    "per-step FLOPs over the timeline window")
         return Response.json(report)
+
+    async def debug_compile(self, req: Request) -> Response:
+        """GET /debug/compile — the device compile/recompile ledger
+        (ISSUE 19): every XLA compilation of a jitted engine entry point
+        with program name, static shape signature, compile wall-ms, and
+        ``cost_analysis()`` FLOPs / bytes-accessed — plus the
+        steady-state recompile events with the per-argument signature
+        diff that triggered each one. A nonzero ``recompiles`` after
+        warmup is a shape-stability bug, not noise."""
+        if self.observatory is None:
+            return Response.json(
+                {"error": "device observatory disabled (TELEMETRY_DEVICE_ENABLE)"},
+                status=404)
+        snap = self.observatory.ledger.snapshot()
+        snap["model"] = self.model_name
+        return Response.json(snap)
+
+    async def debug_hbm(self, req: Request) -> Response:
+        """GET /debug/hbm — live device memory against the analytic plan
+        (ISSUE 19): runtime ``memory_stats()`` when the backend exposes
+        it, framed ``measured: false`` otherwise (host numbers are never
+        presented as device truth); the weights + KV-pool byte plan; and
+        the KV page pool's high-water mark."""
+        if self.observatory is None:
+            return Response.json(
+                {"error": "device observatory disabled (TELEMETRY_DEVICE_ENABLE)"},
+                status=404)
+        snap = self.observatory.hbm_snapshot()
+        snap["model"] = self.model_name
+        return Response.json(snap)
 
     async def debug_status(self, req: Request) -> Response:
         """GET /debug/status — one JSON snapshot of the sidecar's
@@ -829,7 +969,7 @@ class SidecarServer:
         health prober caches for /debug/fleet (ISSUE 18) — cheap enough
         to ride every probe round."""
         if req.query_get("brief"):
-            return Response.json({
+            brief = {
                 "model": self.model_name,
                 "uptime_seconds": round(self._clock.now() - self._started, 3),
                 "active_requests": self.scheduler.active_requests(),
@@ -838,7 +978,12 @@ class SidecarServer:
                 "preemptions": self.scheduler.preemptions,
                 "engine_restarts": self.restarts,
                 "streams_migrated_out": self.migrated_out,
-            })
+            }
+            if self.observatory is not None:
+                # Bounded device summary (ISSUE 19) — rides every fleet
+                # probe round, so compact by construction.
+                brief["device"] = self.observatory.fleet_summary()
+            return Response.json(brief)
         status: dict[str, Any] = {
             "model": self.model_name,
             "uptime_seconds": round(self._clock.now() - self._started, 3),
@@ -876,6 +1021,11 @@ class SidecarServer:
             status["timeline"] = self.timeline.stats()
         if self.accounting is not None:
             status["compute_efficiency"] = self.accounting.snapshot()
+        if self.observatory is not None:
+            # The full device pane (ISSUE 19): compile ledger, transfer
+            # audit, HBM accounting — one stop for "what has the device
+            # actually been doing".
+            status["device"] = self.observatory.snapshot()
         if self.slow_log is not None:
             status["slow_requests"] = self.slow_log.snapshot()
         if self.profiler is not None:
@@ -1606,6 +1756,20 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
     if svcfg.decode_pipeline_depth:
         config.pipeline_depth = svcfg.decode_pipeline_depth
     engine = Engine(config)
+    # Device observatory (ISSUE 19): attach BEFORE warmup so every boot
+    # compile lands in the ledger with its cost analysis — warmup()
+    # brackets itself, so these classify as warmup, not recompiles.
+    observatory = None
+    if tcfg.device_enable:
+        try:
+            observatory = DeviceObservatory(
+                model=served_model_name or config.model, logger=logger,
+                ledger_size=tcfg.device_ledger_size,
+                cost_analysis=tcfg.device_cost_analysis)
+            observatory.attach(engine)
+        except Exception as e:
+            logger.warn("device observatory disabled", "error", str(e))
+            observatory = None
     warm = engine.warmup()
     logger.info("engine warm", "compile_seconds", round(warm, 1), "model", config.model)
     tracer = None
@@ -1663,6 +1827,10 @@ async def serve(config: EngineConfig, host: str = "0.0.0.0", port: int = 8000,
                            accounting_enable=tcfg.accounting_enable,
                            accounting_window=tcfg.accounting_window,
                            accounting_chip=tcfg.accounting_chip or None,
+                           observatory=observatory,
+                           device_enable=tcfg.device_enable,
+                           device_cost_analysis=tcfg.device_cost_analysis,
+                           device_ledger_size=tcfg.device_ledger_size,
                            preempt_max=preempt_budget,
                            preempt_high_water=svcfg.preempt_high_water,
                            engine_watchdog=engine_watchdog,

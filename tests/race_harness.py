@@ -747,3 +747,109 @@ def hammer_shm_journeys(workers: int = 4, iters: int = 3000,
                 p.kill()
         seg.close(unlink=True)
     return errors
+
+
+def hammer_compile_ledger(writer_threads: int = 6, reader_threads: int = 2,
+                          iters: int = 300) -> list[str]:
+    """Concurrency hammer for the ISSUE 19 ``CompileLedger``.
+
+    The ledger is written from every wrapped jit entry point — prefill
+    and decode seams run on the scheduler thread, warmup on an executor
+    thread — while ``/debug/compile`` snapshots and the scheduler's
+    recompile-count reads land from the serving thread, and a
+    supervised restart flips the warmup bracket mid-flight. N writer
+    threads drive wrapped functions with thread-unique signatures (the
+    fallback signature detector path — deterministic compile counting),
+    a flipper toggles warmup_begin/mark_warmup_complete, and readers
+    snapshot concurrently. Returns error strings; empty means no
+    exceptions, no torn snapshot, and exactly-conserved compile counts.
+    """
+    from inference_gateway_tpu.otel.device_observatory import CompileLedger
+
+    ledger = CompileLedger(size=64, cost_analysis=False)
+    errors: list[str] = []
+    errors_lock = threading.Lock()
+    barrier = threading.Barrier(writer_threads + reader_threads + 1)
+    done = threading.Event()
+
+    def fail(msg: str) -> None:
+        with errors_lock:
+            errors.append(f"{msg} [thread={threading.current_thread().name}]")
+
+    def base_fn(tag):
+        return tag
+
+    wrapped = {t: ledger.wrap(f"prog_{t % 3}", base_fn)
+               for t in range(writer_threads)}
+
+    def writer(tid: int) -> None:
+        barrier.wait()
+        fn = wrapped[tid]
+        for i in range(iters):
+            try:
+                # Thread-unique signature per call: every call is a
+                # first-seen signature, so total compiles is exact.
+                fn(f"w{tid}-{i}")
+            except Exception as e:
+                fail(f"writer: {e!r}")
+                return
+
+    def flipper() -> None:
+        barrier.wait()
+        while not done.is_set():
+            try:
+                ledger.mark_warmup_complete()
+                ledger.warmup_begin()
+            except Exception as e:
+                fail(f"flipper: {e!r}")
+                return
+
+    def reader() -> None:
+        barrier.wait()
+        while not done.is_set():
+            try:
+                snap = ledger.snapshot()
+                if snap["recompiles"] > snap["compiles"]:
+                    fail(f"torn snapshot: recompiles {snap['recompiles']} > "
+                         f"compiles {snap['compiles']}")
+                    return
+                if len(snap["records"]) > 64:
+                    fail(f"ring overflow: {len(snap['records'])} records")
+                    return
+                for rec in snap["records"]:
+                    if "program" not in rec or "signature" not in rec:
+                        fail(f"torn record: {rec}")
+                        return
+                ledger.recompile_count()
+                ledger.recent_recompiles(5)
+                ledger.per_kind_xla()
+            except Exception as e:
+                fail(f"reader: {e!r}")
+                return
+
+    threads = [threading.Thread(target=writer, args=(t,), name=f"ledger-w{t}",
+                                daemon=True)
+               for t in range(writer_threads)]
+    threads += [threading.Thread(target=reader, name=f"ledger-r{t}", daemon=True)
+                for t in range(reader_threads)]
+    flip = threading.Thread(target=flipper, name="ledger-flip", daemon=True)
+    for t in threads:
+        t.start()
+    flip.start()
+    for t in threads[:writer_threads]:
+        t.join(timeout=120)
+        if t.is_alive():
+            fail(f"{t.name} did not finish")
+    done.set()
+    for t in threads[writer_threads:]:
+        t.join(timeout=120)
+        if t.is_alive():
+            fail(f"{t.name} did not finish")
+    flip.join(timeout=120)
+    expected = writer_threads * iters
+    if ledger.compiles != expected:
+        fail(f"compile count lost updates: {ledger.compiles} != {expected}")
+    snap = ledger.snapshot()
+    if snap["recompiles"] != ledger.recompiles:
+        fail("snapshot/counter recompile divergence")
+    return errors

@@ -73,8 +73,15 @@ def test_unit_suffix_conventions():
             assert any(tok in pname for tok in ("duration", "time", "lag", "latency", "wait")), (
                 f"{inst.name}: seconds histogram should name a duration/time/lag")
         if isinstance(inst, Counter):
-            assert inst.unit.startswith("{") or inst.unit == "", (
-                f"{inst.name}: counters count discrete events; unit {inst.unit!r}")
+            # Counters count discrete events ({...} annotation units) —
+            # except byte totals, which carry OTel's "By" and must say
+            # so in the name (ISSUE 19: engine.transfer_bytes).
+            if inst.unit == "By":
+                assert pname.endswith("_bytes"), (
+                    f"{inst.name}: 'By' counter must be named *_bytes")
+            else:
+                assert inst.unit.startswith("{") or inst.unit == "", (
+                    f"{inst.name}: counters count discrete events; unit {inst.unit!r}")
 
 
 def test_noop_telemetry_overrides_every_recorder():
@@ -309,6 +316,71 @@ def test_noop_structured_recorders_record_nothing():
     assert noop.constrained_requests_counter.values() == {}
     assert noop.mask_cache_counter.values() == {}
     assert noop.schema_compile_duration.total_count() == 0
+
+
+def test_device_observatory_instruments_registered_with_expected_shapes():
+    """ISSUE 19: the device-observatory surface must expose exactly the
+    advertised names — the chained-submit invariant and the recompile
+    alert key on them."""
+    otel = OpenTelemetry()
+    by_name = {inst.name: inst for inst in otel.registry._instruments}
+    compile_h = by_name["engine.compile_duration"]
+    assert isinstance(compile_h, Histogram)
+    assert compile_h.label_names == ("gen_ai_request_model", "program")
+    assert compile_h.unit == "s"
+    recompiles = by_name["engine.recompiles"]
+    assert isinstance(recompiles, Counter)
+    assert recompiles.label_names == ("gen_ai_request_model", "program")
+    assert recompiles.unit == "{compile}"
+    transfers = by_name["engine.transfers"]
+    assert isinstance(transfers, Counter)
+    assert transfers.label_names == ("gen_ai_request_model", "direction", "path")
+    assert transfers.unit == "{transfer}"
+    tbytes = by_name["engine.transfer_bytes"]
+    assert isinstance(tbytes, Counter)
+    assert tbytes.label_names == ("gen_ai_request_model", "direction", "path")
+    assert tbytes.unit == "By"
+    for name in ("engine.hbm.live_bytes", "engine.hbm.peak_bytes"):
+        gauge = by_name[name]
+        assert isinstance(gauge, Gauge)
+        assert gauge.label_names == ("gen_ai_request_model",)
+        # Staleness discipline: live/peak age out when sampling stops;
+        # the static plan gauge persists for the process lifetime.
+        assert gauge.ttl > 0
+    plan = by_name["engine.hbm.plan_bytes"]
+    assert isinstance(plan, Gauge)
+    assert plan.label_names == ("gen_ai_request_model",)
+    # A warmup compile records duration only; a steady-state recompile
+    # counts on engine.recompiles too.
+    otel.record_compile("m", "decode_fn", 0.8, recompile=False)
+    otel.record_compile("m", "decode_fn", 0.8, recompile=True)
+    assert compile_h.total_count() == 2
+    assert recompiles.values() == {("m", "decode_fn"): 1}
+    # record_transfer(count=0) pre-seeds the invariant series at an
+    # explicit scrapeable zero.
+    otel.record_transfer("m", "h2d", "chain", 0, 0)
+    assert transfers.values()[("m", "h2d", "chain")] == 0
+    otel.record_transfer("m", "h2d", "fresh", 1, 128)
+    assert transfers.values()[("m", "h2d", "fresh")] == 1
+    assert tbytes.values()[("m", "h2d", "fresh")] == 128
+    otel.set_hbm_bytes("m", plan=1000, live=900, peak=950)
+    assert plan.values()[("m",)] == 1000
+    otel.remove_hbm_gauges("m")
+    assert plan.values() == {}
+
+
+def test_noop_device_recorders_record_nothing():
+    """NoopTelemetry drift guard for the ISSUE 19 recorders."""
+    noop = NoopTelemetry()
+    noop.record_compile("m", "decode_fn", 0.5, recompile=True)
+    noop.record_transfer("m", "h2d", "chain", 1, 64)
+    noop.set_hbm_bytes("m", plan=1, live=2, peak=3)
+    noop.remove_hbm_gauges("m")
+    assert noop.engine_compile_duration.total_count() == 0
+    assert noop.engine_recompile_counter.values() == {}
+    assert noop.engine_transfer_counter.values() == {}
+    assert noop.engine_hbm_live_gauge.values() == {}
+    assert noop.engine_hbm_plan_gauge.values() == {}
 
 
 def test_noop_fleet_recorders_record_nothing():

@@ -22,6 +22,7 @@ from inference_gateway_tpu.serving.scheduler import GenRequest, Scheduler
 
 from tests.race_harness import (
     DisciplineViolation,
+    hammer_compile_ledger,
     hammer_prober,
     hammer_registry,
     hammer_scheduler_preempt,
@@ -153,6 +154,17 @@ def test_metrics_registry_survives_concurrent_add_and_collect():
     from inference_gateway_tpu.otel.metrics import Registry
 
     errors = hammer_registry(Registry())
+    assert errors == [], errors
+
+
+def test_compile_ledger_survives_concurrent_compiles_and_snapshots():
+    """The ISSUE 19 compile ledger is written from every wrapped jit
+    entry point (scheduler thread, warmup executor) while /debug/compile
+    snapshots read from the serving thread and a supervised restart
+    flips the warmup bracket mid-flight: concurrent compiles, bracket
+    flips, and snapshot reads must lose no compile and never tear a
+    snapshot."""
+    errors = hammer_compile_ledger()
     assert errors == [], errors
 
 
